@@ -1,0 +1,16 @@
+"""CaffeOnSpark-TPU: a TPU-native deep learning framework with the
+capabilities of yahoo/CaffeOnSpark, built on JAX/XLA/Pallas.
+
+Subpackages:
+  proto     — Caffe prototxt/protobuf schema + self-contained codec
+  ops       — layer forward functions + fillers (+ Pallas kernels)
+  parallel  — device mesh, data/tensor/sequence parallel strategies
+  data      — data sources, transformer, LMDB/SequenceFile/Parquet readers
+  models    — net compiler output, model zoo configs
+  tools     — dataset conversion utilities (Binary2Sequence, Vocab, COCO)
+
+Top-level modules mirror the reference's public API surface
+(`CaffeOnSpark.scala`, `Config.scala`, `CaffeProcessor.scala`).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+"""Test harness config: force CPU with 8 virtual devices so multi-chip
+sharding tests (Mesh/pjit/shard_map) run without TPU hardware, mirroring
+SURVEY.md §4.4's guidance for the rebuild's CI."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,244 @@
+"""Proto subsystem tests — ProtoTest.java analog (SURVEY §4.1) plus binary
+wire round-trips the reference got for free from protobuf-java."""
+
+import os
+
+import pytest
+
+from caffeonspark_tpu.proto import (BlobProto, BlobShape, Datum,
+                                    NetParameter, Phase, SolverParameter,
+                                    read_net, read_solver)
+
+LENET_SOLVER = """
+net: "lenet_memory_train_test.prototxt"
+test_iter: 10
+test_interval: 100
+base_lr: 0.01
+momentum: 0.9
+weight_decay: 0.0005
+lr_policy: "inv"
+gamma: 0.0001
+power: 0.75
+display: 100
+max_iter: 2000
+snapshot: 5000
+snapshot_prefix: "mnist_lenet"
+solver_mode: GPU
+"""
+
+NET_SNIPPET = """
+name: "LeNet"
+layer {
+  name: "data"
+  type: "MemoryData"
+  top: "data"
+  top: "label"
+  include { phase: TRAIN }
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param {
+    source: "file:/tmp/mnist_train_lmdb"
+    batch_size: 64
+    channels: 1
+    height: 28
+    width: 28
+    share_in_parallel: false
+  }
+  transform_param { scale: 0.00390625 }
+}
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  param { lr_mult: 1 }
+  param { lr_mult: 2 }
+  convolution_param {
+    num_output: 20
+    kernel_size: 5
+    stride: 1
+    weight_filler { type: "xavier" }
+    bias_filler { type: "constant" }
+  }
+}
+layer {
+  name: "loss"
+  type: "SoftmaxWithLoss"
+  bottom: "conv1"
+  bottom: "label"
+  top: "loss"
+}
+"""
+
+
+def test_solver_parse():
+    s = SolverParameter.from_text(LENET_SOLVER)
+    assert s.net == "lenet_memory_train_test.prototxt"
+    assert s.test_iter == [10]
+    assert s.test_interval == 100
+    assert abs(s.base_lr - 0.01) < 1e-9
+    assert s.lr_policy == "inv"
+    assert s.max_iter == 2000
+    assert s.momentum == pytest.approx(0.9)
+    assert s.snapshot_prefix == "mnist_lenet"
+    # defaults for unset fields
+    assert s.iter_size == 1
+    assert s.clip_gradients == -1.0
+    assert s.random_seed == -1
+
+
+def test_net_parse():
+    n = NetParameter.from_text(NET_SNIPPET)
+    assert n.name == "LeNet"
+    assert len(n.layer) == 3
+    data = n.layer[0]
+    assert data.type == "MemoryData"
+    assert data.top == ["data", "label"]
+    assert data.include[0].phase == Phase.TRAIN
+    assert data.source_class == "com.yahoo.ml.caffe.LMDB"
+    assert data.memory_data_param.batch_size == 64
+    assert data.memory_data_param.share_in_parallel is False
+    assert data.transform_param.scale == pytest.approx(0.00390625)
+    conv = n.layer[1]
+    assert [p.lr_mult for p in conv.param] == [1.0, 2.0]
+    assert conv.convolution_param.kernel_size == [5]
+    assert conv.convolution_param.weight_filler.type == "xavier"
+    # bias_term default
+    assert conv.convolution_param.bias_term is True
+
+
+def test_text_round_trip():
+    n = NetParameter.from_text(NET_SNIPPET)
+    n2 = NetParameter.from_text(n.to_text())
+    assert n == n2
+
+
+def test_train_state_stages():
+    s = SolverParameter.from_text("""
+        train_state: { stage: 'freeze-convnet' stage: 'factored' }
+        test_state: { stage: 'a' stage: 'test-on-train' }
+        random_seed: 1701
+        average_loss: 100
+        clip_gradients: 10
+        snapshot_format: HDF5
+    """)
+    assert s.train_state.stage == ["freeze-convnet", "factored"]
+    assert s.test_state[0].stage == ["a", "test-on-train"]
+    assert s.random_seed == 1701
+    assert s.average_loss == 100
+    assert s.clip_gradients == pytest.approx(10.0)
+    assert s.snapshot_format == 0  # HDF5
+
+
+def test_unknown_fields_skipped():
+    n = NetParameter.from_text("""
+        name: "x"
+        some_unknown_scalar: 3
+        some_unknown_block { foo: 1 bar { baz: "s" } }
+        layer { name: "l" type: "ReLU" }
+    """)
+    assert n.name == "x"
+    assert n.layer[0].type == "ReLU"
+
+
+def test_datum_binary_round_trip():
+    d = Datum(channels=3, height=2, width=2, label=7,
+              data=bytes(range(12)), encoded=False)
+    b = d.to_binary()
+    d2 = Datum.from_binary(b)
+    assert d2.channels == 3 and d2.height == 2 and d2.width == 2
+    assert d2.label == 7
+    assert d2.data == bytes(range(12))
+    assert d2.encoded is False
+
+
+def test_blobproto_packed_floats():
+    bp = BlobProto(shape=BlobShape(dim=[2, 3]),
+                   data=[0.5, -1.25, 3.0, 0.0, 2.5, 7.0])
+    b = bp.to_binary()
+    bp2 = BlobProto.from_binary(b)
+    assert bp2.shape.dim == [2, 3]
+    assert bp2.data == pytest.approx([0.5, -1.25, 3.0, 0.0, 2.5, 7.0])
+
+
+def test_netparameter_binary_round_trip():
+    n = NetParameter.from_text(NET_SNIPPET)
+    n2 = NetParameter.from_binary(n.to_binary())
+    assert n2 == n
+    assert n2.layer[1].convolution_param.num_output == 20
+
+
+def test_read_does_not_create_presence():
+    a = NetParameter.from_text('name: "x"')
+    b = NetParameter.from_text('name: "x"')
+    _ = a.state            # read-only access of unset message field
+    _ = a.layer            # and of unset repeated field
+    assert a == b
+    assert "state" not in a.to_text()
+
+
+def test_write_through_chain_vivifies():
+    s = SolverParameter()
+    s.train_state.stage.append("factored")
+    assert s.train_state.stage == ["factored"]
+    assert "train_state" in s.to_text()
+    s2 = SolverParameter()
+    s2.net_param.name = "deep"
+    assert s2.net_param.name == "deep"
+    assert "net_param" in s2.to_text()
+
+
+def test_octal_and_hex_int_literals():
+    assert SolverParameter.from_text("device_id: 010").device_id == 8
+    assert SolverParameter.from_text("device_id: 0x1F").device_id == 31
+    assert SolverParameter.from_text("device_id: -010").device_id == -8
+
+
+def test_negative_int32_binary():
+    from caffeonspark_tpu.proto.caffe import LossParameter
+    lp = LossParameter(ignore_label=-1)
+    assert LossParameter.from_binary(lp.to_binary()).ignore_label == -1
+
+
+def test_truncated_binary_rejected():
+    d = Datum(channels=3, data=b"xxxx")
+    with pytest.raises(ValueError):
+        Datum.from_binary(d.to_binary()[:-2])
+    # truncation inside an *unknown* trailing field must also raise
+    with pytest.raises(ValueError):
+        NetParameter.from_binary(bytes([0xF2, 0x3E, 100]) + b"ab")
+
+
+def test_trailing_backslash_is_parse_error():
+    with pytest.raises(ValueError, match="unterminated"):
+        NetParameter.from_text('name: "abc\\')
+
+
+REF_DATA = "/root/reference/data"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_DATA),
+                    reason="reference configs not mounted")
+@pytest.mark.parametrize("fname", [
+    "lenet_memory_solver.prototxt", "cifar10_quick_solver.prototxt",
+    "bvlc_reference_solver.prototxt", "lrcn_solver.prototxt",
+])
+def test_parse_reference_solvers(fname):
+    s = read_solver(os.path.join(REF_DATA, fname))
+    assert s.max_iter > 0
+    assert s.base_lr > 0
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_DATA),
+                    reason="reference configs not mounted")
+@pytest.mark.parametrize("fname", [
+    "lenet_memory_train_test.prototxt", "cifar10_quick_train_test.prototxt",
+    "bvlc_reference_net.prototxt", "caffenet_train_net.prototxt",
+    "lrcn_cos.prototxt", "lenet_cos_train_test.prototxt",
+    "lstm_deploy.prototxt", "lrcn_word_to_preds.deploy.prototxt",
+    "lenet_dataframe_train_test.prototxt",
+])
+def test_parse_reference_nets(fname):
+    n = read_net(os.path.join(REF_DATA, fname))
+    assert len(n.layer) > 0
+    for lyr in n.layer:
+        assert lyr.type
