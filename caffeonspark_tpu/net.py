@@ -1,0 +1,304 @@
+"""Net compiler: NetParameter (+ NetState) → a functional JAX net.
+
+TPU-native equivalent of caffe::Net construction inside
+`CaffeNet<Dtype>::CaffeNet` (reference `caffe-distri/src/main/cpp/
+CaffeNet.cpp:101-205`) and the per-phase layer filtering the driver does in
+`Config.scala:73-86`.  Instead of a mutable layer graph, compilation
+produces:
+
+  * ``Net.init(key)``      → params pytree {layer: {blob: array}}
+  * ``Net.apply(params, inputs, train, rng, state)`` → (blobs, new_state)
+  * ``Net.loss(...)``      → weighted total loss + blobs (for jax.grad)
+
+Everything in apply is traceable: one `jax.jit` covers the whole forward
+(+backward via grad), letting XLA fuse elementwise chains into MXU matmul/
+conv ops.  Layer inclusion rules (phase/stage/not_stage/level) follow
+caffe's NetState::StateMeetsRule semantics used by lrcn_solver.prototxt's
+train_state/test_state stages.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .ops import layers as L
+from .proto.caffe import (LayerParameter, NetParameter, NetState,
+                          NetStateRule, Phase, TopBlobType)
+
+Array = jax.Array
+Params = Dict[str, Dict[str, Array]]
+
+
+def state_meets_rule(rule: NetStateRule, state: NetState) -> bool:
+    if rule.has("phase") and rule.phase != state.phase:
+        return False
+    if rule.has("min_level") and state.level < rule.min_level:
+        return False
+    if rule.has("max_level") and state.level > rule.max_level:
+        return False
+    stages = set(state.stage)
+    for s in rule.stage:
+        if s not in stages:
+            return False
+    for s in rule.not_stage:
+        if s in stages:
+            return False
+    return True
+
+
+def layer_included(lp: LayerParameter, state: NetState) -> bool:
+    if lp.include:
+        return any(state_meets_rule(r, state) for r in lp.include)
+    if lp.exclude:
+        return not any(state_meets_rule(r, state) for r in lp.exclude)
+    return True
+
+
+def _cos_top_shape(top, batch: int) -> Tuple[int, ...]:
+    """Shape of one CoSData top (cos_data_layer.cpp:10-47 semantics)."""
+    if top.transpose:
+        # time-major (T, B) layout for RNN inputs
+        return (int(top.channels), batch)
+    axes = top.sample_num_axes
+    t = top.type
+    if t in (TopBlobType.ENCODED_IMAGE_WITH_DIM, TopBlobType.ENCODED_IMAGE,
+             TopBlobType.RAW_IMAGE):
+        c = int(top.out_channels or top.channels)
+        h = int(top.out_height or top.height)
+        w = int(top.out_width or top.width)
+        if top.transform_param.crop_size:
+            h = w = int(top.transform_param.crop_size)
+        return (batch, c, h, w)
+    if axes == 1:
+        return (batch, int(top.channels))
+    if axes == 0:
+        return (batch,)
+    return (batch, int(top.channels), int(top.height), int(top.width))
+
+
+def data_layer_input_specs(lp: LayerParameter) -> List[Tuple[str, Tuple[int, ...], str]]:
+    """(blob_name, shape, kind) for each top of a data layer.
+    kind ∈ {'data','label','int'} guides dtype selection downstream."""
+    t = lp.type
+    if t == "MemoryData":
+        p = lp.memory_data_param
+        b = int(p.batch_size)
+        shape = (b, int(p.channels), int(p.height), int(p.width))
+        if lp.transform_param.crop_size:
+            cs = int(lp.transform_param.crop_size)
+            shape = (b, int(p.channels), cs, cs)
+        specs = [(lp.top[0], shape, "data")]
+        if len(lp.top) > 1:
+            specs.append((lp.top[1], (b,), "label"))
+        return specs
+    if t == "CoSData":
+        p = lp.cos_data_param
+        b = int(p.batch_size)
+        # transpose tops are time-major (T, B): batch axis is 1
+        return [(top.name, _cos_top_shape(top, b),
+                 ("int" if top.type in (TopBlobType.INT,
+                                        TopBlobType.INT_ARRAY) else "data")
+                 + (":T" if top.transpose else ""))
+                for top in p.top]
+    if t == "Input":
+        return [(name, tuple(int(d) for d in shp.dim), "data")
+                for name, shp in zip(lp.top, lp.input_param.shape)]
+    if t == "Data":
+        p = lp.data_param
+        b = int(p.batch_size)
+        cs = int(p.crop_size or lp.transform_param.crop_size or 0)
+        # channels/size unknown until records arrive; caller overrides
+        shape = (b, 3, cs or 1, cs or 1)
+        specs = [(lp.top[0], shape, "data")]
+        if len(lp.top) > 1:
+            specs.append((lp.top[1], (b,), "label"))
+        return specs
+    if t == "DummyData":
+        p = lp.dummy_data_param
+        out = []
+        for i, name in enumerate(lp.top):
+            if p.shape:
+                shp = p.shape[min(i, len(p.shape) - 1)]
+                out.append((name, tuple(int(d) for d in shp.dim), "data"))
+            else:
+                idx = min(i, len(p.num) - 1) if p.num else 0
+                out.append((name, (int(p.num[idx]), int(p.channels[idx]),
+                                   int(p.height[idx]), int(p.width[idx])),
+                            "data"))
+        return out
+    raise NotImplementedError(f"data layer {t}")
+
+
+class Net:
+    """A compiled, phase-filtered network."""
+
+    def __init__(self, net_param: NetParameter, state: Optional[NetState] = None,
+                 input_shapes: Optional[Dict[str, Sequence[int]]] = None,
+                 dtype=jnp.float32):
+        self.net_param = net_param
+        self.state = state or NetState(phase=Phase.TRAIN)
+        self.name = net_param.name
+        self.dtype = dtype
+
+        self.layers: List[LayerParameter] = [
+            lp for lp in net_param.layer if layer_included(lp, self.state)]
+
+        # --- resolve net inputs ------------------------------------------
+        self.input_specs: List[Tuple[str, Tuple[int, ...], str]] = []
+        self.data_layers: List[LayerParameter] = []
+        # legacy net-level inputs (deploy prototxts)
+        if net_param.input:
+            for i, name in enumerate(net_param.input):
+                if net_param.input_shape:
+                    shp = tuple(int(d)
+                                for d in net_param.input_shape[i].dim)
+                else:
+                    shp = tuple(int(d)
+                                for d in net_param.input_dim[4 * i:4 * i + 4])
+                self.input_specs.append((name, shp, "data"))
+        for lp in self.layers:
+            if L.get_op(lp.type).is_data:
+                self.data_layers.append(lp)
+                specs = data_layer_input_specs(lp)
+                if input_shapes:
+                    specs = [(n, tuple(input_shapes.get(n, s)), k)
+                             for (n, s, k) in specs]
+                self.input_specs.extend(specs)
+        self.compute_layers = [lp for lp in self.layers
+                               if not L.get_op(lp.type).is_data]
+
+        # --- shape inference + param spec construction -------------------
+        blob_shapes: Dict[str, Tuple[int, ...]] = {
+            name: tuple(shape) for name, shape, _ in self.input_specs}
+        self.param_layout: Dict[str, List[Tuple[str, Tuple[int, ...], object]]] = {}
+        self._top_shapes: Dict[str, Dict[str, Tuple[int, ...]]] = {}
+        for lp in self.compute_layers:
+            op = L.get_op(lp.type)
+            for b in lp.bottom:
+                if b not in blob_shapes:
+                    raise ValueError(
+                        f"layer {lp.name!r} ({lp.type}) consumes unknown "
+                        f"blob {b!r}; produced so far: "
+                        f"{sorted(blob_shapes)}")
+            bshapes = [blob_shapes[b] for b in lp.bottom]
+            specs = [(n, tuple(int(x) for x in s), f)
+                     for (n, s, f) in op.param_specs(lp, bshapes)]
+            if specs:
+                self.param_layout[lp.name] = specs
+            # abstract evaluation for top shapes
+            dummy_params = [jax.ShapeDtypeStruct(s, dtype)
+                            for (_, s, _) in specs]
+            dummy_bottoms = [jax.ShapeDtypeStruct(s, dtype) for s in bshapes]
+            ctx = L.Ctx(train=self.state.phase == Phase.TRAIN,
+                        rng=jax.random.key(0), layer_name=lp.name)
+            tops = jax.eval_shape(
+                lambda p, b, lp=lp, op=op, ctx=ctx: op.apply(ctx, lp, p, b),
+                dummy_params, dummy_bottoms)
+            shaped = {}
+            for name, tshape in zip(lp.top, tops):
+                blob_shapes[name] = tuple(tshape.shape)
+                shaped[name] = tuple(tshape.shape)
+            self._top_shapes[lp.name] = shaped
+        self.blob_shapes = blob_shapes
+
+        # --- net outputs: tops never consumed ----------------------------
+        consumed = {b for lp in self.compute_layers for b in lp.bottom}
+        produced: List[str] = [n for n, _, _ in self.input_specs]
+        for lp in self.compute_layers:
+            for t in lp.top:
+                if t not in produced:
+                    produced.append(t)
+        # in-place layers re-produce their bottom; a blob is an output if no
+        # layer consumes it — approximate Caffe: top not in consumed
+        self.output_blobs = [n for n in produced if n not in consumed]
+        # loss weights per top
+        self.loss_weights: Dict[str, float] = {}
+        for lp in self.compute_layers:
+            op = L.get_op(lp.type)
+            for i, t in enumerate(lp.top):
+                if i < len(lp.loss_weight):
+                    w = float(lp.loss_weight[i])
+                elif op.is_loss:
+                    w = 1.0
+                else:
+                    w = 0.0
+                if w:
+                    self.loss_weights[t] = w
+
+    # ------------------------------------------------------------------
+    def init(self, key: Array) -> Params:
+        """Initialize all learnable blobs (filler semantics)."""
+        from .ops.fillers import fill
+        from .ops.layers import stable_hash
+        params: Params = {}
+        for lname, specs in self.param_layout.items():
+            lkey = jax.random.fold_in(key, stable_hash(lname))
+            blobs = {}
+            for i, (bname, shape, filler) in enumerate(specs):
+                blobs[bname] = fill(jax.random.fold_in(lkey, i), filler,
+                                    shape, self.dtype)
+            params[lname] = blobs
+        return params
+
+    def input_names(self) -> List[str]:
+        return [n for n, _, _ in self.input_specs]
+
+    def make_dummy_inputs(self, batch_override: Optional[int] = None
+                          ) -> Dict[str, Array]:
+        out = {}
+        for name, shape, kind in self.input_specs:
+            if batch_override is not None:
+                # time-major (":T") tops carry batch on axis 1, not 0
+                ax = 1 if kind.endswith(":T") else 0
+                shape = tuple(batch_override if i == ax else d
+                              for i, d in enumerate(shape))
+            out[name] = jnp.zeros(shape, self.dtype)
+        return out
+
+    # ------------------------------------------------------------------
+    def apply(self, params: Params, inputs: Dict[str, Array], *,
+              train: Optional[bool] = None, rng: Optional[Array] = None,
+              net_state: Optional[Dict] = None
+              ) -> Tuple[Dict[str, Array], Dict]:
+        """Forward pass. Returns (all blobs, new mutable state)."""
+        if train is None:
+            train = self.state.phase == Phase.TRAIN
+        blobs: Dict[str, Array] = dict(inputs)
+        ctx = L.Ctx(train=train, rng=rng,
+                    state_in=net_state or {}, state_out={})
+        for lp in self.compute_layers:
+            op = L.get_op(lp.type)
+            ctx.layer_name = lp.name
+            lparams = []
+            if lp.name in self.param_layout:
+                pd = params[lp.name]
+                lparams = [pd[bname]
+                           for bname, _, _ in self.param_layout[lp.name]]
+            bottoms = [blobs[b] for b in lp.bottom]
+            tops = op.apply(ctx, lp, lparams, bottoms)
+            for name, val in zip(lp.top, tops):
+                blobs[name] = val
+        return blobs, ctx.state_out
+
+    def loss(self, params: Params, inputs: Dict[str, Array], *,
+             train: bool = True, rng: Optional[Array] = None,
+             net_state: Optional[Dict] = None
+             ) -> Tuple[Array, Tuple[Dict[str, Array], Dict]]:
+        """Total weighted loss (for jax.value_and_grad(has_aux=True))."""
+        blobs, new_state = self.apply(params, inputs, train=train, rng=rng,
+                                      net_state=net_state)
+        total = jnp.zeros((), self.dtype)
+        for name, w in self.loss_weights.items():
+            total = total + w * jnp.sum(blobs[name])
+        return total, (blobs, new_state)
+
+    def num_params(self, params: Optional[Params] = None) -> int:
+        if params is not None:
+            return sum(int(x.size) for lb in params.values()
+                       for x in lb.values())
+        return sum(math.prod(s) for specs in self.param_layout.values()
+                   for (_, s, _) in specs)

@@ -1,0 +1,4 @@
+"""Layer forward functions, fillers, and Pallas kernels."""
+
+from . import fillers, layers
+from .layers import get_op, supported_types

@@ -1,0 +1,940 @@
+"""Caffe layer semantics as pure JAX functions (TPU-first).
+
+Each layer type registers:
+  * ``param_specs(lp, bottom_shapes)`` → list of (blob_name, shape, filler)
+    for its learnable blobs (order == Caffe blob order, so `.caffemodel`
+    import/export maps 1:1), and
+  * ``apply(ctx, lp, params, bottoms)`` → list of top arrays.
+
+Layout is Caffe-logical NCHW at layer boundaries; XLA's TPU layout
+assignment maps convs/matmuls onto the MXU, so no manual NHWC plumbing is
+needed for correctness, and compute-heavy paths stay fused under one jit.
+
+Caffe behaviors reproduced (the "hard parts" of SURVEY.md §7):
+  * pooling ceil-mode output sizing with tail-window clipping,
+  * AVE pooling divisor = window ∩ padded region (not kernel area),
+  * LRN ACROSS_CHANNELS uses alpha/local_size,
+  * SoftmaxWithLoss VALID normalization + ignore_label,
+  * Dropout inverted scaling at train time,
+  * LSTM cont-gated recurrence (gate order i,f,o,g), time-major (T,B,·).
+
+Reference equivalents: caffe-public layer implementations consumed via
+`CaffeNet.cpp` (see SURVEY.md §2.5, §2.9 layer list).
+"""
+
+from __future__ import annotations
+
+import math
+import zlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..proto.caffe import (EltwiseOp, FillerParameter, LayerParameter,
+                           NormalizationMode, NormRegion, PoolMethod)
+
+Array = jax.Array
+
+
+@dataclass
+class Ctx:
+    """Per-call context threaded through layer application."""
+    train: bool = False
+    rng: Optional[Array] = None          # folded per-layer inside Net.apply
+    state_in: Dict[str, List[Array]] = field(default_factory=dict)
+    state_out: Dict[str, List[Array]] = field(default_factory=dict)
+    layer_name: str = ""
+
+    def take_rng(self) -> Array:
+        assert self.rng is not None, "layer needs rng but none provided"
+        return jax.random.fold_in(self.rng, stable_hash(self.layer_name))
+
+
+def stable_hash(name: str) -> int:
+    """Process-independent name hash (Python's hash() is randomized per
+    interpreter, which would break random_seed reproducibility)."""
+    return zlib.crc32(name.encode("utf-8"))
+
+
+_REGISTRY: Dict[str, "LayerOp"] = {}
+
+
+@dataclass
+class LayerOp:
+    name: str
+    apply: Callable
+    param_specs: Callable = lambda lp, shapes: []
+    is_loss: bool = False
+    is_data: bool = False
+
+
+def register(name: str, *, params=None, is_loss=False, is_data=False):
+    def deco(fn):
+        _REGISTRY[name] = LayerOp(name, fn, params or (lambda lp, s: []),
+                                  is_loss=is_loss, is_data=is_data)
+        return fn
+    return deco
+
+
+def get_op(type_name: str) -> LayerOp:
+    if type_name not in _REGISTRY:
+        raise NotImplementedError(f"layer type {type_name!r} not supported")
+    return _REGISTRY[type_name]
+
+
+def supported_types() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def _filler(msg, default_type="constant") -> FillerParameter:
+    if isinstance(msg, FillerParameter):
+        return msg
+    return FillerParameter(type=default_type)
+
+
+# ---------------------------------------------------------------------------
+# data layers — net inputs; shapes resolved by the net compiler
+# ---------------------------------------------------------------------------
+
+@register("MemoryData", is_data=True)
+def _memory_data(ctx, lp, params, bottoms):
+    raise RuntimeError("data layers are net inputs; never applied")
+
+
+@register("CoSData", is_data=True)
+def _cos_data(ctx, lp, params, bottoms):
+    raise RuntimeError("data layers are net inputs; never applied")
+
+
+@register("Input", is_data=True)
+def _input(ctx, lp, params, bottoms):
+    raise RuntimeError("data layers are net inputs; never applied")
+
+
+@register("Data", is_data=True)
+def _db_data(ctx, lp, params, bottoms):
+    raise RuntimeError("data layers are net inputs; never applied")
+
+
+@register("HDF5Data", is_data=True)
+def _hdf5_data(ctx, lp, params, bottoms):
+    raise RuntimeError("data layers are net inputs; never applied")
+
+
+@register("DummyData", is_data=True)
+def _dummy_data(ctx, lp, params, bottoms):
+    raise RuntimeError("data layers are net inputs; never applied")
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution / InnerProduct / Embed
+# ---------------------------------------------------------------------------
+
+def _conv_geometry(cp):
+    def pair(rep, h, w, default):
+        if cp.has(h) or cp.has(w):
+            if not (cp.has(h) and cp.has(w)):
+                raise ValueError(f"{h} and {w} must be set together")
+            return (int(getattr(cp, h)), int(getattr(cp, w)))
+        v = getattr(cp, rep)
+        if isinstance(v, list):
+            if len(v) == 0:
+                return (default, default)
+            if len(v) == 1:
+                return (int(v[0]), int(v[0]))
+            return (int(v[0]), int(v[1]))
+        return (int(v), int(v))
+
+    kernel = pair("kernel_size", "kernel_h", "kernel_w", None)
+    if kernel[0] is None:
+        raise ValueError("convolution_param needs kernel_size or "
+                         "kernel_h/kernel_w")
+    stride = pair("stride", "stride_h", "stride_w", 1)
+    pad = pair("pad", "pad_h", "pad_w", 0)
+    dil = cp.dilation
+    dilation = ((int(dil[0]), int(dil[-1] if len(dil) > 1 else dil[0]))
+                if dil else (1, 1))
+    return kernel, stride, pad, dilation
+
+
+def _conv_params(lp, shapes):
+    cp = lp.convolution_param
+    (kh, kw), _, _, _ = _conv_geometry(cp)
+    c_in = shapes[0][1]
+    group = max(1, cp.group)
+    specs = [("weight", (cp.num_output, c_in // group, kh, kw),
+              _filler(cp.weight_filler if lp.convolution_param.has(
+                  "weight_filler") else None))]
+    if cp.bias_term:
+        specs.append(("bias", (cp.num_output,),
+                      _filler(cp.bias_filler if cp.has("bias_filler")
+                              else None)))
+    return specs
+
+
+@register("Convolution", params=_conv_params)
+def _conv(ctx, lp, params, bottoms):
+    cp = lp.convolution_param
+    (kh, kw), (sh, sw), (ph, pw), (dh, dw) = _conv_geometry(cp)
+    x = bottoms[0]
+    w = params[0]
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(sh, sw), padding=[(ph, ph), (pw, pw)],
+        rhs_dilation=(dh, dw), feature_group_count=max(1, cp.group),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32)
+    if cp.bias_term:
+        out = out + params[1].reshape(1, -1, 1, 1)
+    return [out]
+
+
+def _deconv_params(lp, shapes):
+    cp = lp.convolution_param
+    (kh, kw), _, _, _ = _conv_geometry(cp)
+    c_in = shapes[0][1]
+    group = max(1, cp.group)
+    # Caffe Deconvolution weight blob: (C_in, N/group, kh, kw)
+    specs = [("weight", (c_in, cp.num_output // group, kh, kw),
+              _filler(cp.weight_filler if cp.has("weight_filler") else None))]
+    if cp.bias_term:
+        specs.append(("bias", (cp.num_output,),
+                      _filler(cp.bias_filler if cp.has("bias_filler")
+                              else None)))
+    return specs
+
+
+@register("Deconvolution", params=_deconv_params)
+def _deconv(ctx, lp, params, bottoms):
+    """Caffe deconv = gradient of conv wrt its input: output size
+    s·(i−1) + k − 2p.  Expressed as an input-dilated convolution with a
+    spatially flipped kernel and per-side padding (k−1−p), which XLA maps
+    onto the MXU like any conv."""
+    cp = lp.convolution_param
+    (kh, kw), (sh, sw), (ph, pw), (dh, dw) = _conv_geometry(cp)
+    x = bottoms[0]
+    w = params[0]  # (C_in, C_out/g, kh, kw)
+    g = max(1, cp.group)
+    c_in = w.shape[0]
+    c_out = w.shape[1] * g
+    # (C_in, C_out/g, kh, kw) → (C_out, C_in/g, kh, kw), spatially flipped
+    wk = w.reshape(g, c_in // g, c_out // g, kh, kw)
+    wk = wk.transpose(0, 2, 1, 3, 4).reshape(c_out, c_in // g, kh, kw)
+    wk = wk[:, :, ::-1, ::-1]
+    ekh = (kh - 1) * dh + 1  # effective (dilated) kernel extent
+    ekw = (kw - 1) * dw + 1
+    out = lax.conv_general_dilated(
+        x, wk, window_strides=(1, 1),
+        padding=[(ekh - 1 - ph, ekh - 1 - ph), (ekw - 1 - pw, ekw - 1 - pw)],
+        lhs_dilation=(sh, sw), rhs_dilation=(dh, dw),
+        feature_group_count=g,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32)
+    if cp.bias_term:
+        out = out + params[1].reshape(1, -1, 1, 1)
+    return [out]
+
+
+def _ip_params(lp, shapes):
+    ip = lp.inner_product_param
+    axis = ip.axis
+    k = math.prod(shapes[0][axis:])
+    shape = (k, ip.num_output) if ip.transpose else (ip.num_output, k)
+    specs = [("weight", shape,
+              _filler(ip.weight_filler if ip.has("weight_filler") else None))]
+    if ip.bias_term:
+        specs.append(("bias", (ip.num_output,),
+                      _filler(ip.bias_filler if ip.has("bias_filler")
+                              else None)))
+    return specs
+
+
+@register("InnerProduct", params=_ip_params)
+def _inner_product(ctx, lp, params, bottoms):
+    ip = lp.inner_product_param
+    axis = ip.axis
+    x = bottoms[0]
+    lead = x.shape[:axis]
+    x2 = x.reshape((math.prod(lead), -1))
+    w = params[0]
+    y = x2 @ w if ip.transpose else x2 @ w.T
+    if ip.bias_term:
+        y = y + params[1]
+    return [y.reshape(lead + (ip.num_output,))]
+
+
+def _embed_params(lp, shapes):
+    ep = lp.embed_param
+    specs = [("weight", (ep.input_dim, ep.num_output),
+              _filler(ep.weight_filler if ep.has("weight_filler") else None))]
+    if ep.bias_term:
+        specs.append(("bias", (ep.num_output,),
+                      _filler(ep.bias_filler if ep.has("bias_filler")
+                              else None)))
+    return specs
+
+
+@register("Embed", params=_embed_params)
+def _embed(ctx, lp, params, bottoms):
+    ep = lp.embed_param
+    idx = bottoms[0].astype(jnp.int32)
+    out = jnp.take(params[0], idx, axis=0)
+    if ep.bias_term:
+        out = out + params[1]
+    return [out]
+
+
+# ---------------------------------------------------------------------------
+# Pooling (Caffe ceil-mode + divisor semantics)
+# ---------------------------------------------------------------------------
+
+def pool_output_dim(size: int, kernel: int, stride: int, pad: int) -> int:
+    out = int(math.ceil((size + 2 * pad - kernel) / stride)) + 1
+    if pad > 0 and (out - 1) * stride >= size + pad:
+        out -= 1
+    return out
+
+
+@register("Pooling")
+def _pooling(ctx, lp, params, bottoms):
+    pp = lp.pooling_param
+    x = bottoms[0]
+    n, c, h, w = x.shape
+    if pp.global_pooling:
+        kh, kw = h, w
+        sh = sw = 1
+        ph = pw = 0
+    else:
+        for a, b in (("kernel_h", "kernel_w"), ("stride_h", "stride_w"),
+                     ("pad_h", "pad_w")):
+            if pp.has(a) != pp.has(b):
+                raise ValueError(f"pooling_param: {a} and {b} must be set "
+                                 "together")
+        kh = int(pp.kernel_h) if pp.has("kernel_h") else int(pp.kernel_size)
+        kw = int(pp.kernel_w) if pp.has("kernel_w") else int(pp.kernel_size)
+        if kh == 0 or kw == 0:
+            raise ValueError("pooling_param needs kernel_size or "
+                             "kernel_h/kernel_w")
+        sh = int(pp.stride_h) if pp.has("stride_h") else int(pp.stride)
+        sw = int(pp.stride_w) if pp.has("stride_w") else int(pp.stride)
+        ph = int(pp.pad_h) if pp.has("pad_h") else int(pp.pad)
+        pw = int(pp.pad_w) if pp.has("pad_w") else int(pp.pad)
+    oh = pool_output_dim(h, kh, sh, ph)
+    ow = pool_output_dim(w, kw, sw, pw)
+    # explicit asymmetric padding so the ceil-mode tail window exists
+    eh = max(0, (oh - 1) * sh + kh - h - ph)
+    ew = max(0, (ow - 1) * sw + kw - w - pw)
+    if pp.pool == PoolMethod.MAX:
+        xp = jnp.pad(x, ((0, 0), (0, 0), (ph, eh), (pw, ew)),
+                     constant_values=-jnp.inf)
+        out = lax.reduce_window(xp, -jnp.inf, lax.max,
+                                (1, 1, kh, kw), (1, 1, sh, sw), "VALID")
+    elif pp.pool == PoolMethod.AVE:
+        xp = jnp.pad(x, ((0, 0), (0, 0), (ph, eh), (pw, ew)))
+        s = lax.reduce_window(xp, 0.0, lax.add,
+                              (1, 1, kh, kw), (1, 1, sh, sw), "VALID")
+        # Caffe divisor: overlap of each window with the symmetric padded
+        # region [0, size + 2*pad), NOT the raw kernel area
+        ones_h = jnp.ones((1, 1, h + 2 * ph, 1), x.dtype)
+        ones_w = jnp.ones((1, 1, 1, w + 2 * pw), x.dtype)
+        ones_h = jnp.pad(ones_h, ((0, 0), (0, 0), (0, max(0, eh - ph)),
+                                  (0, 0)))
+        ones_w = jnp.pad(ones_w, ((0, 0), (0, 0), (0, 0),
+                                  (0, max(0, ew - pw))))
+        div_h = lax.reduce_window(ones_h, 0.0, lax.add, (1, 1, kh, 1),
+                                  (1, 1, sh, 1), "VALID")
+        div_w = lax.reduce_window(ones_w, 0.0, lax.add, (1, 1, 1, kw),
+                                  (1, 1, 1, sw), "VALID")
+        out = s / (div_h * div_w)
+    else:
+        raise NotImplementedError("STOCHASTIC pooling")
+    return [out]
+
+
+# ---------------------------------------------------------------------------
+# elementwise activations
+# ---------------------------------------------------------------------------
+
+@register("ReLU")
+def _relu(ctx, lp, params, bottoms):
+    slope = lp.relu_param.negative_slope
+    x = bottoms[0]
+    if slope:
+        return [jnp.where(x > 0, x, slope * x)]
+    return [jax.nn.relu(x)]
+
+
+def _prelu_params(lp, shapes):
+    n = 1 if lp.prelu_param.channel_shared else shapes[0][1]
+    f = (lp.prelu_param.filler if lp.prelu_param.has("filler")
+         else FillerParameter(type="constant", value=0.25))
+    return [("slope", (n,), f)]
+
+
+@register("PReLU", params=_prelu_params)
+def _prelu(ctx, lp, params, bottoms):
+    x = bottoms[0]
+    a = params[0].reshape((1, -1) + (1,) * (x.ndim - 2))
+    return [jnp.where(x > 0, x, a * x)]
+
+
+@register("ELU")
+def _elu(ctx, lp, params, bottoms):
+    a = lp.elu_param.alpha
+    x = bottoms[0]
+    return [jnp.where(x > 0, x, a * (jnp.exp(x) - 1.0))]
+
+
+@register("Sigmoid")
+def _sigmoid(ctx, lp, params, bottoms):
+    return [jax.nn.sigmoid(bottoms[0])]
+
+
+@register("TanH")
+def _tanh(ctx, lp, params, bottoms):
+    return [jnp.tanh(bottoms[0])]
+
+
+@register("AbsVal")
+def _absval(ctx, lp, params, bottoms):
+    return [jnp.abs(bottoms[0])]
+
+
+@register("BNLL")
+def _bnll(ctx, lp, params, bottoms):
+    x = bottoms[0]
+    return [jnp.where(x > 0, x + jnp.log1p(jnp.exp(-x)),
+                      jnp.log1p(jnp.exp(x)))]
+
+
+@register("Power")
+def _power(ctx, lp, params, bottoms):
+    p = lp.power_param
+    y = p.shift + p.scale * bottoms[0]
+    if p.power != 1.0:
+        y = jnp.power(y, p.power)
+    return [y]
+
+
+@register("Exp")
+def _exp(ctx, lp, params, bottoms):
+    p = lp.exp_param
+    x = p.shift + p.scale * bottoms[0]
+    if p.base > 0:
+        return [jnp.power(p.base, x)]
+    return [jnp.exp(x)]
+
+
+@register("Log")
+def _log(ctx, lp, params, bottoms):
+    p = lp.log_param
+    x = p.shift + p.scale * bottoms[0]
+    y = jnp.log(x)
+    if p.base > 0:
+        y = y / math.log(p.base)
+    return [y]
+
+
+@register("Threshold")
+def _threshold(ctx, lp, params, bottoms):
+    t = lp.threshold_param.threshold
+    return [(bottoms[0] > t).astype(bottoms[0].dtype)]
+
+
+@register("Dropout")
+def _dropout(ctx, lp, params, bottoms):
+    ratio = lp.dropout_param.dropout_ratio
+    x = bottoms[0]
+    if not ctx.train or ratio == 0.0:
+        return [x]
+    keep = 1.0 - ratio
+    mask = jax.random.bernoulli(ctx.take_rng(), keep, x.shape)
+    return [jnp.where(mask, x / keep, 0.0)]
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+@register("LRN")
+def _lrn(ctx, lp, params, bottoms):
+    p = lp.lrn_param
+    x = bottoms[0]
+    n = int(p.local_size)
+    alpha, beta, k = p.alpha, p.beta, p.k
+    if p.norm_region == NormRegion.ACROSS_CHANNELS:
+        sq = x * x
+        pad = n // 2
+        sqp = jnp.pad(sq, ((0, 0), (pad, pad), (0, 0), (0, 0)))
+        s = lax.reduce_window(sqp, 0.0, lax.add, (1, n, 1, 1),
+                              (1, 1, 1, 1), "VALID")
+        scale = k + (alpha / n) * s
+    else:  # WITHIN_CHANNEL: spatial window average of squares
+        sq = x * x
+        pad = n // 2
+        sqp = jnp.pad(sq, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        s = lax.reduce_window(sqp, 0.0, lax.add, (1, 1, n, n),
+                              (1, 1, 1, 1), "VALID")
+        scale = k + (alpha / (n * n)) * s
+    return [x / jnp.power(scale, beta)]
+
+
+@register("MVN")
+def _mvn(ctx, lp, params, bottoms):
+    p = lp.mvn_param
+    x = bottoms[0]
+    axes = (1, 2, 3) if p.across_channels else (2, 3)
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    y = x - mean
+    if p.normalize_variance:
+        var = jnp.mean(y * y, axis=axes, keepdims=True)
+        y = y / (jnp.sqrt(var) + p.eps)
+    return [y]
+
+
+def _bn_params(lp, shapes):
+    c = shapes[0][1]
+    zero = FillerParameter(type="constant", value=0.0)
+    return [("mean", (c,), zero), ("variance", (c,), zero),
+            ("count", (1,), zero)]
+
+
+@register("BatchNorm", params=_bn_params)
+def _batch_norm(ctx, lp, params, bottoms):
+    p = lp.batch_norm_param
+    x = bottoms[0]
+    eps = p.eps
+    use_global = (p.use_global_stats if p.has("use_global_stats")
+                  else not ctx.train)
+    mean_b, var_b, count = params
+    if use_global:
+        scale = jnp.where(count[0] == 0, 1.0, 1.0 / count[0])
+        mean = mean_b * scale
+        var = var_b * scale
+    else:
+        axes = (0,) + tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+        m = p.moving_average_fraction
+        ctx.state_out[ctx.layer_name] = [
+            mean_b * m + mean, var_b * m + var, count * m + 1.0]
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return [(x - mean.reshape(shape))
+            / jnp.sqrt(var.reshape(shape) + eps)]
+
+
+def _scale_params(lp, shapes):
+    p = lp.scale_param
+    if len(shapes) > 1:
+        # two-bottom Scale: the multiplier IS bottom[1]; only an optional
+        # bias blob is learnable (its shape follows bottom[1])
+        if not p.bias_term:
+            return []
+        bf = (p.bias_filler if p.has("bias_filler")
+              else FillerParameter(type="constant", value=0.0))
+        return [("bias", tuple(shapes[1]), bf)]
+    axis = p.axis if p.axis >= 0 else len(shapes[0]) + p.axis
+    num_axes = p.num_axes
+    if num_axes == -1:
+        shape = shapes[0][axis:]
+    else:
+        shape = shapes[0][axis:axis + num_axes]
+    f = p.filler if p.has("filler") else FillerParameter(type="constant",
+                                                        value=1.0)
+    specs = [("scale", tuple(shape), f)]
+    if p.bias_term:
+        bf = (p.bias_filler if p.has("bias_filler")
+              else FillerParameter(type="constant", value=0.0))
+        specs.append(("bias", tuple(shape), bf))
+    return specs
+
+
+@register("Scale", params=_scale_params)
+def _scale(ctx, lp, params, bottoms):
+    p = lp.scale_param
+    x = bottoms[0]
+    g = bottoms[1] if len(bottoms) > 1 else params[0]
+    bias = None
+    if p.bias_term:
+        bias = params[0] if len(bottoms) > 1 else params[1]
+    axis = p.axis if p.axis >= 0 else x.ndim + p.axis
+    shape = [1] * x.ndim
+    for i, d in enumerate(g.shape):
+        shape[axis + i] = d
+    y = x * g.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return [y]
+
+
+def _bias_params(lp, shapes):
+    p = lp.bias_param
+    axis = p.axis if p.axis >= 0 else len(shapes[0]) + p.axis
+    if p.num_axes == -1:
+        shape = shapes[0][axis:]
+    else:
+        shape = shapes[0][axis:axis + p.num_axes]
+    f = p.filler if p.has("filler") else FillerParameter(type="constant")
+    return [("bias", tuple(shape), f)]
+
+
+@register("Bias", params=_bias_params)
+def _bias(ctx, lp, params, bottoms):
+    p = lp.bias_param
+    x = bottoms[0]
+    b = bottoms[1] if len(bottoms) > 1 else params[0]
+    axis = p.axis if p.axis >= 0 else x.ndim + p.axis
+    shape = [1] * x.ndim
+    for i, d in enumerate(b.shape):
+        shape[axis + i] = d
+    return [x + b.reshape(shape)]
+
+
+# ---------------------------------------------------------------------------
+# shape ops
+# ---------------------------------------------------------------------------
+
+@register("Flatten")
+def _flatten(ctx, lp, params, bottoms):
+    p = lp.flatten_param
+    x = bottoms[0]
+    axis = p.axis if p.axis >= 0 else x.ndim + p.axis
+    end = p.end_axis if p.end_axis >= 0 else x.ndim + p.end_axis
+    shape = x.shape[:axis] + (-1,) + x.shape[end + 1:]
+    return [x.reshape(shape)]
+
+
+@register("Reshape")
+def _reshape(ctx, lp, params, bottoms):
+    p = lp.reshape_param
+    x = bottoms[0]
+    dims = list(p.shape.dim)
+    axis = p.axis if p.axis >= 0 else x.ndim + p.axis
+    num_axes = p.num_axes
+    end = x.ndim if num_axes == -1 else axis + num_axes
+    mid = []
+    for i, d in enumerate(dims):
+        if d == 0:
+            mid.append(x.shape[axis + i])
+        else:
+            mid.append(int(d))
+    shape = list(x.shape[:axis]) + mid + list(x.shape[end:])
+    return [x.reshape(shape)]
+
+
+@register("Concat")
+def _concat(ctx, lp, params, bottoms):
+    p = lp.concat_param
+    axis = p.axis if p.has("axis") or not p.has("concat_dim") \
+        else int(p.concat_dim)
+    return [jnp.concatenate(bottoms, axis=axis)]
+
+
+@register("Slice")
+def _slice(ctx, lp, params, bottoms):
+    p = lp.slice_param
+    x = bottoms[0]
+    axis = p.axis
+    n_top = len(lp.top)
+    if p.slice_point:
+        points = [0] + [int(q) for q in p.slice_point] + [x.shape[axis]]
+    else:
+        if x.shape[axis] % n_top != 0:
+            raise ValueError(
+                f"Slice: axis size {x.shape[axis]} not divisible by "
+                f"{n_top} tops (set slice_point explicitly)")
+        step = x.shape[axis] // n_top
+        points = [i * step for i in range(n_top + 1)]
+    return [lax.slice_in_dim(x, points[i], points[i + 1], axis=axis)
+            for i in range(n_top)]
+
+
+@register("Eltwise")
+def _eltwise(ctx, lp, params, bottoms):
+    p = lp.eltwise_param
+    op = p.operation
+    if op == EltwiseOp.PROD:
+        y = bottoms[0]
+        for b in bottoms[1:]:
+            y = y * b
+    elif op == EltwiseOp.SUM:
+        coeffs = p.coeff if p.coeff else [1.0] * len(bottoms)
+        y = coeffs[0] * bottoms[0]
+        for c, b in zip(coeffs[1:], bottoms[1:]):
+            y = y + c * b
+    else:  # MAX
+        y = bottoms[0]
+        for b in bottoms[1:]:
+            y = jnp.maximum(y, b)
+    return [y]
+
+
+@register("Tile")
+def _tile(ctx, lp, params, bottoms):
+    p = lp.tile_param
+    x = bottoms[0]
+    reps = [1] * x.ndim
+    reps[p.axis] = int(p.tiles)
+    return [jnp.tile(x, reps)]
+
+
+@register("Reduction")
+def _reduction(ctx, lp, params, bottoms):
+    p = lp.reduction_param
+    x = bottoms[0]
+    axis = p.axis if p.axis >= 0 else x.ndim + p.axis
+    flat = x.reshape(x.shape[:axis] + (-1,))
+    op = p.operation
+    if op == 1:
+        y = jnp.sum(flat, axis=-1)
+    elif op == 2:
+        y = jnp.sum(jnp.abs(flat), axis=-1)
+    elif op == 3:
+        y = jnp.sum(flat * flat, axis=-1)
+    else:
+        y = jnp.mean(flat, axis=-1)
+    return [p.coeff * y]
+
+
+@register("Crop")
+def _crop(ctx, lp, params, bottoms):
+    p = lp.crop_param
+    x, ref = bottoms
+    axis = p.axis if p.axis >= 0 else x.ndim + p.axis
+    offsets = list(p.offset) or [0]
+    starts = [0] * x.ndim
+    sizes = list(x.shape)
+    for i in range(axis, x.ndim):
+        off = offsets[i - axis] if i - axis < len(offsets) else offsets[-1]
+        starts[i] = off
+        sizes[i] = ref.shape[i]
+    return [lax.dynamic_slice(x, starts, sizes)]
+
+
+@register("Split")
+def _split(ctx, lp, params, bottoms):
+    return [bottoms[0] for _ in lp.top]
+
+
+@register("Silence")
+def _silence(ctx, lp, params, bottoms):
+    return []
+
+
+@register("ArgMax")
+def _argmax(ctx, lp, params, bottoms):
+    p = lp.argmax_param
+    x = bottoms[0]
+    if p.has("axis"):
+        idx = jnp.argmax(x, axis=p.axis).astype(jnp.float32)
+        return [idx]
+    flat = x.reshape(x.shape[0], -1)
+    k = int(p.top_k)
+    vals, idxs = lax.top_k(flat, k)
+    if p.out_max_val:
+        return [jnp.stack([idxs.astype(jnp.float32), vals],
+                          axis=1).reshape(x.shape[0], 2, k, 1)]
+    return [idxs.astype(jnp.float32).reshape(x.shape[0], 1, k, 1)]
+
+
+# ---------------------------------------------------------------------------
+# softmax / losses / metrics
+# ---------------------------------------------------------------------------
+
+@register("Softmax")
+def _softmax(ctx, lp, params, bottoms):
+    axis = lp.softmax_param.axis
+    return [jax.nn.softmax(bottoms[0], axis=axis)]
+
+
+def _loss_normalizer(norm_mode, valid_count, batch, full):
+    if norm_mode == NormalizationMode.FULL:
+        return full
+    if norm_mode == NormalizationMode.BATCH_SIZE:
+        return batch
+    if norm_mode == NormalizationMode.NONE:
+        return 1.0
+    return jnp.maximum(valid_count, 1.0)  # VALID
+
+
+@register("SoftmaxWithLoss", is_loss=True)
+def _softmax_loss(ctx, lp, params, bottoms):
+    axis = lp.softmax_param.axis if lp.has("softmax_param") else 1
+    scores, labels = bottoms[0], bottoms[1]
+    logp = jax.nn.log_softmax(scores, axis=axis)
+    lbl = labels.astype(jnp.int32)
+    # reshape labels to scores-without-class-axis
+    outer = scores.shape[:axis]
+    inner = scores.shape[axis + 1:]
+    lbl = lbl.reshape(outer + inner)
+    lp_msg = lp.loss_param
+    has_ignore = lp.has("loss_param") and lp_msg.has("ignore_label")
+    ignore = lp_msg.ignore_label if has_ignore else -1
+    safe_lbl = jnp.where(lbl == ignore, 0, lbl) if has_ignore else lbl
+    picked = jnp.take_along_axis(
+        logp, jnp.expand_dims(safe_lbl, axis), axis=axis)
+    nll = -jnp.squeeze(picked, axis)
+    if has_ignore:
+        mask = (lbl != ignore).astype(scores.dtype)
+        nll = nll * mask
+        valid = jnp.sum(mask)
+    else:
+        valid = float(math.prod(outer + inner))
+    # legacy loss_param.normalize: true → VALID, false → BATCH_SIZE
+    # (only consulted when 'normalization' itself is unset)
+    if lp.has("loss_param") and not lp_msg.has("normalization") \
+            and lp_msg.has("normalize"):
+        norm_mode = (NormalizationMode.VALID if lp_msg.normalize
+                     else NormalizationMode.BATCH_SIZE)
+    elif lp.has("loss_param"):
+        norm_mode = lp_msg.normalization
+    else:
+        norm_mode = NormalizationMode.VALID
+    denom = _loss_normalizer(norm_mode, valid, scores.shape[0],
+                             math.prod(outer + inner))
+    return [jnp.sum(nll) / denom]
+
+
+@register("EuclideanLoss", is_loss=True)
+def _euclidean_loss(ctx, lp, params, bottoms):
+    a, b = bottoms[0], bottoms[1]
+    diff = a - b
+    return [jnp.sum(diff * diff) / (2.0 * a.shape[0])]
+
+
+@register("SigmoidCrossEntropyLoss", is_loss=True)
+def _sce_loss(ctx, lp, params, bottoms):
+    x, t = bottoms[0], bottoms[1]
+    # stable: max(x,0) - x*t + log(1+exp(-|x|))
+    loss = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return [jnp.sum(loss) / x.shape[0]]
+
+
+@register("HingeLoss", is_loss=True)
+def _hinge_loss(ctx, lp, params, bottoms):
+    x, y = bottoms[0], bottoms[1]
+    n = x.shape[0]
+    lbl = y.astype(jnp.int32).reshape(n)
+    sign = jnp.ones_like(x).at[jnp.arange(n), lbl].set(-1.0)
+    margin = jnp.maximum(0.0, 1.0 + sign * x)
+    if lp.hinge_loss_param.norm == 2:
+        return [jnp.sum(margin * margin) / n]
+    return [jnp.sum(margin) / n]
+
+
+@register("Accuracy")
+def _accuracy(ctx, lp, params, bottoms):
+    p = lp.accuracy_param
+    axis = p.axis
+    k = int(p.top_k)
+    scores, labels = bottoms[0], bottoms[1]
+    outer = scores.shape[:axis]
+    inner = scores.shape[axis + 1:]
+    lbl = labels.astype(jnp.int32).reshape(outer + inner)
+    has_ignore = lp.has("accuracy_param") and p.has("ignore_label")
+    moved = jnp.moveaxis(scores, axis, -1)
+    if k == 1:
+        correct = (jnp.argmax(moved, axis=-1) == lbl)
+    else:
+        _, topi = lax.top_k(moved, k)
+        correct = jnp.any(topi == lbl[..., None], axis=-1)
+    correct = correct.astype(scores.dtype)
+    if has_ignore:
+        mask = (lbl != p.ignore_label).astype(scores.dtype)
+        return [jnp.sum(correct * mask) / jnp.maximum(jnp.sum(mask), 1.0)]
+    return [jnp.mean(correct)]
+
+
+# ---------------------------------------------------------------------------
+# recurrent layers (time-major (T, B, ·), cont-gated — Caffe RecurrentLayer)
+# ---------------------------------------------------------------------------
+
+def _lstm_params(lp, shapes):
+    rp = lp.recurrent_param
+    n = int(rp.num_output)
+    d = math.prod(shapes[0][2:]) if len(shapes[0]) > 2 else 1
+    wf = _filler(rp.weight_filler if rp.has("weight_filler") else None)
+    bf = _filler(rp.bias_filler if rp.has("bias_filler") else None)
+    specs = [("W_xc", (4 * n, d), wf), ("b_c", (4 * n,), bf),
+             ("W_hc", (4 * n, n), wf)]
+    if len(shapes) > 2:  # static input bottom
+        ds = math.prod(shapes[2][1:])
+        specs.append(("W_xc_static", (4 * n, ds), wf))
+    return specs
+
+
+@register("LSTM", params=_lstm_params)
+def _lstm(ctx, lp, params, bottoms):
+    """Caffe LSTMLayer: x (T,B,D), cont (T,B) in {0,1}; gate order i,f,o,g;
+    cont gates both h_{t-1} and c_{t-1} (sequence restart ⇒ zero state).
+    Time loop is a `lax.scan` — XLA compiles one fused step, the MXU sees
+    a (B,D)x(D,4N) matmul per step; the big x-projection for ALL steps is
+    hoisted out of the scan as one (T*B,D)x(D,4N) matmul."""
+    rp = lp.recurrent_param
+    n = int(rp.num_output)
+    x, cont = bottoms[0], bottoms[1]
+    t_steps, batch = x.shape[0], x.shape[1]
+    xf = x.reshape(t_steps, batch, -1)
+    w_xc, b_c, w_hc = params[0], params[1], params[2]
+    # hoisted input projection: one big MXU matmul over all timesteps
+    xproj = jnp.einsum("tbd,gd->tbg", xf, w_xc) + b_c
+    if len(bottoms) > 2:
+        xproj = xproj + (bottoms[2].reshape(batch, -1) @ params[3].T)
+
+    cont_f = cont.reshape(t_steps, batch, 1).astype(xf.dtype)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xp_t, cont_t = inp
+        h_g = h_prev * cont_t
+        c_g = c_prev * cont_t
+        gates = xp_t + h_g @ w_hc.T
+        i, f, o, g = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        o = jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c_g + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    h0 = jnp.zeros((batch, n), xf.dtype)
+    c0 = jnp.zeros((batch, n), xf.dtype)
+    (_, _), hs = lax.scan(step, (h0, c0), (xproj, cont_f))
+    return [hs]
+
+
+def _rnn_params(lp, shapes):
+    rp = lp.recurrent_param
+    n = int(rp.num_output)
+    d = math.prod(shapes[0][2:]) if len(shapes[0]) > 2 else 1
+    wf = _filler(rp.weight_filler if rp.has("weight_filler") else None)
+    bf = _filler(rp.bias_filler if rp.has("bias_filler") else None)
+    return [("W_xh", (n, d), wf), ("b_h", (n,), bf), ("W_hh", (n, n), wf),
+            ("W_ho", (n, n), wf), ("b_o", (n,), bf)]
+
+
+@register("RNN", params=_rnn_params)
+def _rnn(ctx, lp, params, bottoms):
+    """Caffe RNNLayer: h_t = tanh(W_hh h'_{t-1} + W_xh x_t + b_h);
+    o_t = tanh(W_ho h_t + b_o)."""
+    rp = lp.recurrent_param
+    n = int(rp.num_output)
+    x, cont = bottoms[0], bottoms[1]
+    t_steps, batch = x.shape[0], x.shape[1]
+    xf = x.reshape(t_steps, batch, -1)
+    w_xh, b_h, w_hh, w_ho, b_o = params
+    xproj = jnp.einsum("tbd,nd->tbn", xf, w_xh) + b_h
+    cont_f = cont.reshape(t_steps, batch, 1).astype(xf.dtype)
+
+    def step(h_prev, inp):
+        xp_t, cont_t = inp
+        h = jnp.tanh(xp_t + (h_prev * cont_t) @ w_hh.T)
+        o = jnp.tanh(h @ w_ho.T + b_o)
+        return h, o
+
+    h0 = jnp.zeros((batch, n), xf.dtype)
+    _, os = lax.scan(step, h0, (xproj, cont_f))
+    return [os]

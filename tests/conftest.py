@@ -9,3 +9,13 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon TPU plugin (sitecustomize.py) registers itself at interpreter
+# startup whenever PALLAS_AXON_POOL_IPS is set and force-selects
+# jax_platforms="axon,cpu" — which would make the first backend init dial
+# the TPU tunnel even for CPU-only tests. Registration already happened
+# by the time this conftest runs, so override the config directly; tests
+# then run pure-CPU (fast, deterministic, immune to tunnel state).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,312 @@
+"""Net compiler tests: shape inference, phase filtering, forward pass on
+the reference model zoo configs (LeNet, CIFAR-10 quick, CaffeNet, LRCN)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from caffeonspark_tpu.net import Net, layer_included
+from caffeonspark_tpu.proto import NetParameter, NetState, Phase, read_net
+
+REF_DATA = "/root/reference/data"
+HAS_REF = os.path.isdir(REF_DATA)
+
+
+def test_deconvolution_fcn_upsample():
+    """FCN-style deconv k=4 s=2 p=1 doubles spatial dims; bilinear
+    upsampling of a constant field is constant (grouped, no bias)."""
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx, _deconv_params
+    from caffeonspark_tpu.ops.fillers import fill
+    lp = LayerParameter.from_text(
+        'name: "up" type: "Deconvolution" bottom: "x" top: "y" '
+        'convolution_param { num_output: 2 kernel_size: 4 stride: 2 pad: 1 '
+        'group: 2 bias_term: false weight_filler { type: "bilinear" } }')
+    specs = _deconv_params(lp, [(1, 2, 8, 8)])
+    w = fill(jax.random.key(0), specs[0][2], specs[0][1])
+    y = get_op("Deconvolution").apply(Ctx(), lp, [w],
+                                      [jnp.ones((1, 2, 8, 8))])[0]
+    assert y.shape == (1, 2, 16, 16)
+    assert float(y[0, 0, 8, 8]) == pytest.approx(1.0)
+
+
+def test_scale_two_bottom_bias():
+    """Two-bottom Scale: multiplier is bottom[1]; only bias is learnable."""
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx, _scale_params
+    lp = LayerParameter.from_text(
+        'name: "s" type: "Scale" bottom: "x" bottom: "g" top: "y" '
+        'scale_param { axis: 1 bias_term: true }')
+    specs = _scale_params(lp, [(2, 3, 4, 4), (3,)])
+    assert [s[0] for s in specs] == ["bias"]
+    bias = jnp.array([1.0, 2.0, 3.0])
+    x = jnp.ones((2, 3, 4, 4))
+    g = jnp.array([2.0, 2.0, 2.0])
+    y = get_op("Scale").apply(Ctx(), lp, [bias], [x, g])[0]
+    assert float(y[0, 0, 0, 0]) == pytest.approx(3.0)  # 1*2 + 1
+    assert float(y[0, 2, 0, 0]) == pytest.approx(5.0)  # 1*2 + 3
+
+
+def test_init_deterministic_across_runs():
+    """Same seed → identical init (stable_hash, not randomized hash())."""
+    import subprocess, sys
+    code = (
+        "import sys; sys.path.insert(0, '/root/repo');"
+        "import os; os.environ['JAX_PLATFORMS']='cpu';"
+        "import jax;"
+        "from caffeonspark_tpu.net import Net;"
+        "from caffeonspark_tpu.proto import NetParameter;"
+        "n = Net(NetParameter.from_text('''"
+        "layer { name: \"d\" type: \"MemoryData\" top: \"data\" "
+        "memory_data_param { batch_size: 1 channels: 1 height: 4 width: 4 } }"
+        "layer { name: \"ip\" type: \"InnerProduct\" bottom: \"data\" "
+        "top: \"y\" inner_product_param { num_output: 2 "
+        "weight_filler { type: \"gaussian\" std: 1.0 } } }'''));"
+        "p = n.init(jax.random.key(7));"
+        "print(float(p['ip']['weight'][0, 0]))")
+    outs = set()
+    for _ in range(2):
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            env={**os.environ, "PYTHONHASHSEED": "random",
+                 "PALLAS_AXON_POOL_IPS": ""})
+        assert r.returncode == 0, r.stderr[-500:]
+        outs.add(r.stdout.strip().splitlines()[-1])
+    assert len(outs) == 1, f"nondeterministic init: {outs}"
+
+
+def test_slice_indivisible_raises():
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx
+    lp = LayerParameter.from_text(
+        'name: "s" type: "Slice" bottom: "x" top: "a" top: "b" top: "c" '
+        'slice_param { axis: 1 }')
+    with pytest.raises(ValueError, match="not divisible"):
+        get_op("Slice").apply(Ctx(), lp, [], [jnp.ones((2, 10))])
+
+
+def test_loss_normalize_legacy():
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx
+    base = ('name: "l" type: "SoftmaxWithLoss" bottom: "x" bottom: "lab" '
+            'top: "loss" ')
+    x = jnp.zeros((4, 3, 2))  # (N, C, spatial): FULL count 8, batch 4
+    lab = jnp.zeros((4, 2))
+    loss_valid = get_op("SoftmaxWithLoss").apply(
+        Ctx(), LayerParameter.from_text(base), [], [x, lab])[0]
+    loss_bs = get_op("SoftmaxWithLoss").apply(
+        Ctx(), LayerParameter.from_text(
+            base + 'loss_param { normalize: false }'), [], [x, lab])[0]
+    assert float(loss_bs) == pytest.approx(2 * float(loss_valid), rel=1e-6)
+
+LENET = """
+name: "LeNet"
+layer {
+  name: "data" type: "MemoryData" top: "data" top: "label"
+  include { phase: TRAIN }
+  memory_data_param { batch_size: 8 channels: 1 height: 28 width: 28 }
+}
+layer {
+  name: "data" type: "MemoryData" top: "data" top: "label"
+  include { phase: TEST }
+  memory_data_param { batch_size: 4 channels: 1 height: 28 width: 28 }
+}
+layer {
+  name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 20 kernel_size: 5 stride: 1
+    weight_filler { type: "xavier" } }
+}
+layer {
+  name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+  inner_product_param { num_output: 500 weight_filler { type: "xavier" } }
+}
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer {
+  name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 10 weight_filler { type: "xavier" } }
+}
+layer {
+  name: "accuracy" type: "Accuracy" bottom: "ip2" bottom: "label"
+  top: "accuracy" include { phase: TEST }
+}
+layer {
+  name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" bottom: "label"
+  top: "loss"
+}
+"""
+
+
+def test_phase_filtering():
+    np_ = NetParameter.from_text(LENET)
+    train = Net(np_, NetState(phase=Phase.TRAIN))
+    test = Net(np_, NetState(phase=Phase.TEST))
+    train_names = [lp.name for lp in train.compute_layers]
+    test_names = [lp.name for lp in test.compute_layers]
+    assert "accuracy" not in train_names
+    assert "accuracy" in test_names
+    # batch size comes from the phase's own data layer
+    assert dict((n, s) for n, s, _ in train.input_specs)["data"][0] == 8
+    assert dict((n, s) for n, s, _ in test.input_specs)["data"][0] == 4
+
+
+def test_shape_inference_and_forward():
+    np_ = NetParameter.from_text(LENET)
+    net = Net(np_, NetState(phase=Phase.TRAIN))
+    assert net.blob_shapes["conv1"] == (8, 20, 24, 24)
+    assert net.blob_shapes["pool1"] == (8, 20, 12, 12)
+    assert net.blob_shapes["ip1"] == (8, 500)
+    assert net.blob_shapes["ip2"] == (8, 10)
+    assert net.blob_shapes["loss"] == ()
+    params = net.init(jax.random.key(0))
+    assert params["conv1"]["weight"].shape == (20, 1, 5, 5)
+    assert params["conv1"]["bias"].shape == (20,)
+    inputs = {"data": jnp.ones((8, 1, 28, 28)),
+              "label": jnp.zeros((8,))}
+    blobs, _ = net.apply(params, inputs)
+    assert blobs["loss"].shape == ()
+    assert np.isfinite(float(blobs["loss"]))
+    # loss ≈ log(10) at init for 10-way uniform-ish outputs
+    assert 0.5 < float(blobs["loss"]) < 5.0
+
+
+def test_loss_and_grad():
+    np_ = NetParameter.from_text(LENET)
+    net = Net(np_, NetState(phase=Phase.TRAIN))
+    params = net.init(jax.random.key(0))
+    inputs = {"data": jnp.ones((8, 1, 28, 28)), "label": jnp.zeros((8,))}
+    (loss, _), grads = jax.value_and_grad(net.loss, has_aux=True)(
+        params, inputs)
+    assert np.isfinite(float(loss))
+    gnorm = sum(float(jnp.sum(g * g)) for lb in grads.values()
+                for g in lb.values())
+    assert gnorm > 0
+
+
+def test_net_outputs():
+    np_ = NetParameter.from_text(LENET)
+    net = Net(np_, NetState(phase=Phase.TEST))
+    assert set(net.output_blobs) == {"accuracy", "loss"}
+
+
+def test_pooling_ceil_mode():
+    # CIFAR pool: 32→ceil((32-3)/2)+1 = 16 (+1 if tail window)
+    from caffeonspark_tpu.ops.layers import pool_output_dim
+    assert pool_output_dim(32, 3, 2, 0) == 16
+    assert pool_output_dim(28, 2, 2, 0) == 14
+    # AlexNet: 55 →  pool 3 stride 2 → 27 (caffe ceil mode: 27.0 → 27+1=28?
+    # ceil((55-3)/2)+1 = 27
+    assert pool_output_dim(55, 3, 2, 0) == 27
+    # with padding, tail clip: size 6, k 3, s 2, pad 1 → ceil(6/2)+1=4
+    # but (4-1)*2=6 >= 6+1? no → stays 4
+    assert pool_output_dim(6, 3, 2, 1) == 4
+
+
+def test_ave_pooling_divisor():
+    """Caffe AVE divisor counts window ∩ padded region."""
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx
+    lp = LayerParameter.from_text(
+        'name: "p" type: "Pooling" bottom: "x" top: "y" '
+        'pooling_param { pool: AVE kernel_size: 3 stride: 2 pad: 1 }')
+    x = jnp.ones((1, 1, 4, 4))
+    tops = get_op("Pooling").apply(Ctx(), lp, [], [x])
+    y = np.asarray(tops[0])
+    # out = ceil((4+2-3)/2)+1 = 3; corner window covers 2x2 real pixels,
+    # divisor = 3x3 (fully inside the padded region) → 4/9
+    assert y.shape == (1, 1, 3, 3)
+    assert y[0, 0, 0, 0] == pytest.approx(4.0 / 9.0)
+    assert y[0, 0, 1, 1] == pytest.approx(1.0)
+
+
+def test_lrn_across_channels():
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx
+    lp = LayerParameter.from_text(
+        'name: "n" type: "LRN" bottom: "x" top: "y" '
+        'lrn_param { local_size: 5 alpha: 0.0001 beta: 0.75 }')
+    x = jnp.ones((2, 8, 3, 3))
+    y = get_op("LRN").apply(Ctx(), lp, [], [x])[0]
+    # center channels: scale = 1 + alpha/5*5 = 1.0001
+    expect = 1.0 / (1 + 0.0001) ** 0.75
+    assert float(y[0, 4, 0, 0]) == pytest.approx(expect, rel=1e-5)
+
+
+def test_dropout_train_vs_test():
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx
+    lp = LayerParameter.from_text(
+        'name: "d" type: "Dropout" bottom: "x" top: "y" '
+        'dropout_param { dropout_ratio: 0.5 }')
+    x = jnp.ones((4, 100))
+    y_test = get_op("Dropout").apply(Ctx(train=False), lp, [], [x])[0]
+    assert np.allclose(np.asarray(y_test), 1.0)
+    ctx = Ctx(train=True, rng=jax.random.key(1), layer_name="d")
+    y_train = np.asarray(get_op("Dropout").apply(ctx, lp, [], [x])[0])
+    assert set(np.unique(y_train)).issubset({0.0, 2.0})
+    assert 0.3 < (y_train == 0).mean() < 0.7
+
+
+def test_lstm_cont_gating():
+    """cont=0 at t must reset state: output at t equals output of a fresh
+    sequence start."""
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx, _lstm_params
+    lp = LayerParameter.from_text(
+        'name: "l" type: "LSTM" bottom: "x" bottom: "cont" top: "h" '
+        'recurrent_param { num_output: 4 weight_filler { type: "uniform" '
+        'min: -0.1 max: 0.1 } } ')
+    from caffeonspark_tpu.ops.fillers import fill
+    specs = _lstm_params(lp, [(6, 2, 3), (6, 2)])
+    key = jax.random.key(0)
+    params = [fill(jax.random.fold_in(key, i), f, s)
+              for i, (_, s, f) in enumerate(specs)]
+    x = jax.random.normal(jax.random.key(1), (6, 2, 3))
+    cont = jnp.ones((6, 2)).at[0].set(0.0).at[3].set(0.0)
+    h = get_op("LSTM").apply(Ctx(), lp, params, [x, cont])[0]
+    assert h.shape == (6, 2, 4)
+    # restart at t=3 ≡ fresh run starting from x[3:]
+    h2 = get_op("LSTM").apply(Ctx(), lp, params,
+                              [x[3:], jnp.ones((3, 2)).at[0].set(0.0)])[0]
+    np.testing.assert_allclose(np.asarray(h[3:]), np.asarray(h2),
+                               rtol=1e-5)
+
+
+@pytest.mark.skipif(not HAS_REF, reason="reference configs not mounted")
+@pytest.mark.parametrize("fname,phase", [
+    ("lenet_memory_train_test.prototxt", Phase.TRAIN),
+    ("lenet_memory_train_test.prototxt", Phase.TEST),
+    ("cifar10_quick_train_test.prototxt", Phase.TRAIN),
+])
+def test_reference_nets_forward(fname, phase):
+    np_ = read_net(os.path.join(REF_DATA, fname))
+    net = Net(np_, NetState(phase=phase))
+    params = net.init(jax.random.key(0))
+    blobs, _ = net.apply(params, net.make_dummy_inputs(),
+                         rng=jax.random.key(1))
+    for out in net.output_blobs:
+        assert np.all(np.isfinite(np.asarray(blobs[out]))), out
+
+
+@pytest.mark.skipif(not HAS_REF, reason="reference configs not mounted")
+def test_caffenet_shapes():
+    """bvlc_reference (AlexNet-style) shape parity checkpoints."""
+    np_ = read_net(os.path.join(REF_DATA, "bvlc_reference_net.prototxt"))
+    net = Net(np_, NetState(phase=Phase.TRAIN))
+    bs = net.blob_shapes
+    b = bs["data"][0]
+    assert bs["conv1"] == (b, 96, 55, 55)
+    assert bs["pool1"] == (b, 96, 27, 27)
+    assert bs["conv2"] == (b, 256, 27, 27)
+    assert bs["pool2"] == (b, 256, 13, 13)
+    assert bs["conv3"] == (b, 384, 13, 13)
+    assert bs["conv5"] == (b, 256, 13, 13)
+    assert bs["pool5"] == (b, 256, 6, 6)
+    assert bs["fc6"] == (b, 4096)
+    assert bs["fc8"] == (b, 1000)
