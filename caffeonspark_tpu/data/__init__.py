@@ -1,0 +1,8 @@
+"""Data sources, transformers, and readers (LMDB, SequenceFile, Parquet)."""
+
+from .lmdb_io import LmdbReader, LmdbWriter
+from .sequencefile import SequenceFileReader, SequenceFileWriter
+from .source import (LMDB, DataSource, ImageDataFrame, SeqImageDataSource,
+                     STOP_MARK, datum_to_record, get_source,
+                     register_source)
+from .transformer import Transformer, load_mean_file

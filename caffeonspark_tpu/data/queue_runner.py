@@ -1,0 +1,115 @@
+"""Bounded-queue feed runtime: the QueuePair / backpressure protocol of
+the reference executor, re-expressed for a host→TPU pipeline.
+
+Reference semantics preserved (SURVEY §2.2):
+  * bounded source queue, capacity 1024 (`DataSource.scala:67-76`);
+  * STOP_MARK sentinel ends an epoch (`CaffeProcessor.scala:205`);
+  * `feedQueue` spins `offer` until the solver completes — device→task
+    backpressure (`CaffeProcessor.scala:192-198`);
+  * double-buffered transformer→solver handoff (QueuePair depth 2,
+    `CaffeProcessor.scala:32-35`) — here a device-prefetch depth of 2:
+    while the TPU runs step N, batch N+1 is already transferring H2D.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+from .source import STOP_MARK
+
+SOURCE_QUEUE_CAPACITY = 1024
+
+
+class FeedQueue:
+    """Bounded record queue with STOP_MARK epoch protocol."""
+
+    def __init__(self, capacity: int = SOURCE_QUEUE_CAPACITY):
+        self._q: queue.Queue = queue.Queue(maxsize=capacity)
+        self._stopped = False
+
+    def offer(self, item, timeout: Optional[float] = None) -> bool:
+        """Blocking put with backpressure; returns False if stopped."""
+        if self._stopped:
+            return False
+        while True:
+            try:
+                self._q.put(item, timeout=timeout or 0.1)
+                return True
+            except queue.Full:
+                if self._stopped:
+                    return False
+                if timeout is not None:
+                    return False
+
+    def mark_epoch_end(self):
+        self._q.put(STOP_MARK)
+
+    def take(self, timeout: Optional[float] = None):
+        return self._q.get(timeout=timeout) if timeout else self._q.get()
+
+    def stop(self):
+        self._stopped = True
+
+    def __len__(self):
+        return self._q.qsize()
+
+
+def batch_iterator(feed: FeedQueue, batch_size: int,
+                   pack: Callable) -> Iterator[Dict[str, np.ndarray]]:
+    """Drain a FeedQueue into packed batches; one epoch per STOP_MARK."""
+    buf = []
+    while True:
+        item = feed.take()
+        if item is STOP_MARK:
+            if buf:
+                yield pack(buf)
+            return
+        buf.append(item)
+        if len(buf) == batch_size:
+            yield pack(buf)
+            buf = []
+
+
+def transformer_pool(feed: FeedQueue, batch_size: int, pack: Callable,
+                     out: "queue.Queue", num_threads: int = 1):
+    """Background transformer threads (transform_thread_per_device
+    analog, `CaffeProcessor.scala:54-55`): decode/augment off the
+    critical path while the device computes."""
+    def run():
+        for batch in batch_iterator(feed, batch_size, pack):
+            out.put(batch)
+        out.put(STOP_MARK)
+
+    threads = [threading.Thread(target=run, daemon=True)
+               for _ in range(num_threads)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], *,
+                    depth: int = 2, sharding=None
+                    ) -> Iterator[Dict[str, jax.Array]]:
+    """Asynchronously stage `depth` batches onto the device (the
+    double-buffered QueuePair analog). jax transfers are async: calling
+    device_put for batch N+1 while N computes overlaps H2D with compute."""
+    buf = collections.deque()
+
+    def put(b):
+        if sharding is not None:
+            return {k: jax.device_put(v, sharding[k] if isinstance(
+                sharding, dict) else sharding) for k, v in b.items()}
+        return {k: jax.device_put(v) for k, v in b.items()}
+
+    for b in batches:
+        buf.append(put(b))
+        if len(buf) > depth:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()

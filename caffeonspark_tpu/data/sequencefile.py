@@ -1,0 +1,151 @@
+"""Hadoop SequenceFile reader/writer (uncompressed record format).
+
+The reference trains CaffeNet-ImageNet from SequenceFiles produced by
+`tools/Binary2Sequence.scala:18-89` and read back via Spark's
+`sc.sequenceFile` in `SeqImageDataSource.scala:35-64`.  This is a
+dependency-free implementation of the same container: version-6 header,
+Text/BytesWritable serialization, 16-byte sync markers every few KB.
+
+Key class `org.apache.hadoop.io.Text` (VInt length + UTF-8), value class
+`org.apache.hadoop.io.BytesWritable` (4-byte big-endian length + bytes).
+Records: {recordLen i32be, keyLen i32be, key, value}; recordLen == -1
+escapes a sync marker.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Optional, Tuple
+
+SEQ_MAGIC = b"SEQ\x06"
+TEXT_CLASS = "org.apache.hadoop.io.Text"
+BYTES_CLASS = "org.apache.hadoop.io.BytesWritable"
+SYNC_INTERVAL = 2000  # bytes between sync markers (hadoop default ~2000)
+
+
+def write_vint(v: int) -> bytes:
+    if -112 <= v <= 127:
+        return struct.pack("b", v)
+    out = bytearray()
+    neg = v < 0
+    if neg:
+        v = ~v
+    length = (v.bit_length() + 7) // 8
+    out.append((-121 if neg else -113) - (length - 1) & 0xFF)
+    out.extend(v.to_bytes(length, "big"))
+    return bytes(out)
+
+
+def read_vint(buf: bytes, pos: int) -> Tuple[int, int]:
+    (first,) = struct.unpack_from("b", buf, pos)
+    pos += 1
+    if first >= -112:
+        return first, pos
+    neg = first <= -121
+    length = (-first - 120) if neg else (-first - 112)
+    v = int.from_bytes(buf[pos:pos + length], "big")
+    pos += length
+    return (~v if neg else v), pos
+
+
+def _write_text(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return write_vint(len(b)) + b
+
+
+def _read_text(buf: bytes, pos: int) -> Tuple[str, int]:
+    n, pos = read_vint(buf, pos)
+    return buf[pos:pos + n].decode("utf-8"), pos + n
+
+
+class SequenceFileWriter:
+    """(Text key, BytesWritable value) records, uncompressed."""
+
+    def __init__(self, path: str, *, key_class: str = TEXT_CLASS,
+                 value_class: str = BYTES_CLASS,
+                 sync_seed: int = 0x53455106):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "wb")
+        self.key_class = key_class
+        self.value_class = value_class
+        import hashlib
+        self.sync = hashlib.md5(
+            f"cos-tpu-sync-{sync_seed}".encode()).digest()
+        hdr = SEQ_MAGIC + _write_text(key_class) + _write_text(value_class)
+        hdr += b"\x00\x00"            # compressed=false, block=false
+        hdr += struct.pack(">i", 0)   # metadata entries
+        hdr += self.sync
+        self._f.write(hdr)
+        self._since_sync = 0
+
+    def append(self, key: str, value: bytes) -> None:
+        kb = _write_text(key)  # Text writable: VInt + utf8
+        rec = struct.pack(">ii", len(kb) + len(value) + 4, len(kb))
+        # BytesWritable serializes as {len i32be, bytes}
+        self._f.write(rec + kb + struct.pack(">i", len(value)) + value)
+        self._since_sync += len(kb) + len(value) + 12
+        if self._since_sync >= SYNC_INTERVAL:
+            self._f.write(struct.pack(">i", -1) + self.sync)
+            self._since_sync = 0
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class SequenceFileReader:
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            self._buf = f.read()
+        buf = self._buf
+        if buf[:4] != SEQ_MAGIC:
+            raise ValueError(f"{path}: not a SequenceFile (v6)")
+        pos = 4
+        self.key_class, pos = _read_text(buf, pos)
+        self.value_class, pos = _read_text(buf, pos)
+        compressed, block = buf[pos], buf[pos + 1]
+        pos += 2
+        if compressed or block:
+            raise NotImplementedError("compressed SequenceFiles")
+        (nmeta,) = struct.unpack_from(">i", buf, pos)
+        pos += 4
+        self.metadata = {}
+        for _ in range(nmeta):
+            k, pos = _read_text(buf, pos)
+            v, pos = _read_text(buf, pos)
+            self.metadata[k] = v
+        self.sync = buf[pos:pos + 16]
+        self._data_start = pos + 16
+
+    def records(self) -> Iterator[Tuple[str, bytes]]:
+        buf = self._buf
+        pos = self._data_start
+        n = len(buf)
+        while pos < n:
+            (rec_len,) = struct.unpack_from(">i", buf, pos)
+            pos += 4
+            if rec_len == -1:
+                if buf[pos:pos + 16] != self.sync:
+                    raise ValueError("sync marker mismatch (corrupt file)")
+                pos += 16
+                continue
+            (key_len,) = struct.unpack_from(">i", buf, pos)
+            pos += 4
+            kend = pos + key_len
+            _, kpos = read_vint(buf, pos)
+            key = buf[kpos:kend].decode("utf-8")
+            (vlen,) = struct.unpack_from(">i", buf, kend)
+            value = buf[kend + 4:kend + 4 + vlen]
+            pos = kend + (rec_len - key_len)  # value section incl. length
+            yield key, bytes(value)
+
+    def __iter__(self):
+        return self.records()

@@ -1,0 +1,251 @@
+"""Data sources: the DataSource SPI of the reference re-expressed for a
+host→TPU feed pipeline.
+
+Reference: `caffe-grid/.../DataSource.scala:27-128` (SPI: init /
+makeRDD / nextBatch / STOP_MARK queue protocol) with concrete sources
+LMDB (`LMDB.scala`), SeqImageDataSource (`SeqImageDataSource.scala`),
+ImageDataFrame (`ImageDataFrame.scala`), DataFrameSource
+(`DataFrameSource.scala`) — all instantiated reflectively from the
+prototxt `source_class` field (`DataSource.scala:133-166`).
+
+Here each source yields **record tuples** `(id, label, C, H, W, encoded,
+bytes)` — the reference's 7-tuple RDD element — and `next_batch` packs
+them through the `Transformer` into the data layer's named blobs, ready
+for `jax.device_put`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..proto.caffe import (Datum, LayerParameter, NetState, Phase,
+                           TopBlobType)
+from .lmdb_io import LmdbReader
+from .sequencefile import SequenceFileReader
+from .transformer import Transformer
+
+ImageRecord = Tuple[str, float, int, int, int, bool, bytes]
+
+STOP_MARK = object()
+
+
+def _strip_scheme(uri: str) -> str:
+    for scheme in ("file:", "hdfs:"):
+        if uri.startswith(scheme):
+            uri = uri[len(scheme):]
+    return uri
+
+
+def decode_image(data: bytes, *, channels: int,
+                 resize_hw: Optional[Tuple[int, int]] = None) -> np.ndarray:
+    """JPEG/PNG bytes → (C, H, W) float32, BGR channel order like OpenCV
+    (`jcaffe/Mat.java decode` semantics)."""
+    import cv2
+    flag = cv2.IMREAD_GRAYSCALE if channels == 1 else cv2.IMREAD_COLOR
+    img = cv2.imdecode(np.frombuffer(data, np.uint8), flag)
+    if img is None:
+        raise ValueError("image decode failed")
+    if resize_hw is not None and (img.shape[0], img.shape[1]) != resize_hw:
+        img = cv2.resize(img, (resize_hw[1], resize_hw[0]))
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img.transpose(2, 0, 1).astype(np.float32)
+
+
+def datum_to_record(key: bytes, raw: bytes) -> ImageRecord:
+    """LMDB value (serialized Datum) → 7-tuple record
+    (`LmdbRDD.scala:136-151` + CHW ordering :270-281)."""
+    d = Datum.from_binary(raw)
+    if d.encoded or not d.has("data"):
+        data = d.data if d.has("data") else b""
+        return (key.decode("latin-1"), float(d.label), d.channels,
+                d.height, d.width, True, data)
+    return (key.decode("latin-1"), float(d.label), d.channels, d.height,
+            d.width, False, d.data)
+
+
+class DataSource:
+    """SPI base: concrete sources implement `records()`."""
+
+    def __init__(self, layer: LayerParameter, *, phase_train: bool,
+                 rank: int = 0, num_ranks: int = 1, seed: int = 0,
+                 resize: bool = False):
+        self.layer = layer
+        self.phase_train = phase_train
+        self.rank = rank
+        self.num_ranks = num_ranks
+        self.seed = seed
+        self.resize = resize
+        self.batch_size = self._batch_size()
+        self.transformer = Transformer(
+            layer.transform_param if layer.has("transform_param") else None,
+            phase_train=phase_train, seed=seed + rank,
+            mean_dir=os.path.dirname(self.source_uri()) or None)
+
+    # -- config ------------------------------------------------------------
+    def _batch_size(self) -> int:
+        if self.layer.has("memory_data_param"):
+            return int(self.layer.memory_data_param.batch_size)
+        if self.layer.has("cos_data_param"):
+            return int(self.layer.cos_data_param.batch_size)
+        raise ValueError("data layer has no batch size")
+
+    def source_uri(self) -> str:
+        if self.layer.has("memory_data_param"):
+            return _strip_scheme(self.layer.memory_data_param.source)
+        if self.layer.has("cos_data_param"):
+            return _strip_scheme(self.layer.cos_data_param.source)
+        return ""
+
+    def image_dims(self) -> Tuple[int, int, int]:
+        p = self.layer.memory_data_param
+        return int(p.channels), int(p.height), int(p.width)
+
+    # -- SPI ---------------------------------------------------------------
+    def records(self) -> Iterator[ImageRecord]:
+        raise NotImplementedError
+
+    def record_partitions(self, n: int) -> List[Any]:
+        """Opaque partition descriptors for sharded reads (rank i of n)."""
+        return list(range(n))
+
+    def next_batch(self, records: Sequence[ImageRecord]
+                   ) -> Dict[str, np.ndarray]:
+        """Pack + transform records into the data layer's blobs
+        (ImageDataSource.nextBatch analog, `ImageDataSource.scala:99-163`)."""
+        c, h, w = self.image_dims()
+        n = len(records)
+        data = np.zeros((n, c, h, w), np.float32)
+        labels = np.zeros((n,), np.float32)
+        for i, (rid, label, rc, rh, rw, encoded, payload) in \
+                enumerate(records):
+            if encoded:
+                arr = decode_image(payload, channels=c, resize_hw=(h, w)
+                                   if (self.resize or (rh, rw) != (h, w))
+                                   else None)
+            else:
+                arr = np.frombuffer(payload, np.uint8).astype(
+                    np.float32).reshape(rc, rh, rw)
+                if (rh, rw) != (h, w):
+                    raise ValueError(
+                        f"record {rid}: {rh}x{rw} != layer {h}x{w} "
+                        "(set -resize for encoded sources)")
+            data[i] = arr
+            labels[i] = label
+        out_names = list(self.layer.top)
+        batch = {out_names[0]: self.transformer(data)}
+        if len(out_names) > 1:
+            batch[out_names[1]] = labels
+        return batch
+
+    def batches(self, *, loop: bool = True) -> Iterator[Dict[str, np.ndarray]]:
+        """Convenience: records → transformed batches, epoch-looping."""
+        buf: List[ImageRecord] = []
+        while True:
+            got_any = False
+            for rec in self.records():
+                got_any = True
+                buf.append(rec)
+                if len(buf) == self.batch_size:
+                    yield self.next_batch(buf)
+                    buf = []
+            if not got_any:
+                return
+            if not loop:
+                if buf:
+                    yield self.next_batch(buf)
+                return
+
+
+class LMDB(DataSource):
+    """LMDB of Caffe Datum records (source_class com.yahoo.ml.caffe.LMDB)."""
+
+    def records(self) -> Iterator[ImageRecord]:
+        path = self.source_uri()
+        with LmdbReader(path) as r:
+            ranges = r.partition_ranges(self.num_ranks)
+            lo, hi = ranges[self.rank % len(ranges)]
+            for k, v in r.items(lo, hi):
+                yield datum_to_record(k, v)
+
+
+class SeqImageDataSource(DataSource):
+    """SequenceFile of (id, Datum) records
+    (source_class com.yahoo.ml.caffe.SeqImageDataSource)."""
+
+    def records(self) -> Iterator[ImageRecord]:
+        path = self.source_uri()
+        files = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if not f.startswith((".", "_"))) if os.path.isdir(path) \
+            else [path]
+        for i, f in enumerate(files):
+            if i % self.num_ranks != self.rank and len(files) > 1:
+                continue
+            for key, val in SequenceFileReader(f):
+                yield datum_to_record(key.encode("latin-1"), val)
+
+
+class ImageDataFrame(DataSource):
+    """Parquet DataFrame of images (source_class
+    com.yahoo.ml.caffe.ImageDataFrame): optional columns id/label/
+    channels/height/width/encoded + data (ImageDataFrame.scala:31-73)."""
+
+    def records(self) -> Iterator[ImageRecord]:
+        import pyarrow.parquet as pq
+        c, h, w = self.image_dims()
+        encoded_default = self.layer.memory_data_param.image_encoded
+        table = pq.read_table(self.source_uri())
+        cols = set(table.column_names)
+        sel = list(self.layer.memory_data_param.dataframe_column_select)
+        n = table.num_rows
+        lo = self.rank * n // self.num_ranks
+        hi = (self.rank + 1) * n // self.num_ranks
+        tbl = table.slice(lo, hi - lo).to_pydict()
+        for i in range(hi - lo):
+            def col(name, default):
+                return tbl[name][i] if name in cols else default
+            data = col("data", b"") or b""
+            if isinstance(data, list):
+                data = bytes(data)
+            yield (str(col("id", i)), float(col("label", 0.0) or 0.0),
+                   int(col("channels", c)), int(col("height", h)),
+                   int(col("width", w)),
+                   bool(col("encoded", encoded_default)), data)
+
+
+_CLASS_MAP = {
+    "com.yahoo.ml.caffe.LMDB": LMDB,
+    "com.yahoo.ml.caffe.SeqImageDataSource": SeqImageDataSource,
+    "com.yahoo.ml.caffe.ImageDataFrame": ImageDataFrame,
+    "LMDB": LMDB,
+    "SeqImageDataSource": SeqImageDataSource,
+    "ImageDataFrame": ImageDataFrame,
+}
+
+
+def get_source(layer: LayerParameter, **kw) -> DataSource:
+    """Reflective factory keyed on prototxt `source_class`
+    (DataSource.scala:130-167 analog)."""
+    cls_name = layer.source_class
+    if not cls_name:
+        raise ValueError(f"data layer {layer.name!r} has no source_class")
+    if cls_name in _CLASS_MAP:
+        return _CLASS_MAP[cls_name](layer, **kw)
+    if cls_name == "com.yahoo.ml.caffe.DataFrameSource" \
+            or cls_name.endswith("DataFrameSource"):
+        from .dataframe import DataFrameSource
+        return DataFrameSource(layer, **kw)
+    # user-provided "module:Class" extension point
+    if ":" in cls_name:
+        import importlib
+        mod, cls = cls_name.rsplit(":", 1)
+        return getattr(importlib.import_module(mod), cls)(layer, **kw)
+    raise ValueError(f"unknown source_class {cls_name!r}")
+
+
+def register_source(name: str, cls) -> None:
+    _CLASS_MAP[name] = cls

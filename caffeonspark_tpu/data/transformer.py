@@ -1,0 +1,113 @@
+"""Host-side data transformer: Caffe `transform_param` semantics.
+
+Equivalent of caffe::DataTransformer<float> consumed through the JNI
+wrapper `jcaffe/FloatDataTransformer.java:9-40` (scale / mirror / crop /
+mean-subtract per batch, SURVEY §2.4).  Runs on the host CPU over numpy
+batches (the TPU analog of the reference's transformer threads feeding
+preallocated blobs), so the jitted step receives ready NCHW tensors.
+
+Order of operations (matches Caffe Transform):
+  1. crop (random at TRAIN, center at TEST)
+  2. mirror (random horizontal flip at TRAIN)
+  3. mean subtraction (mean_file pixel-wise, else mean_value per channel)
+  4. scale multiplication
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..proto.caffe import BlobProto, TransformationParameter
+
+
+def load_mean_file(path: str) -> np.ndarray:
+    """mean.binaryproto → (C, H, W) float32 (BlobProto wire format)."""
+    with open(path, "rb") as f:
+        bp = BlobProto.from_binary(f.read())
+    if bp.shape.dim:
+        shape = tuple(int(d) for d in bp.shape.dim)
+    else:
+        shape = (int(bp.channels), int(bp.height), int(bp.width))
+    arr = np.asarray(bp.data, np.float32).reshape(shape)
+    if arr.ndim == 4:
+        arr = arr[0]
+    return arr
+
+
+class Transformer:
+    """Batched NCHW transformer with Caffe RNG discipline: one stream per
+    transformer instance, seeded per rank (CaffeNet.cpp:614-618 analog)."""
+
+    def __init__(self, tp: Optional[TransformationParameter], *,
+                 phase_train: bool, seed: int = 0,
+                 mean_dir: Optional[str] = None):
+        self.tp = tp or TransformationParameter()
+        self.train = phase_train
+        self.rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        self.mean: Optional[np.ndarray] = None
+        if self.tp.has("mean_file") and self.tp.mean_file:
+            import os
+            p = self.tp.mean_file
+            if mean_dir is not None and not os.path.isabs(p):
+                p = os.path.join(mean_dir, p)
+            self.mean = load_mean_file(p)
+        if self.tp.mean_value and self.mean is not None:
+            raise ValueError("specify either mean_file or mean_value, "
+                             "not both")
+
+    def __call__(self, batch: np.ndarray) -> np.ndarray:
+        """batch: (N, C, H, W) float32 (raw 0..255 pixel scale)."""
+        tp = self.tp
+        n, c, h, w = batch.shape
+        crop = int(tp.crop_size)
+        out = batch
+
+        if crop and (crop != h or crop != w):
+            if crop > h or crop > w:
+                raise ValueError(f"crop_size {crop} exceeds input {h}x{w}")
+            if self.train:
+                hs = self.rng.randint(0, h - crop + 1, size=n)
+                ws = self.rng.randint(0, w - crop + 1, size=n)
+                out = np.stack([out[i, :, hs[i]:hs[i] + crop,
+                                    ws[i]:ws[i] + crop]
+                                for i in range(n)])
+            else:
+                hs0 = (h - crop) // 2
+                ws0 = (w - crop) // 2
+                out = out[:, :, hs0:hs0 + crop, ws0:ws0 + crop]
+        elif crop:
+            out = out.copy()
+        else:
+            out = out.copy()
+
+        if tp.mirror and self.train:
+            flip = self.rng.randint(0, 2, size=n).astype(bool)
+            out[flip] = out[flip, :, :, ::-1]
+
+        if self.mean is not None:
+            m = self.mean
+            if crop and (m.shape[1] != out.shape[2]
+                         or m.shape[2] != out.shape[3]):
+                hs0 = (m.shape[1] - out.shape[2]) // 2
+                ws0 = (m.shape[2] - out.shape[3]) // 2
+                m = m[:, hs0:hs0 + out.shape[2], ws0:ws0 + out.shape[3]]
+            out = out - m[None]
+        elif tp.mean_value:
+            mv = np.asarray(list(tp.mean_value), np.float32)
+            if len(mv) == 1:
+                out = out - mv[0]
+            else:
+                if len(mv) != c:
+                    raise ValueError(
+                        f"{len(mv)} mean_values for {c} channels")
+                out = out - mv.reshape(1, c, 1, 1)
+
+        if tp.scale != 1.0:
+            out = out * tp.scale
+        return np.ascontiguousarray(out, np.float32)
+
+    def output_hw(self, h: int, w: int) -> Tuple[int, int]:
+        crop = int(self.tp.crop_size)
+        return (crop, crop) if crop else (h, w)

@@ -105,8 +105,18 @@ def data_layer_input_specs(lp: LayerParameter) -> List[Tuple[str, Tuple[int, ...
                  + (":T" if top.transpose else ""))
                 for top in p.top]
     if t == "Input":
+        shapes = list(lp.input_param.shape)
+        if len(shapes) == 1 and len(lp.top) > 1:
+            shapes = shapes * len(lp.top)  # one shape shared by all tops
+        if len(shapes) != len(lp.top):
+            raise ValueError(f"Input layer {lp.name!r}: {len(shapes)} "
+                             f"shapes for {len(lp.top)} tops")
         return [(name, tuple(int(d) for d in shp.dim), "data")
-                for name, shp in zip(lp.top, lp.input_param.shape)]
+                for name, shp in zip(lp.top, shapes)]
+    if t == "HDF5Data":
+        # shapes live in the HDF5 files, not the prototxt — the caller
+        # must pass input_shapes overrides (Net(..., input_shapes=...))
+        return [(name, (), "data") for name in lp.top]
     if t == "Data":
         p = lp.data_param
         b = int(p.batch_size)
@@ -167,6 +177,12 @@ class Net:
                 if input_shapes:
                     specs = [(n, tuple(input_shapes.get(n, s)), k)
                              for (n, s, k) in specs]
+                for n, s, _ in specs:
+                    if len(s) == 0:
+                        raise ValueError(
+                            f"data layer {lp.name!r} ({lp.type}) top "
+                            f"{n!r} has no shape in the prototxt — pass "
+                            f"input_shapes={{'{n}': (...)}} to Net")
                 self.input_specs.extend(specs)
         self.compute_layers = [lp for lp in self.layers
                                if not L.get_op(lp.type).is_data]
@@ -264,7 +280,13 @@ class Net:
               train: Optional[bool] = None, rng: Optional[Array] = None,
               net_state: Optional[Dict] = None
               ) -> Tuple[Dict[str, Array], Dict]:
-        """Forward pass. Returns (all blobs, new mutable state)."""
+        """Forward pass. Returns (all blobs, updated_param_blobs).
+
+        The second value maps layer name → [new blob arrays] for layers
+        that update their own param blobs during the forward pass
+        (BatchNorm running stats).  `Solver.train_step` merges it back
+        into params with `merge_forward_state`; stat blobs are pinned to
+        lr_mult = decay_mult = 0 so the optimizer never touches them."""
         if train is None:
             train = self.state.phase == Phase.TRAIN
         blobs: Dict[str, Array] = dict(inputs)
@@ -295,6 +317,23 @@ class Net:
         for name, w in self.loss_weights.items():
             total = total + w * jnp.sum(blobs[name])
         return total, (blobs, new_state)
+
+    def merge_forward_state(self, params: Params,
+                            forward_state: Dict[str, List[Array]]) -> Params:
+        """Overwrite self-updating param blobs (BatchNorm stats) with the
+        values produced by the last forward pass."""
+        if not forward_state:
+            return params
+        out = {ln: dict(bl) for ln, bl in params.items()}
+        for lname, blobs in forward_state.items():
+            for (bname, _, _), arr in zip(self.param_layout[lname], blobs):
+                out[lname][bname] = arr
+        return out
+
+    def stat_param_layers(self) -> List[str]:
+        """Layers whose param blobs are running statistics, not weights."""
+        return [lp.name for lp in self.compute_layers
+                if lp.type == "BatchNorm"]
 
     def num_params(self, params: Optional[Params] = None) -> int:
         if params is not None:

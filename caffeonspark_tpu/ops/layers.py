@@ -661,6 +661,10 @@ def _eltwise(ctx, lp, params, bottoms):
             y = y * b
     elif op == EltwiseOp.SUM:
         coeffs = p.coeff if p.coeff else [1.0] * len(bottoms)
+        if len(coeffs) != len(bottoms):
+            raise ValueError(
+                f"Eltwise SUM: {len(coeffs)} coeffs for "
+                f"{len(bottoms)} bottoms (must match)")
         y = coeffs[0] * bottoms[0]
         for c, b in zip(coeffs[1:], bottoms[1:]):
             y = y + c * b
@@ -727,16 +731,19 @@ def _silence(ctx, lp, params, bottoms):
 def _argmax(ctx, lp, params, bottoms):
     p = lp.argmax_param
     x = bottoms[0]
-    if p.has("axis"):
-        idx = jnp.argmax(x, axis=p.axis).astype(jnp.float32)
-        return [idx]
-    flat = x.reshape(x.shape[0], -1)
     k = int(p.top_k)
+    if p.has("axis"):
+        # keep the axis with size top_k; out_max_val selects values
+        axis = p.axis if p.axis >= 0 else x.ndim + p.axis
+        moved = jnp.moveaxis(x, axis, -1)
+        vals, idxs = lax.top_k(moved, k)
+        out = vals if p.out_max_val else idxs.astype(jnp.float32)
+        return [jnp.moveaxis(out, -1, axis)]
+    flat = x.reshape(x.shape[0], -1)
     vals, idxs = lax.top_k(flat, k)
     if p.out_max_val:
-        return [jnp.stack([idxs.astype(jnp.float32), vals],
-                          axis=1).reshape(x.shape[0], 2, k, 1)]
-    return [idxs.astype(jnp.float32).reshape(x.shape[0], 1, k, 1)]
+        return [jnp.stack([idxs.astype(jnp.float32), vals], axis=1)]
+    return [idxs.astype(jnp.float32).reshape(x.shape[0], 1, k)]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,203 @@
+"""Data pipeline tests: LMDB B+tree round-trip, SequenceFile round-trip,
+transformer semantics (TransformTest analog), source SPI, and the
+end-to-end LMDB→LeNet slice driven by an unmodified reference config."""
+
+import os
+
+import numpy as np
+import pytest
+
+from caffeonspark_tpu.data import (LMDB, LmdbReader, LmdbWriter,
+                                   SequenceFileReader, SequenceFileWriter,
+                                   Transformer, get_source)
+from caffeonspark_tpu.data.synthetic import make_images
+from caffeonspark_tpu.proto import TransformationParameter
+from caffeonspark_tpu.proto.caffe import BlobProto, BlobShape, Datum, \
+    LayerParameter
+
+
+def _mnist_style_lmdb(path, n=64, h=28, w=28):
+    imgs, labels = make_images(n, height=h, width=w, seed=5)
+    recs = []
+    for i in range(n):
+        d = Datum(channels=1, height=h, width=w,
+                  data=(imgs[i, 0] * 255).astype(np.uint8).tobytes(),
+                  label=int(labels[i]))
+        recs.append((b"%08d" % i, d.to_binary()))
+    LmdbWriter(os.path.join(path, "data.mdb")).write(recs)
+    return imgs, labels
+
+
+def test_lmdb_round_trip(tmp_path):
+    imgs, labels = _mnist_style_lmdb(str(tmp_path), n=64)
+    with LmdbReader(str(tmp_path)) as r:
+        assert r.entries == 64
+        items = list(r.items())
+    assert len(items) == 64
+    assert items[0][0] == b"00000000"
+    assert [k for k, _ in items] == sorted(k for k, _ in items)
+    d = Datum.from_binary(items[7][1])
+    assert d.label == int(labels[7])
+    got = np.frombuffer(d.data, np.uint8).reshape(28, 28)
+    np.testing.assert_array_equal(got, (imgs[7, 0] * 255).astype(np.uint8))
+
+
+def test_lmdb_large_values_overflow_pages(tmp_path):
+    # values far bigger than a page exercise overflow-page reads
+    recs = [(b"k%04d" % i, bytes([i % 256]) * (5000 + i * 17))
+            for i in range(20)]
+    LmdbWriter(str(tmp_path / "big")).write(recs)
+    with LmdbReader(str(tmp_path / "big")) as r:
+        got = list(r.items())
+    assert [(k, len(v)) for k, v in got] == \
+        [(k, len(v)) for k, v in sorted(recs)]
+    assert all(v == dict(recs)[k] for k, v in got)
+
+
+def test_lmdb_many_records_multilevel(tmp_path):
+    # enough records to force a multi-level B+tree
+    recs = [(b"%010d" % i, b"v" * 100 + b"%d" % i) for i in range(3000)]
+    LmdbWriter(str(tmp_path / "многа"))  # path unicode no-op
+    LmdbWriter(str(tmp_path / "many")).write(recs)
+    with LmdbReader(str(tmp_path / "many")) as r:
+        assert r.entries == 3000
+        items = list(r.items())
+        assert len(items) == 3000
+        assert items == sorted(recs)
+        # range scan
+        mid = list(r.items(b"%010d" % 1000, b"%010d" % 1010))
+        assert len(mid) == 10
+        # partitioning covers everything exactly once
+        parts = r.partition_ranges(7)
+        total = []
+        for lo, hi in parts:
+            total.extend(r.items(lo, hi))
+    assert len(total) == 3000
+
+
+def test_sequencefile_round_trip(tmp_path):
+    p = str(tmp_path / "images.seq")
+    payloads = [(f"img{i:05d}", os.urandom(600 + 37 * i))
+                for i in range(50)]
+    with SequenceFileWriter(p) as w:
+        for k, v in payloads:
+            w.append(k, v)
+    r = SequenceFileReader(p)
+    assert r.key_class.endswith("Text")
+    got = list(r)
+    assert got == payloads
+
+
+def test_transformer_scale_mean_value():
+    tp = TransformationParameter(scale=0.5, mean_value=[10.0, 20.0, 30.0])
+    t = Transformer(tp, phase_train=False, seed=0)
+    x = np.full((2, 3, 4, 4), 40.0, np.float32)
+    y = t(x)
+    np.testing.assert_allclose(y[0, 0], 15.0)   # (40-10)*0.5
+    np.testing.assert_allclose(y[0, 2], 5.0)    # (40-30)*0.5
+
+
+def test_transformer_crop_center_vs_random():
+    tp = TransformationParameter(crop_size=8)
+    x = np.zeros((4, 1, 12, 12), np.float32)
+    x[:, :, 2:10, 2:10] = 1.0
+    t_test = Transformer(tp, phase_train=False, seed=0)
+    y = t_test(x)
+    assert y.shape == (4, 1, 8, 8)
+    np.testing.assert_allclose(y, 1.0)   # center crop hits the block
+    t_train = Transformer(tp, phase_train=True, seed=0)
+    crops = [t_train(x) for _ in range(5)]
+    assert any(c.min() == 0.0 for c in crops)  # random crops vary
+
+
+def test_transformer_mean_file(tmp_path):
+    mean = np.random.RandomState(0).rand(1, 6, 6).astype(np.float32) * 10
+    bp = BlobProto(shape=BlobShape(dim=[1, 1, 6, 6]),
+                   data=[float(v) for v in mean.ravel()])
+    mp = tmp_path / "mean.binaryproto"
+    mp.write_bytes(bp.to_binary())
+    tp = TransformationParameter(mean_file=str(mp))
+    t = Transformer(tp, phase_train=False, seed=0)
+    x = np.full((1, 1, 6, 6), 10.0, np.float32)
+    np.testing.assert_allclose(t(x)[0, 0], 10.0 - mean[0], rtol=1e-6)
+
+
+def test_transformer_mirror_deterministic_by_seed():
+    tp = TransformationParameter(mirror=True)
+    x = np.zeros((8, 1, 2, 3), np.float32)
+    x[:, :, :, 0] = 1.0
+    a = Transformer(tp, phase_train=True, seed=7)(x)
+    b = Transformer(tp, phase_train=True, seed=7)(x)
+    np.testing.assert_array_equal(a, b)
+    flipped = (a[:, 0, 0, 2] == 1.0)
+    assert flipped.any() and not flipped.all()
+
+
+def test_lmdb_source_spi(tmp_path):
+    _mnist_style_lmdb(str(tmp_path), n=40)
+    lp = LayerParameter.from_text(f'''
+        name: "data" type: "MemoryData" top: "data" top: "label"
+        source_class: "com.yahoo.ml.caffe.LMDB"
+        memory_data_param {{
+          source: "file:{tmp_path}"
+          batch_size: 10 channels: 1 height: 28 width: 28 }}
+        transform_param {{ scale: 0.00390625 }}''')
+    src = get_source(lp, phase_train=True, seed=0)
+    assert isinstance(src, LMDB)
+    batches = list(src.batches(loop=False))
+    assert len(batches) == 4
+    b0 = batches[0]
+    assert b0["data"].shape == (10, 1, 28, 28)
+    assert b0["label"].shape == (10,)
+    assert 0.0 <= b0["data"].max() <= 1.0   # scaled
+
+
+def test_lmdb_source_rank_sharding(tmp_path):
+    _mnist_style_lmdb(str(tmp_path), n=40)
+    lp = LayerParameter.from_text(f'''
+        name: "data" type: "MemoryData" top: "data" top: "label"
+        source_class: "LMDB"
+        memory_data_param {{ source: "{tmp_path}"
+          batch_size: 5 channels: 1 height: 28 width: 28 }}''')
+    ids = set()
+    for rank in range(4):
+        src = get_source(lp, phase_train=True, rank=rank, num_ranks=4)
+        for rec in src.records():
+            assert rec[0] not in ids, "rank shards overlap"
+            ids.add(rec[0])
+    assert len(ids) == 40
+
+
+def test_end_to_end_lmdb_lenet(tmp_path):
+    """The minimum end-to-end slice (SURVEY §7): unmodified reference
+    LeNet solver config + LMDB source → train steps reduce loss."""
+    ref = "/root/reference/data/lenet_memory_solver.prototxt"
+    if not os.path.exists(ref):
+        pytest.skip("reference configs not mounted")
+    import jax.numpy as jnp
+    from caffeonspark_tpu.proto import (SolverParameter, read_net)
+    from caffeonspark_tpu.solver import Solver
+    _mnist_style_lmdb(str(tmp_path), n=128)
+    sp = SolverParameter.from_text(open(ref).read())
+    net_param = read_net(
+        "/root/reference/data/lenet_memory_train_test.prototxt")
+    # point the config's data layer at our LMDB (the driver does this via
+    # -train path override; here we edit the parsed message)
+    for lyr in net_param.layer:
+        if lyr.type == "MemoryData":
+            lyr.memory_data_param.source = str(tmp_path)
+            lyr.memory_data_param.batch_size = 16
+    s = Solver(sp, net_param)
+    src = get_source(s.train_net.data_layers[0], phase_train=True, seed=1)
+    params, st = s.init()
+    step = s.jit_train_step()
+    losses = []
+    gen = src.batches(loop=True)
+    for i in range(12):
+        batch = next(gen)
+        params, st, out = step(
+            params, st, {k: jnp.asarray(v) for k, v in batch.items()},
+            s.step_rng(i))
+        losses.append(float(out["loss"]))
+    assert np.isfinite(losses).all()
+    assert min(losses[-3:]) < losses[0]
