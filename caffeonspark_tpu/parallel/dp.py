@@ -1,0 +1,160 @@
+"""Data-parallel (+ tensor-parallel) execution of a Solver step over a
+device mesh.
+
+This replaces the whole L1 sync stack of the reference — `P2PSync` tree
+reduce, `SocketSync`/`RDMASync` sharded parameter-server exchange
+(`socket_sync.cpp`, SURVEY §2.6), and the `1/solver_count` gradient
+scaling (`parallel_cpu.cpp:120-122`) — with GSPMD: inputs are sharded on
+the `dp` axis, parameters are replicated (or `tp`-sharded), and XLA
+inserts the gradient all-reduce (a psum over ICI) automatically because
+the loss is a global mean over the sharded batch.  Semantically the step
+IS the single-device step — same loss, same update — executed across the
+slice; the barrier of `CaffeNet::sync` is implicit in the collective.
+
+Tensor parallelism: `tp_param_specs` shards large InnerProduct / Embed
+weights over the `tp` axis (Megatron-style column split on num_output).
+XLA partitions the matmuls and inserts all-gathers/reduce-scatters where
+layouts demand; convs stay replicated (batch dominates for the CNN zoo).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..net import Net
+from ..solver import OptState, Solver
+from .mesh import replicated
+
+Array = jax.Array
+
+TP_MIN_FEATURES = 1024  # shard only matmuls big enough to matter
+
+
+def tp_param_specs(net: Net, *, min_features: int = TP_MIN_FEATURES
+                   ) -> Dict[str, Dict[str, P]]:
+    """PartitionSpec per param blob: column-shard large IP/Embed weights
+    over 'tp', replicate the rest."""
+    specs: Dict[str, Dict[str, P]] = {}
+    by_name = {lp.name: lp for lp in net.compute_layers}
+    for lname, blobs in net.param_layout.items():
+        lp = by_name[lname]
+        specs[lname] = {}
+        for bname, shape, _ in blobs:
+            spec = P()
+            if lp.type == "InnerProduct" and bname == "weight":
+                ipp = lp.inner_product_param
+                n_out = int(ipp.num_output)
+                if n_out >= min_features and not ipp.transpose:
+                    spec = P("tp", None)     # (num_output, K) column split
+                elif n_out >= min_features:
+                    spec = P(None, "tp")
+            elif lp.type == "InnerProduct" and bname == "bias":
+                if int(lp.inner_product_param.num_output) >= min_features:
+                    spec = P("tp")
+            elif lp.type == "Embed" and bname == "weight":
+                if int(lp.embed_param.num_output) >= min_features:
+                    spec = P(None, "tp")     # (vocab, dim) dim split
+            elif lp.type in ("LSTM", "RNN") and bname.startswith("W_x"):
+                rp = lp.recurrent_param
+                if int(rp.num_output) * 4 >= min_features:
+                    spec = P("tp", None)     # (4N, D) gate split
+            specs[lname][bname] = spec
+    return specs
+
+
+class ParallelSolver:
+    """Wraps a Solver's train/eval step for mesh execution."""
+
+    def __init__(self, solver: Solver, mesh: Mesh, *,
+                 tensor_parallel: bool = True):
+        self.solver = solver
+        self.mesh = mesh
+        self.tp_on = tensor_parallel and mesh.shape.get("tp", 1) > 1
+        net = solver.train_net
+        self.param_specs = (tp_param_specs(net) if self.tp_on else
+                            {ln: {bn: P() for bn, _, _ in blobs}
+                             for ln, blobs in net.param_layout.items()})
+        self.param_sharding = {
+            ln: {bn: NamedSharding(mesh, spec)
+                 for bn, spec in blobs.items()}
+            for ln, blobs in self.param_specs.items()}
+        self.repl = replicated(mesh)
+        self._step = None
+        self._eval = None
+
+    # ------------------------------------------------------------------
+    def shard_params(self, params) -> Dict:
+        return {ln: {bn: jax.device_put(arr, self.param_sharding[ln][bn])
+                     for bn, arr in blobs.items()}
+                for ln, blobs in params.items()}
+
+    def shard_opt_state(self, st: OptState) -> OptState:
+        hist = {ln: {bn: jax.device_put(arr, self.param_sharding[ln][bn])
+                     for bn, arr in blobs.items()}
+                for ln, blobs in st.history.items()}
+        hist2 = {ln: {bn: jax.device_put(arr, self.param_sharding[ln][bn])
+                      for bn, arr in blobs.items()}
+                 for ln, blobs in st.history2.items()}
+        return OptState(iter=jax.device_put(st.iter, self.repl),
+                        history=hist, history2=hist2)
+
+    def input_shardings(self, net: Optional[Net] = None) -> Dict[str, NamedSharding]:
+        """Batch-sharded over dp; time-major tops shard their axis 1."""
+        net = net or self.solver.train_net
+        out = {}
+        for name, shape, kind in net.input_specs:
+            ax = 1 if kind.endswith(":T") else 0
+            spec = [None] * (ax + 1)
+            spec[ax] = "dp"
+            out[name] = NamedSharding(self.mesh, P(*spec))
+        return out
+
+    def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Array]:
+        sh = self.input_shardings()
+        return {k: jax.device_put(v, sh[k]) for k, v in batch.items()}
+
+    # ------------------------------------------------------------------
+    def init(self) -> Tuple[Dict, OptState]:
+        params, st = self.solver.init()
+        params = self.shard_params(params)
+        return params, self.shard_opt_state(st)
+
+    def train_step(self):
+        """Jitted SPMD step: donated params/opt, dp-sharded inputs."""
+        if self._step is None:
+            base = self.solver.train_step_fn()
+            in_sh = (
+                self.param_sharding,
+                OptState(iter=self.repl,
+                         history=self.param_sharding,
+                         history2=self.param_sharding),
+                self.input_shardings(),
+                self.repl,
+            )
+            out_sh = (in_sh[0], in_sh[1], None)
+            self._step = jax.jit(base, donate_argnums=(0, 1),
+                                 in_shardings=in_sh,
+                                 out_shardings=out_sh)
+        return self._step
+
+    def eval_step(self):
+        if self._eval is None:
+            base = self.solver.eval_step_fn()
+            in_sh = (self.param_sharding,
+                     self.input_shardings(self.solver.test_net))
+            self._eval = jax.jit(base, in_shardings=in_sh,
+                                 out_shardings=None)
+        return self._eval
+
+    @property
+    def num_dp_ranks(self) -> int:
+        return self.mesh.shape.get("dp", 1)
+
+    def global_batch(self, per_device_batch: int) -> int:
+        """README: 'Batch sizes specified in prototxt files are per
+        device' — the global batch scales with dp."""
+        return per_device_batch * self.num_dp_ranks

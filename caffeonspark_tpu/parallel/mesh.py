@@ -1,0 +1,82 @@
+"""Device mesh construction + multi-host bootstrap.
+
+TPU-native replacement for the reference's entire connection machinery:
+RDMA/socket server address exchange via Spark collect
+(`CaffeOnSpark.scala:113-142`), `SocketChannel::Connect` retries
+(`socket.cpp:242-281`), and TCP `MiniCluster::AllGather` rank assignment
+(`mini_cluster.cpp:22-66`) all collapse into `jax.distributed.initialize`
+(coordinator address = the "server" flag) plus a named `Mesh`.  The
+cluster barrier (`CaffeNet::sync`, `socket_sync.cpp:156-183`) is implicit
+in every SPMD collective.
+
+Mesh axes:
+  dp — data parallel (batch sharding, gradient pmean)
+  tp — tensor parallel (weight sharding on large InnerProducts)
+  sp — sequence parallel (ring attention / long-context)
+  pp — pipeline parallel (stage-partitioned nets)
+Axes of size 1 cost nothing; lay dp innermost-last so its collectives
+ride ICI neighbors first.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("pp", "sp", "tp", "dp")
+
+
+def distributed_init(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap (the address-exchange / rank-assignment
+    analog).  No-op for single-process runs."""
+    if coordinator is None:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def build_mesh(*, dp: Optional[int] = None, tp: int = 1, sp: int = 1,
+               pp: int = 1, devices=None) -> Mesh:
+    """Mesh over all devices with named axes (pp, sp, tp, dp); dp is
+    inferred as the remainder when unset."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    fixed = tp * sp * pp
+    if n % fixed != 0:
+        raise ValueError(f"{n} devices not divisible by tp*sp*pp={fixed}")
+    if dp is None:
+        dp = n // fixed
+    if dp * fixed != n:
+        raise ValueError(f"dp*tp*sp*pp={dp * fixed} != {n} devices")
+    arr = np.asarray(devices).reshape(pp, sp, tp, dp)
+    return Mesh(arr, AXES)
+
+
+def data_sharding(mesh: Mesh, batch_axis: int = 0) -> NamedSharding:
+    """Shard the batch dimension across dp AND sp together — for pure
+    data parallelism on a mesh that also carries an sp axis, both axes
+    consume the global batch so no devices idle."""
+    spec = [None] * (batch_axis + 1)
+    spec[batch_axis] = ("dp", "sp") if mesh.shape.get("sp", 1) > 1 \
+        else "dp"
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def lockstep_steps(total_records: int, batch_per_step: int,
+                   num_ranks: int) -> int:
+    """The minPartSize equalization invariant
+    (`CaffeOnSpark.scala:185-200`): every rank must execute the SAME
+    number of steps or a collective deadlocks the slice.  Returns the
+    per-epoch step count = floor(min records per rank / batch)."""
+    per_rank = total_records // num_ranks
+    return max(0, per_rank // batch_per_step)

@@ -1,0 +1,115 @@
+"""Sequence/context parallelism: ring attention over the `sp` mesh axis.
+
+The reference handles sequences only by single-device time-unrolled LSTM
+(SURVEY §5.7 — no SP/CP of any kind).  Long-context support is
+first-class here: sequences are sharded along time across the `sp` axis,
+and attention runs as a **ring**: each step every device computes a
+partial (flash-style, numerically stable online-softmax) attention
+against its resident K/V block, then rotates K/V to its ring neighbor
+with `lax.ppermute` — ICI traffic overlapping MXU compute, total memory
+O(T/S) per device (Ring Attention, Liu et al. 2023; blockwise parallel
+transformers).
+
+`ring_attention` is the shard_map-ready collective op; `attention` is
+the single-device reference implementation (also the parity oracle in
+tests).  The LSTM path gets sequence scaling separately via its hoisted
+(T·B, D)×(D, 4N) input projection, which XLA shards on `sp` when the
+time axis carries a sharding.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+Array = jax.Array
+
+
+def attention(q: Array, k: Array, v: Array, *, causal: bool = False,
+              q_offset: int = 0, k_offset: int = 0) -> Array:
+    """Reference softmax attention. q,k,v: (B, H, T, D)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[2])[:, None]
+        kpos = k_offset + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _ring_attention_local(q: Array, k: Array, v: Array, *, axis_name: str,
+                          causal: bool) -> Array:
+    """Per-shard body (inside shard_map): q,k,v are the LOCAL time blocks
+    (B, H, T_local, D)."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    qpos = idx * t_q + jnp.arange(t_q)           # global query positions
+
+    def accumulate(m, l, o, k_blk, v_blk, src):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        if causal:
+            kpos = src * t_k + jnp.arange(t_k)
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # guard fully-masked rows: exp against a finite max
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[..., None])
+        corr = jnp.exp(m - m_safe)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                                 v_blk)
+        return m_new, l_new, o_new
+
+    def body(step, carry):
+        m, l, o, k_blk, v_blk = carry
+        # rotate K/V around the ring (neighbor exchange on ICI), then
+        # accumulate — block 0 is handled before the loop, so no
+        # superfluous rotation happens after the last accumulation
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        m, l, o = accumulate(m, l, o, k_blk, v_blk, (idx - step) % n)
+        return m, l, o, k_blk, v_blk
+
+    # derive from q so the carry is device-varying like the loop outputs
+    # (shard_map VMA typing requires carry in/out types to match)
+    m0 = jnp.full_like(q[..., 0], -jnp.inf)
+    l0 = jnp.zeros_like(q[..., 0])
+    o0 = jnp.zeros_like(q)
+    m, l, o = accumulate(m0, l0, o0, k, v, idx)
+    m, l, o, _, _ = lax.fori_loop(1, n, body, (m, l, o, k, v))
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh, *,
+                   causal: bool = False, axis_name: str = "sp") -> Array:
+    """Sequence-parallel attention: (B, H, T, D) with T sharded on
+    `axis_name`.  Returns output with the same sharding."""
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        partial(_ring_attention_local, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def sp_shard_time(x: Array, mesh: Mesh, *, time_axis: int = 2,
+                  axis_name: str = "sp") -> Array:
+    """Place an activation with its time axis sharded over sp."""
+    spec = [None] * (time_axis + 1)
+    spec[time_axis] = axis_name
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))

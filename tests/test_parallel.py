@@ -1,0 +1,136 @@
+"""Multi-chip tests on the virtual 8-device CPU mesh — the real
+collective coverage the reference never had (SURVEY §4: 'no real
+multi-node CI test')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from caffeonspark_tpu.data.synthetic import batches
+from caffeonspark_tpu.parallel import (ParallelSolver, attention,
+                                       build_mesh, lockstep_steps,
+                                       ring_attention, tp_param_specs)
+from caffeonspark_tpu.proto import NetParameter, SolverParameter
+from caffeonspark_tpu.solver import Solver
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8 virtual devices")
+
+NET = """
+name: "tiny"
+layer {
+  name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 4 channels: 1 height: 28 width: 28 }
+}
+layer {
+  name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 5 stride: 2
+    weight_filler { type: "xavier" } }
+}
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer {
+  name: "fc_big" type: "InnerProduct" bottom: "conv1" top: "fc_big"
+  inner_product_param { num_output: 2048 weight_filler { type: "xavier" } }
+}
+layer { name: "relu2" type: "ReLU" bottom: "fc_big" top: "fc_big" }
+layer {
+  name: "ip2" type: "InnerProduct" bottom: "fc_big" top: "ip2"
+  inner_product_param { num_output: 10 weight_filler { type: "xavier" } }
+}
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" bottom: "label"
+  top: "loss" }
+"""
+
+SOLVER = """
+base_lr: 0.01
+momentum: 0.9
+lr_policy: "fixed"
+max_iter: 20
+random_seed: 11
+"""
+
+
+def _global_batch():
+    gen = batches(256, 32, seed=3, scale=1.0 / 256.0)
+    data, label = next(gen)
+    return {"data": jnp.asarray(data), "label": jnp.asarray(label)}
+
+
+def test_dp8_matches_single_device():
+    """The DP step over 8 devices must be numerically the single-device
+    step on the same global batch (the 1/solver_count semantics)."""
+    sp = SolverParameter.from_text(SOLVER)
+    npm = NetParameter.from_text(NET)
+    batch = _global_batch()
+
+    s1 = Solver(sp, npm)
+    p1, st1 = s1.init()
+    step1 = s1.jit_train_step()
+
+    mesh = build_mesh(dp=8)
+    s8 = Solver(sp, npm)
+    ps = ParallelSolver(s8, mesh)
+    p8, st8 = ps.init()
+    step8 = ps.train_step()
+
+    for i in range(3):
+        rng = s1.step_rng(i)
+        p1, st1, out1 = step1(p1, st1, batch, rng)
+        p8, st8, out8 = step8(p8, st8, ps.shard_batch(batch), rng)
+        assert float(out1["loss"]) == pytest.approx(float(out8["loss"]),
+                                                    rel=2e-4)
+    # final params identical
+    w1 = np.asarray(p1["ip2"]["weight"])
+    w8 = np.asarray(jax.device_get(p8["ip2"]["weight"]))
+    np.testing.assert_allclose(w1, w8, rtol=2e-3, atol=2e-5)
+
+
+def test_dp2_tp4_executes_and_matches():
+    sp = SolverParameter.from_text(SOLVER)
+    npm = NetParameter.from_text(NET)
+    batch = _global_batch()
+
+    mesh = build_mesh(dp=2, tp=4)
+    s = Solver(sp, npm)
+    ps = ParallelSolver(s, mesh)
+    specs = tp_param_specs(s.train_net)
+    from jax.sharding import PartitionSpec as P
+    assert specs["fc_big"]["weight"] == P("tp", None)
+    assert specs["conv1"]["weight"] == P()
+    p, st = ps.init()
+    # big fc weight is actually sharded over tp
+    shd = p["fc_big"]["weight"].sharding.spec
+    assert tuple(shd) [0] == "tp"
+    step = ps.train_step()
+
+    s1 = Solver(sp, npm)
+    p1, st1 = s1.init()
+    step1 = s1.jit_train_step()
+    for i in range(2):
+        rng = s1.step_rng(i)
+        p1, st1, out1 = step1(p1, st1, batch, rng)
+        p, st, out = step(p, st, ps.shard_batch(batch), rng)
+        assert float(out["loss"]) == pytest.approx(float(out1["loss"]),
+                                                   rel=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(causal):
+    mesh = build_mesh(dp=1, sp=8)
+    rng = np.random.RandomState(0)
+    b, h, t, d = 2, 4, 64, 16
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    ref = attention(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(jax.device_get(out)),
+                               np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_lockstep_steps():
+    # 1000 records, 10 ranks, batch 32 → 100/rank → 3 steps each
+    assert lockstep_steps(1000, 32, 10) == 3
+    assert lockstep_steps(64, 64, 1) == 1
+    assert lockstep_steps(63, 64, 1) == 0
