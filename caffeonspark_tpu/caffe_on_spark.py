@@ -1,0 +1,263 @@
+"""CaffeOnSpark: the driver API facade + CLI.
+
+Public surface parity with `caffe-grid/.../CaffeOnSpark.scala`:
+  * `main` CLI dispatch (-train / -test / -features, :27-84)
+  * `train(source)` (:164-231)
+  * `trainWithValidation(sourceTrain, sourceValidation)` (:239-358) —
+    interleaved validation with fixed-size rounds, results as a
+    DataFrame of per-round output means
+  * `test(source)` (:396-418) — per-blob mean vectors (VectorMean UDAF)
+  * `features(source)` / `features2` (:427-506) — SampleID + blob
+    columns DataFrame
+
+Engine: runs on the local process group by default (each process = one
+"executor" owning the mesh).  When pyspark is importable and a
+SparkContext is passed, the same driver logic dispatches partitions to
+executors via `spark_backend` (optional; this environment ships no
+pyspark, so that path is import-gated)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .config import Config
+from .data.source import DataSource, get_source
+from .processor import CaffeProcessor
+
+
+class DataFrame:
+    """Minimal columnar result set (stand-in for Spark's DataFrame in
+    local mode): list-of-dict rows + schema, json/parquet writers."""
+
+    def __init__(self, rows: List[Dict[str, Any]],
+                 columns: Optional[Sequence[str]] = None):
+        self.rows = rows
+        self.columns = (list(columns) if columns is not None
+                        else (list(rows[0].keys()) if rows else []))
+
+    def __len__(self):
+        return len(self.rows)
+
+    def select(self, *cols) -> "DataFrame":
+        return DataFrame([{c: r[c] for c in cols} for r in self.rows],
+                         cols)
+
+    def collect(self) -> List[Dict[str, Any]]:
+        return self.rows
+
+    def to_arrow(self):
+        import pyarrow as pa
+        return pa.table({c: [r.get(c) for r in self.rows]
+                         for c in self.columns})
+
+    def write(self, path: str, fmt: str = "json") -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        if fmt == "json":
+            with open(path, "w") as f:
+                for r in self.rows:
+                    f.write(json.dumps(r) + "\n")
+        elif fmt == "parquet":
+            import pyarrow.parquet as pq
+            pq.write_table(self.to_arrow(), path)
+        else:
+            raise ValueError(f"outputFormat {fmt!r}")
+
+
+def vector_mean(df: DataFrame, column: str) -> List[float]:
+    """Element-wise mean of a float-array column (VectorMean.scala
+    UDAF analog, used by test())."""
+    arrs = [np.asarray(r[column], np.float64) for r in df.rows]
+    if not arrs:
+        return []
+    return [float(x) for x in np.mean(np.stack(arrs), axis=0)]
+
+
+class CaffeOnSpark:
+    """Driver facade.  `sc` is accepted for API parity; local engine
+    when None or pyspark is unavailable."""
+
+    def __init__(self, sc=None):
+        self.sc = sc
+
+    # ------------------------------------------------------------------
+    def train(self, source: DataSource, conf: Optional[Config] = None
+              ) -> None:
+        """Synchronous training over the mesh (CaffeOnSpark.train).
+        The re-feed loop of the reference (:204-227, feeding the RDD
+        until max_iter) is the processor's looping source feed."""
+        conf = conf or source_conf(source)
+        proc = CaffeProcessor.instance(conf, rank=conf.rank)
+        proc.start()
+        try:
+            self._feed_until_done(proc, source)
+        finally:
+            proc.queues[0].offer(None)
+            proc.join()
+
+    def trainWithValidation(self, source_train: DataSource,
+                            source_validation: DataSource,
+                            conf: Optional[Config] = None) -> DataFrame:
+        """Interleaved train+validation (:239-358): every executor feeds
+        test_interval×batch training records then test_iter×batch
+        validation records, in lockstep; rank 0 records metrics."""
+        conf = conf or source_conf(source_train)
+        sp = conf.solverParameter
+        test_interval = sp.test_interval
+        test_iter = sp.test_iter[0] if sp.test_iter else 0
+        if not test_interval or not test_iter:
+            raise ValueError("trainWithValidation needs test_interval "
+                             "and test_iter in the solver prototxt")
+        proc = CaffeProcessor.instance(conf, rank=conf.rank)
+        proc.interleave_validation = True
+        proc.start()
+        try:
+            train_bs = source_train.batch_size
+            val_bs = source_validation.batch_size
+            train_gen = _record_loop(source_train)
+            val_gen = _record_loop(source_validation)
+            max_iter = sp.max_iter
+            fed = 0
+            while fed < max_iter and proc._thread.is_alive():
+                for _ in range(test_interval * train_bs):
+                    if not proc.feed_queue(0, next(train_gen)):
+                        break
+                fed += test_interval
+                for _ in range(test_iter * val_bs):
+                    if not proc.feed_queue(1, next(val_gen)):
+                        break
+        finally:
+            proc.queues[0].offer(None)
+            proc.join()
+        report = proc.validation
+        rows = report.rounds if report else []
+        return DataFrame(rows, report.names if report else [])
+
+    # ------------------------------------------------------------------
+    def test(self, source: DataSource,
+             conf: Optional[Config] = None) -> Dict[str, List[float]]:
+        """Forward over the test set; per-output mean vectors
+        (:396-418)."""
+        df = self.features2(source, conf)
+        names = [c for c in df.columns if c != "SampleID"]
+        return {n: vector_mean(df, n) for n in names}
+
+    def features(self, source: DataSource,
+                 conf: Optional[Config] = None) -> DataFrame:
+        """Feature extraction → DataFrame(SampleID, blobs...)
+        (:427-438)."""
+        return self.features2(source, conf)
+
+    def features2(self, source: DataSource,
+                  conf: Optional[Config] = None) -> DataFrame:
+        conf = conf or source_conf(source)
+        proc = CaffeProcessor.instance(conf, rank=conf.rank)
+        if conf.features:
+            blob_names = [b.strip() for b in conf.features.split(",")
+                          if b.strip()]
+        else:
+            net = proc.solver.test_net or proc.solver.train_net
+            blob_names = list(net.output_blobs)
+        if conf.label:
+            blob_names.append(conf.label)
+        rows = proc.extract_features(source, blob_names)
+        return DataFrame(rows, ["SampleID"] + blob_names)
+
+    # ------------------------------------------------------------------
+    def _feed_until_done(self, proc: CaffeProcessor,
+                         source: DataSource) -> None:
+        gen = _record_loop(source)
+        while proc._thread is not None and proc._thread.is_alive():
+            if not proc.feed_queue(0, next(gen)):
+                break
+
+
+def _record_loop(source: DataSource):
+    """Endless record generator (the repeated RDD re-feed, :204-227)."""
+    while True:
+        n = 0
+        for rec in source.records():
+            n += 1
+            yield rec
+        if n == 0:
+            raise ValueError("data source produced no records")
+
+
+def source_conf(source: DataSource) -> Config:
+    conf = getattr(source, "_conf", None)
+    if conf is None:
+        raise ValueError("pass conf= explicitly (source has none)")
+    return conf
+
+
+# ---------------------------------------------------------------------------
+# CLI (CaffeOnSpark.main, :27-84)
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    conf = Config(argv if argv is not None else sys.argv[1:])
+    conf.validate()
+    cos = CaffeOnSpark()
+
+    if conf.isTraining:
+        # the trained model is handed to a later -test/-features phase
+        # through the model file, as the reference does via -model
+        if not conf.modelPath:
+            conf.modelPath = os.path.join(conf.outputPath or ".",
+                                          "model.caffemodel")
+        train_layer = conf.train_data_layer()
+        src = get_source(train_layer, phase_train=True, rank=conf.rank,
+                         num_ranks=max(1, conf.clusterSize),
+                         resize=conf.resize)
+        src._conf = conf
+        test_layer = conf.test_data_layer()
+        sp = conf.solverParameter
+        if test_layer is not None and sp.test_interval \
+                and sp.test_iter and sp.test_iter[0]:
+            val_src = get_source(test_layer, phase_train=False,
+                                 rank=conf.rank,
+                                 num_ranks=max(1, conf.clusterSize),
+                                 resize=conf.resize)
+            df = cos.trainWithValidation(src, val_src, conf)
+            if conf.outputPath:
+                df.write(os.path.join(conf.outputPath,
+                                      "validation." + conf.outputFormat),
+                         conf.outputFormat)
+        else:
+            cos.train(src, conf)
+
+    if conf.isTest or conf.features:
+        if conf.isTraining and conf.modelPath \
+                and os.path.exists(conf.modelPath):
+            conf.snapshotModelFile = conf.modelPath
+            conf.snapshotStateFile = ""
+        layer = conf.test_data_layer() or conf.train_data_layer()
+        src = get_source(layer, phase_train=False, rank=conf.rank,
+                         num_ranks=max(1, conf.clusterSize),
+                         resize=conf.resize)
+        src._conf = conf
+        if conf.isTest:
+            result = cos.test(src, conf)
+            out = json.dumps(result)
+            print(out)
+            if conf.outputPath:
+                os.makedirs(conf.outputPath, exist_ok=True)
+                with open(os.path.join(conf.outputPath, "test_result"),
+                          "w") as f:
+                    f.write(out + "\n")
+        else:
+            df = cos.features(src, conf)
+            if conf.outputPath:
+                df.write(os.path.join(conf.outputPath,
+                                      "features." + conf.outputFormat),
+                         conf.outputFormat)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,154 @@
+"""Config: the full CLI flag surface of the reference driver.
+
+Mirrors `caffe-grid/.../Config.scala` — option table :407-437, solver/net
+prototxt parsing on the driver :70-71, train/test data-layer location by
+`include.phase` :73-86, clusterSize derivation :459-474, connection enum
+:227-236.  The connection flag is kept for CLI compatibility but maps to
+the mesh backend (ICI/DCN collectives) — there is no RDMA/SOCKET code to
+select anymore.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Optional
+
+from .proto import (NetParameter, Phase, SolverParameter, read_net,
+                    read_solver)
+
+CONNECTION_NONE = 0
+CONNECTION_MESH = 1      # reference: RDMA (default)
+CONNECTION_SOCKET = 2    # reference: ethernet sockets
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    """Flag table parity with Config.scala:407-437."""
+    p = argparse.ArgumentParser(prog="CaffeOnSparkTPU", add_help=True)
+    a = p.add_argument
+    a("-conf", dest="protoFile", default="",
+      help="solver configuration (prototxt)")
+    a("-train", dest="isTraining", action="store_true",
+      help="training mode")
+    a("-test", dest="isTest", action="store_true", help="test mode")
+    a("-features", dest="features", default="",
+      help="comma-separated blob names for feature extraction")
+    a("-label", dest="label", default="",
+      help="label blob name (feature extraction)")
+    a("-outputFormat", dest="outputFormat", default="json",
+      help="json | parquet")
+    a("-model", dest="modelPath", default="",
+      help="model file path (in/out)")
+    a("-output", dest="outputPath", default="",
+      help="output path for features/test results")
+    a("-devices", dest="devices", type=int, default=0,
+      help="devices per executor (0 = all local)")
+    a("-persistent", dest="isPersistent", action="store_true",
+      help="persist intermediate DataFrames to disk")
+    a("-snapshot", dest="snapshotStateFile", default="",
+      help="solverstate to resume from")
+    a("-weights", dest="snapshotModelFile", default="",
+      help="caffemodel to finetune from")
+    a("-connection", dest="connection", default="",
+      help="ethernet | infiniband (compat; both → mesh collectives)")
+    a("-resize", dest="resize", action="store_true",
+      help="resize images to layer dims")
+    a("-clusterSize", dest="clusterSize", type=int, default=1,
+      help="number of executor processes")
+    a("-lmdb_partitions", dest="lmdb_partitions", type=int, default=0,
+      help="LMDB RDD partitions (default clusterSize)")
+    a("-imageRoot", dest="imageRoot", default="",
+      help="image root dir (conversion tools)")
+    a("-labelFile", dest="labelFile", default="",
+      help="label file (conversion tools)")
+    a("-captionFile", dest="captionFile", default="",
+      help="COCO caption json (tools)")
+    a("-captionLength", dest="captionLength", type=int, default=20,
+      help="max caption length")
+    a("-vocabSize", dest="vocabSize", type=int, default=10000,
+      help="vocabulary size")
+    a("-imageCaptionDFDir", dest="imageCaptionDFDir", default="",
+      help="image-caption dataframe dir")
+    a("-vocabDir", dest="vocabDir", default="",
+      help="vocabulary dir")
+    a("-embeddingDFDir", dest="embeddingDFDir", default="",
+      help="embedding dataframe dir")
+    # mesh extensions (not in the reference)
+    a("-mesh", dest="mesh", default="",
+      help="mesh spec dp[,tp[,sp]] per process")
+    a("-server", dest="server", default="",
+      help="multi-host coordinator host:port")
+    a("-rank", dest="rank", type=int, default=0, help="process rank")
+    return p
+
+
+def resolve_net_path(solver_path: str, net_path: str) -> str:
+    """Resolve the solver's `net:` reference: absolute/cwd-relative, else
+    look next to the solver file (reference configs use repo-relative
+    paths like "CaffeOnSpark/data/...")."""
+    if not os.path.isabs(net_path) and not os.path.exists(net_path):
+        cand = os.path.join(os.path.dirname(os.path.abspath(solver_path)),
+                            os.path.basename(net_path))
+        if os.path.exists(cand):
+            return cand
+    return net_path
+
+
+class Config:
+    """Parsed CLI + solver/net prototxt (driver side)."""
+
+    def __init__(self, args: Optional[List[str]] = None, **overrides):
+        ns, _ = build_argparser().parse_known_args(args or [])
+        for k, v in overrides.items():
+            setattr(ns, k, v)
+        self.args = ns
+        for k in vars(ns):
+            setattr(self, k, getattr(ns, k))
+
+        self.solverParameter: Optional[SolverParameter] = None
+        self.netParam: Optional[NetParameter] = None
+        if self.protoFile:
+            self.solverParameter = read_solver(self.protoFile)
+            self.netParam = read_net(
+                resolve_net_path(self.protoFile, self.solverParameter.net))
+        if self.lmdb_partitions == 0:
+            self.lmdb_partitions = self.clusterSize
+
+    # -- data-layer location by phase (Config.scala:73-86) ---------------
+    def _data_layer_ids(self, phase: int) -> List[int]:
+        out = []
+        if self.netParam is None:
+            return out
+        for i, lyr in enumerate(self.netParam.layer):
+            if lyr.type not in ("MemoryData", "CoSData", "Data"):
+                continue
+            if any(r.has("phase") and r.phase == phase
+                   for r in lyr.include):
+                out.append(i)
+        return out
+
+    @property
+    def train_data_layer_id(self) -> int:
+        ids = self._data_layer_ids(Phase.TRAIN)
+        return ids[0] if ids else -1
+
+    @property
+    def test_data_layer_id(self) -> int:
+        ids = self._data_layer_ids(Phase.TEST)
+        return ids[0] if ids else -1
+
+    def train_data_layer(self):
+        i = self.train_data_layer_id
+        return self.netParam.layer[i] if i >= 0 else None
+
+    def test_data_layer(self):
+        i = self.test_data_layer_id
+        return self.netParam.layer[i] if i >= 0 else None
+
+    # -- validation (Config.scala:459-474 sanity analog) -----------------
+    def validate(self) -> None:
+        if self.snapshotStateFile and not self.snapshotModelFile:
+            raise ValueError(
+                "-snapshot requires -weights (state without model)")
+        if self.isTraining and self.train_data_layer_id < 0:
+            raise ValueError("no TRAIN-phase data layer in net prototxt")

@@ -1,0 +1,222 @@
+"""Standalone cluster trainer — no Spark, pure CLI.
+
+TPU-native analog of `caffe-distri/.../tools/caffe_mini_cluster.cpp`
+(:31-293) + `util/mini_cluster.cpp`: the reference's bring-up harness
+that runs distributed `caffe train` with `-cluster N -server host` rank
+assignment over raw TCP.  Here the rank/address machinery is
+`jax.distributed.initialize` and the sync is the SPMD mesh; the CLI
+surface mirrors the reference's flags:
+
+    python -m caffeonspark_tpu.mini_cluster \
+        -solver lenet_memory_solver.prototxt \
+        [-train /path/override_source] [-net net.prototxt] \
+        [-weights model.caffemodel] [-snapshot state.solverstate] \
+        [-iterations N] [-devices dp[,tp[,sp]]] \
+        [-server host:port -cluster N -rank I]   # multi-host
+
+Signal actions match the reference (`caffe_mini_cluster.cpp:55-60`):
+SIGINT → "stop" (snapshot + exit), SIGHUP → "snapshot" (snapshot +
+continue).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mini_cluster",
+        description="standalone (non-Spark) distributed trainer")
+    p.add_argument("-solver", "-conf", dest="solver", required=True,
+                   help="solver prototxt")
+    p.add_argument("-net", dest="net", default=None,
+                   help="net prototxt (overrides solver's `net:` path)")
+    p.add_argument("-train", dest="train", default=None,
+                   help="override train data source path")
+    p.add_argument("-test", dest="test", default=None,
+                   help="override test data source path")
+    p.add_argument("-weights", dest="weights", default=None,
+                   help=".caffemodel[.h5] to finetune from")
+    p.add_argument("-snapshot", dest="snapshot", default=None,
+                   help=".solverstate[.h5] to resume from")
+    p.add_argument("-iterations", dest="iterations", type=int,
+                   default=None, help="override max_iter")
+    p.add_argument("-devices", dest="devices", default=None,
+                   help="mesh spec dp[,tp[,sp]] (default: all devices dp)")
+    p.add_argument("-model", dest="model", default=None,
+                   help="final model output path")
+    p.add_argument("-output", dest="output", default=".",
+                   help="snapshot output dir")
+    # multi-host (the -server/-cluster flags of the reference tool)
+    p.add_argument("-server", dest="server", default=None,
+                   help="coordinator host:port for multi-host")
+    p.add_argument("-cluster", dest="cluster", type=int, default=None,
+                   help="number of processes")
+    p.add_argument("-rank", dest="rank", type=int, default=None,
+                   help="this process's rank")
+    p.add_argument("-display_every", type=int, default=None,
+                   help="override solver display interval")
+    return p
+
+
+class MiniCluster:
+    def __init__(self, args):
+        import jax
+        from .parallel import ParallelSolver, build_mesh, distributed_init
+        from .proto import read_net, read_solver
+        from .solver import Solver
+
+        distributed_init(args.server, args.cluster, args.rank)
+
+        from .config import resolve_net_path
+        self.sp = read_solver(args.solver)
+        self.net_param = read_net(
+            resolve_net_path(args.solver, args.net or self.sp.net))
+        if args.train or args.test:
+            for lyr in self.net_param.layer:
+                if lyr.type not in ("MemoryData", "CoSData"):
+                    continue
+                is_test = any(r.phase == 1 for r in lyr.include)
+                override = args.test if is_test else args.train
+                if override:
+                    if lyr.has("memory_data_param"):
+                        lyr.memory_data_param.source = override
+                    else:
+                        lyr.cos_data_param.source = override
+        if args.iterations is not None:
+            self.sp.max_iter = args.iterations
+        if args.display_every is not None:
+            self.sp.display = args.display_every
+
+        self.solver = Solver(self.sp, self.net_param,
+                             rank=args.rank or 0)
+        if args.devices:
+            dims = [int(x) for x in args.devices.split(",")]
+            dims += [1] * (3 - len(dims))
+            mesh = build_mesh(dp=dims[0], tp=dims[1], sp=dims[2])
+        else:
+            mesh = build_mesh()
+        self.mesh = mesh
+        self.psolver = ParallelSolver(self.solver, mesh)
+        self.args = args
+        self.prefix = os.path.join(
+            args.output, self.sp.snapshot_prefix or "model")
+        self._stop = False
+        self._want_snapshot = False
+
+    # ------------------------------------------------------------------
+    def _install_signals(self):
+        def on_int(sig, frame):
+            print("\nSIGINT → stop (snapshot + exit)", file=sys.stderr)
+            self._stop = True
+
+        def on_hup(sig, frame):
+            print("SIGHUP → snapshot", file=sys.stderr)
+            self._want_snapshot = True
+
+        signal.signal(signal.SIGINT, on_int)
+        if hasattr(signal, "SIGHUP"):
+            signal.signal(signal.SIGHUP, on_hup)
+
+    # ------------------------------------------------------------------
+    def train(self) -> str:
+        import jax
+        import jax.numpy as jnp
+        from . import checkpoint
+        from .data import get_source
+        from .data.queue_runner import device_prefetch
+
+        solver, ps = self.solver, self.psolver
+        params, st = ps.init()
+        if self.args.snapshot:
+            params = {ln: dict(bl) for ln, bl in params.items()}
+            params, st = checkpoint.restore(
+                solver.train_net, params, st, self.args.snapshot,
+                weights_path=self.args.weights)
+            params = ps.shard_params(params)
+            st = ps.shard_opt_state(st)
+            print(f"resumed from iter {int(jax.device_get(st.iter))}")
+        elif self.args.weights:
+            params = checkpoint.copy_layers(solver.train_net, params,
+                                            self.args.weights)
+            params = ps.shard_params(params)
+            print(f"finetuning from {self.args.weights}")
+
+        data_layers = solver.train_net.data_layers
+        if not data_layers:
+            raise ValueError("train net has no data layer")
+        src = get_source(data_layers[0], phase_train=True,
+                         rank=self.args.rank or 0,
+                         num_ranks=self.args.cluster or 1,
+                         seed=int(self.sp.random_seed)
+                         if self.sp.random_seed >= 0 else 0)
+        step = ps.train_step()
+        self._install_signals()
+
+        max_iter = self.sp.max_iter
+        display = self.sp.display or 0
+        snap_every = self.sp.snapshot or 0
+        it = int(jax.device_get(st.iter))
+        gen = device_prefetch(
+            ({k: v for k, v in b.items()}
+             for b in src.batches(loop=True)), depth=2,
+            sharding=ps.input_shardings())
+        t0 = time.time()
+        smoothed = None
+        while it < max_iter and not self._stop:
+            batch = next(gen)
+            params, st, out = step(params, st, batch, solver.step_rng(it))
+            it += 1
+            if display and it % display == 0:
+                loss = float(jax.device_get(out["loss"]))
+                smoothed = loss if smoothed is None else (
+                    0.9 * smoothed + 0.1 * loss)
+                rate = it / (time.time() - t0)
+                print(f"iter {it}/{max_iter} loss={loss:.4f} "
+                      f"(smoothed {smoothed:.4f}) "
+                      f"lr={float(jax.device_get(out['lr'])):.6f} "
+                      f"[{rate:.1f} it/s]")
+            if (snap_every and it % snap_every == 0) \
+                    or self._want_snapshot:
+                self._want_snapshot = False
+                m, s = checkpoint.snapshot(
+                    solver.train_net, params, st, self.prefix,
+                    fmt=self.sp.snapshot_format)
+                print(f"snapshot → {m}")
+
+        if self._stop:
+            # interrupted: write model + state so -snapshot can resume
+            m, s = checkpoint.snapshot(solver.train_net, params, st,
+                                       self.prefix,
+                                       fmt=self.sp.snapshot_format)
+            print(f"stopped at iter {it}; resume with -snapshot {s}")
+        model_path = self.args.model or checkpoint.snapshot_filename(
+            self.prefix, it, is_state=False,
+            h5=self.sp.snapshot_format == 0)
+        if model_path.endswith(".h5"):
+            from .checkpoint import _save_h5_blobs
+            _save_h5_blobs(model_path, solver.train_net, params)
+        else:
+            checkpoint.save_caffemodel(model_path, solver.train_net,
+                                       params)
+        print(f"final model → {model_path}")
+        self.final_params = params
+        return model_path
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    MiniCluster(args).train()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

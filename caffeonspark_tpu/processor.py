@@ -1,0 +1,317 @@
+"""CaffeProcessor: per-executor training/inference engine.
+
+Mirror of `caffe-grid/.../CaffeProcessor.scala` re-designed for a TPU
+process: a per-process singleton (`instance()`, :20-30) that owns the
+compiled Solver + mesh step, bounded feed queues with STOP_MARK /
+backpressure semantics (:192-198, :205), transformer threads feeding a
+device-prefetch pipe (:254-383 doTransform), a solver loop (:413-471
+doTrain) with interleaved validation (queue 1, :388-411
+updateValidationReport) and rank-0 snapshotting (:454-458), and a
+feature-extraction path (:473-523 doFeatures).
+
+The sync() barrier (:180-189) is retained for API parity; under SPMD it
+only needs to order host-side epochs — collectives themselves are the
+barrier.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import checkpoint
+from .config import Config
+from .data.queue_runner import FeedQueue, device_prefetch
+from .data.source import STOP_MARK, DataSource
+from .parallel import ParallelSolver, build_mesh
+from .solver import Solver
+
+
+class ValidationReport:
+    """Accumulates per-output means over batch × test_iter
+    (updateValidationReport analog)."""
+
+    def __init__(self, names: Sequence[str]):
+        self.names = list(names)
+        self.rounds: List[Dict[str, float]] = []
+        self._acc: Dict[str, float] = {}
+        self._n = 0
+
+    def add_batch(self, outputs: Dict[str, Any]):
+        for n in self.names:
+            v = float(np.mean(np.asarray(outputs[n])))
+            self._acc[n] = self._acc.get(n, 0.0) + v
+        self._n += 1
+
+    def finish_round(self):
+        if self._n:
+            self.rounds.append({n: self._acc[n] / self._n
+                                for n in self.names})
+        self._acc, self._n = {}, 0
+
+
+class CaffeProcessor:
+    _instance: Optional["CaffeProcessor"] = None
+
+    # -- singleton protocol (CaffeProcessor.scala:20-30) -----------------
+    @classmethod
+    def instance(cls, conf: Optional[Config] = None, rank: int = 0
+                 ) -> "CaffeProcessor":
+        if conf is not None:
+            # same Config object → same processor (so train() followed by
+            # features()/test() keeps the in-memory trained params)
+            if cls._instance is not None and cls._instance.conf is conf:
+                return cls._instance
+            if cls._instance is not None:
+                cls._instance.stop()
+            cls._instance = cls(conf, rank)
+        assert cls._instance is not None, "processor not started"
+        return cls._instance
+
+    def __init__(self, conf: Config, rank: int = 0):
+        from .data.source import get_source
+        self.conf = conf
+        self.rank = rank
+        self.solver = Solver(conf.solverParameter, conf.netParam,
+                             rank=rank)
+        if conf.mesh:
+            dims = [int(x) for x in conf.mesh.split(",")]
+            dims += [1] * (3 - len(dims))
+            mesh = build_mesh(dp=dims[0], tp=dims[1], sp=dims[2])
+        else:
+            mesh = build_mesh()
+        self.psolver = ParallelSolver(self.solver, mesh)
+        self.queues = [FeedQueue(), FeedQueue()]   # 0 train, 1 validation
+        self.results: List[Dict[str, Any]] = []
+        self.validation: Optional[ValidationReport] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._stopped = False
+        # set by trainWithValidation: only then does anyone feed queue 1
+        self.interleave_validation = False
+        self.params = None
+        self.opt_state = None
+
+        seed = int(conf.solverParameter.random_seed) \
+            if conf.solverParameter.random_seed >= 0 else 0
+        self._source_kw = dict(rank=rank,
+                               num_ranks=max(1, conf.clusterSize),
+                               seed=seed, resize=conf.resize)
+        tl = conf.train_data_layer()
+        self.train_source: Optional[DataSource] = (
+            get_source(tl, phase_train=True, **self._source_kw)
+            if tl is not None and conf.isTraining else None)
+        vl = conf.test_data_layer()
+        self.val_source: Optional[DataSource] = (
+            get_source(vl, phase_train=False, **self._source_kw)
+            if vl is not None else None)
+
+    # -- queue API (feedQueue backpressure, :192-198) --------------------
+    def feed_queue(self, idx: int, sample) -> bool:
+        return self.queues[idx].offer(sample)
+
+    def mark_epoch_end(self, idx: int = 0):
+        self.queues[idx].mark_epoch_end()
+
+    def sync(self):
+        """Cluster barrier analog — host-side ordering only."""
+        return True
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        self._init_params()
+        self._thread = threading.Thread(target=self._run_train,
+                                        daemon=True)
+        self._thread.start()
+
+    def _init_params(self):
+        if self.params is not None:
+            return
+        params, st = self.psolver.init()
+        conf = self.conf
+        if conf.snapshotStateFile:
+            params, st = checkpoint.restore(
+                self.solver.train_net, params, st,
+                conf.snapshotStateFile,
+                weights_path=conf.snapshotModelFile or None)
+            params = self.psolver.shard_params(params)
+            st = self.psolver.shard_opt_state(st)
+        elif conf.snapshotModelFile:
+            params = checkpoint.copy_layers(
+                self.solver.train_net, params, conf.snapshotModelFile)
+            params = self.psolver.shard_params(params)
+        self.params, self.opt_state = params, st
+
+    def stop(self):
+        self._stopped = True
+        for q in self.queues:
+            q.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=600)
+            self._thread = None
+        CaffeProcessor._instance = None
+        if self._error is not None:
+            raise self._error
+
+    def join(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    # -- training loop (doTrain, :413-471) -------------------------------
+    def _train_batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        assert self.train_source is not None
+        src = self.train_source
+        buf: List = []
+        while not self._stopped:
+            try:
+                item = self.queues[0].take(timeout=1.0)
+            except queue.Empty:
+                continue
+            if item is STOP_MARK:
+                buf = []       # epoch boundary: drop ragged tail
+                continue
+            if item is None:
+                return         # terminal sentinel
+            buf.append(item)
+            if len(buf) == src.batch_size:
+                yield src.next_batch(buf)
+                buf = []
+
+    def _run_train(self):
+        try:
+            import jax
+            solver, ps = self.solver, self.psolver
+            step = ps.train_step()
+            eval_step = (ps.eval_step()
+                         if solver.test_net is not None else None)
+            sp = solver.param
+            test_interval = sp.test_interval
+            test_iter = solver.test_iter
+            snap = sp.snapshot or 0
+            max_iter = sp.max_iter
+            if eval_step is not None and solver.test_net is not None:
+                self.validation = ValidationReport(
+                    solver.test_net.output_blobs)
+            it = int(jax.device_get(self.opt_state.iter))
+            gen = device_prefetch(self._train_batches(), depth=2,
+                                  sharding=ps.input_shardings())
+            params, st = self.params, self.opt_state
+            for batch in gen:
+                params, st, out = step(params, st, batch,
+                                       solver.step_rng(it))
+                it += 1
+                # interleaved validation: rank-0 records, all ranks step
+                if self.interleave_validation and test_interval \
+                        and it % test_interval == 0 \
+                        and eval_step is not None and test_iter:
+                    self._run_validation(eval_step, params, test_iter)
+                if snap and it % snap == 0 and self.rank == 0:
+                    self.params, self.opt_state = params, st
+                    self._snapshot()
+                if it >= max_iter:
+                    break
+            self.params, self.opt_state = params, st
+            if self.rank == 0:
+                self._snapshot(final=True)
+        except BaseException as e:     # surfaced on stop()/join()
+            self._error = e
+        finally:
+            # unblock feeders spinning in offer() (backpressure release)
+            for q in self.queues:
+                q.stop()
+
+    def _run_validation(self, eval_step, params, test_iter: int):
+        assert self.val_source is not None
+        src = self.val_source
+        buf: List = []
+        done = 0
+        while done < test_iter and not self._stopped:
+            try:
+                item = self.queues[1].take(timeout=30.0)
+            except queue.Empty:
+                break
+            if item is STOP_MARK or item is None:
+                continue
+            buf.append(item)
+            if len(buf) == src.batch_size:
+                out = eval_step(params, {
+                    k: v for k, v in src.next_batch(buf).items()})
+                self.validation.add_batch(out)
+                buf = []
+                done += 1
+        self.validation.finish_round()
+
+    def _snapshot(self, final: bool = False):
+        conf = self.conf
+        prefix = os.path.join(conf.outputPath or ".",
+                              conf.solverParameter.snapshot_prefix
+                              or "model")
+        m, s = checkpoint.snapshot(
+            self.solver.train_net, self.params, self.opt_state, prefix,
+            fmt=conf.solverParameter.snapshot_format)
+        if final and conf.modelPath:
+            checkpoint.save_caffemodel(conf.modelPath,
+                                       self.solver.train_net,
+                                       self.params)
+
+    # -- feature extraction (doFeatures, :473-523) ------------------------
+    def extract_features(self, source: DataSource,
+                         blob_names: Sequence[str]
+                         ) -> List[Dict[str, Any]]:
+        import jax
+        self._init_params()
+        net = self.solver.test_net or self.solver.train_net
+
+        # predict(blobNames) semantics (CaffeNet.cpp:677-697): forward,
+        # then read ANY named blob — not just the net outputs
+        @jax.jit
+        def fwd(params, inputs):
+            blobs, _ = net.apply(params, inputs, train=False)
+            return {bn: blobs[bn] for bn in blob_names}
+        rows: List[Dict[str, Any]] = []
+        buf: List = []
+        ids: List[str] = []
+
+        def flush(real: int):
+            """Run one batch and emit `real` rows (one device_get per
+            blob, not per row — aggregated scalar outputs like Accuracy
+            repeat per row, CaffeOnSpark.scala:499-507)."""
+            nonlocal buf, ids
+            bs = len(buf)
+            out = fwd(self.params, source.next_batch(buf))
+            fetched = {bn: np.asarray(jax.device_get(out[bn]))
+                       for bn in blob_names}
+            for i in range(real):
+                row: Dict[str, Any] = {"SampleID": ids[i]}
+                for bn, arr in fetched.items():
+                    if arr.ndim == 0:
+                        row[bn] = [float(arr)]
+                    else:
+                        per = arr.reshape(bs, -1) if arr.shape[0] == bs \
+                            else np.repeat(arr.reshape(1, -1), bs, 0)
+                        row[bn] = [float(x) for x in per[i]]
+                rows.append(row)
+            buf, ids = [], []
+
+        for rec in source.records():
+            buf.append(rec)
+            ids.append(str(rec[0]) if isinstance(rec, tuple)
+                       else str(rec.get("id", len(ids))))
+            if len(buf) == source.batch_size:
+                flush(real=len(buf))
+        if buf:
+            # ragged tail: pad to full batch (static shapes), trim rows
+            real = len(buf)
+            pad = source.batch_size - real
+            buf += [buf[-1]] * pad
+            ids += [ids[-1]] * pad
+            flush(real=real)
+        return rows

@@ -215,7 +215,11 @@ class Message(metaclass=_MessageMeta):
         if f is None:
             raise AttributeError(f"{type(self).__name__} has no field {name!r}")
         if f.repeated and not isinstance(value, list):
-            value = list(value)
+            # numpy arrays are kept as-is for packed float/double fields
+            # (materializing 60M PyFloats for a caffemodel is pathological)
+            if not (f.packed and f.ftype in (FLOAT, DOUBLE)
+                    and type(value).__name__ == "ndarray"):
+                value = list(value)
         if f.ftype == ENUM and not f.repeated and isinstance(value, str):
             value = f.enum.value(value)
         self._values[name] = value
@@ -281,8 +285,12 @@ class Message(metaclass=_MessageMeta):
                     out.append(f'{pad}{f.name}: "{esc}"\n')
                 elif f.ftype == BOOL:
                     out.append(f"{pad}{f.name}: {'true' if v else 'false'}\n")
+                elif f.ftype in (FLOAT, DOUBLE):
+                    # float() coercion: v may be a numpy scalar whose repr
+                    # ('np.float32(x)') would not re-parse
+                    out.append(f"{pad}{f.name}: {float(v)!r}\n")
                 else:
-                    out.append(f"{pad}{f.name}: {v!r}\n")
+                    out.append(f"{pad}{f.name}: {int(v)!r}\n")
         return "".join(out)
 
     @classmethod
@@ -303,11 +311,19 @@ class Message(metaclass=_MessageMeta):
             if not f.repeated:
                 vals = [vals]
             if f.packed and f.repeated and f.ftype != MESSAGE:
-                payload = io.BytesIO()
-                for v in vals:
-                    _write_scalar(payload, f, v)
+                if f.ftype in (FLOAT, DOUBLE):
+                    # numpy fast path: 60M-param caffemodels would take
+                    # minutes through per-float struct.pack
+                    import numpy as _np
+                    b = _np.asarray(
+                        vals, "<f4" if f.ftype == FLOAT else "<f8"
+                    ).tobytes()
+                else:
+                    payload = io.BytesIO()
+                    for v in vals:
+                        _write_scalar(payload, f, v)
+                    b = payload.getvalue()
                 _write_key(out, f.num, _WT_LEN)
-                b = payload.getvalue()
                 _write_varint(out, len(b))
                 out.write(b)
                 continue
@@ -369,6 +385,20 @@ class Message(metaclass=_MessageMeta):
                     self._append(f, bytes(chunk).decode("utf-8", "replace"))
                 elif f.ftype == BYTES:
                     self._append(f, bytes(chunk))
+                elif (f.ftype == FLOAT and ln % 4 == 0) \
+                        or (f.ftype == DOUBLE and ln % 8 == 0):
+                    # packed float/double: bulk numpy decode, stored as
+                    # an ndarray (list-compatible for our consumers)
+                    import numpy as _np
+                    arr = _np.frombuffer(
+                        chunk, "<f4" if f.ftype == FLOAT else "<f8"
+                    ).copy()
+                    prev = self._values.get(f.name)
+                    if prev is None or len(prev) == 0:
+                        self._values[f.name] = arr
+                    else:
+                        self._values[f.name] = _np.concatenate(
+                            [_np.asarray(prev, arr.dtype), arr])
                 else:
                     # packed repeated scalars
                     p = 0
@@ -395,7 +425,14 @@ class Message(metaclass=_MessageMeta):
 
     def _append(self, f: Field, v: Any) -> None:
         if f.repeated:
-            self._values.setdefault(f.name, []).append(v)
+            cur = self._values.get(f.name)
+            if cur is None:
+                self._values[f.name] = [v]
+            elif isinstance(cur, list):
+                cur.append(v)
+            else:  # ndarray from a packed fast-path decode; spec allows
+                   # packed and unpacked elements interleaved
+                self._values[f.name] = list(cur) + [v]
         else:
             self._values[f.name] = v
 

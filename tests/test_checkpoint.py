@@ -1,0 +1,178 @@
+"""Checkpoint/resume/finetune tests + the mini_cluster CLI end-to-end."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from caffeonspark_tpu import checkpoint
+from caffeonspark_tpu.data.synthetic import batches, make_images
+from caffeonspark_tpu.net import Net
+from caffeonspark_tpu.proto import (NetParameter, SolverParameter)
+from caffeonspark_tpu.proto.caffe import Datum, SnapshotFormat
+from caffeonspark_tpu.solver import Solver
+
+NET = """
+name: "tiny"
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 8 channels: 1 height: 12 width: 12 } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3
+    weight_filler { type: "xavier" } } }
+layer { name: "relu" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "ip" type: "InnerProduct" bottom: "conv1" top: "ip"
+  inner_product_param { num_output: 10 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+  top: "loss" }
+"""
+
+SOLVER = """
+base_lr: 0.01
+momentum: 0.9
+lr_policy: "fixed"
+max_iter: 50
+random_seed: 5
+"""
+
+
+def _trained(iters=5):
+    s = Solver(SolverParameter.from_text(SOLVER),
+               NetParameter.from_text(NET))
+    params, st = s.init()
+    step = s.jit_train_step()
+    gen = batches(64, 8, seed=1, scale=1 / 256.0, height=12, width=12)
+    for i in range(iters):
+        d, l = next(gen)
+        params, st, _ = step(params, st,
+                             {"data": jnp.asarray(d),
+                              "label": jnp.asarray(l)}, s.step_rng(i))
+    return s, params, st
+
+
+@pytest.mark.parametrize("fmt", [SnapshotFormat.BINARYPROTO,
+                                 SnapshotFormat.HDF5])
+def test_snapshot_restore_round_trip(tmp_path, fmt):
+    s, params, st = _trained()
+    prefix = str(tmp_path / "snap")
+    model_path, state_path = checkpoint.snapshot(
+        s.train_net, params, st, prefix, fmt=fmt)
+    assert f"_iter_5." in model_path
+    assert os.path.exists(model_path) and os.path.exists(state_path)
+
+    s2 = Solver(SolverParameter.from_text(SOLVER),
+                NetParameter.from_text(NET))
+    p2, st2 = s2.init()
+    p2, st2 = checkpoint.restore(s2.train_net, p2, st2, state_path)
+    assert int(jax.device_get(st2.iter)) == 5
+    for ln in params:
+        for bn in params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(params[ln][bn])),
+                np.asarray(jax.device_get(p2[ln][bn])), rtol=1e-6)
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(st.history[ln][bn])),
+                np.asarray(jax.device_get(st2.history[ln][bn])),
+                rtol=1e-6)
+    # training continues identically after resume
+    step1 = s.jit_train_step()
+    step2 = s2.jit_train_step()
+    gen = batches(64, 8, seed=2, scale=1 / 256.0, height=12, width=12)
+    d, l = next(gen)
+    b = {"data": jnp.asarray(d), "label": jnp.asarray(l)}
+    pa, _, o1 = step1(params, st, b, s.step_rng(5))
+    pb, _, o2 = step2(p2, st2, b, s2.step_rng(5))
+    assert float(o1["loss"]) == pytest.approx(float(o2["loss"]), rel=1e-6)
+
+
+def test_finetune_copy_layers(tmp_path):
+    s, params, st = _trained()
+    mp = str(tmp_path / "weights.caffemodel")
+    checkpoint.save_caffemodel(mp, s.train_net, params)
+    # a DIFFERENT net sharing conv1 but with a new head
+    net2 = NET.replace('num_output: 10', 'num_output: 3').replace(
+        '"tiny"', '"tiny2"')
+    s2 = Solver(SolverParameter.from_text(SOLVER),
+                NetParameter.from_text(net2))
+    p2, _ = s2.init()
+    p3 = checkpoint.copy_layers(s2.train_net, p2, mp)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(params["conv1"]["weight"])),
+        np.asarray(jax.device_get(p3["conv1"]["weight"])), rtol=1e-6)
+    # mismatched head untouched
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(p2["ip"]["weight"])),
+        np.asarray(jax.device_get(p3["ip"]["weight"])))
+
+
+def test_state_without_model_errors(tmp_path):
+    s, params, st = _trained()
+    prefix = str(tmp_path / "x")
+    model_path, state_path = checkpoint.snapshot(s.train_net, params, st,
+                                                prefix)
+    os.unlink(model_path)
+    s2 = Solver(SolverParameter.from_text(SOLVER),
+                NetParameter.from_text(NET))
+    p2, st2 = s2.init()
+    with pytest.raises(ValueError, match="state without model"):
+        checkpoint.restore(s2.train_net, p2, st2, state_path)
+
+
+def test_mini_cluster_cli(tmp_path):
+    """The standalone CLI trainer end-to-end on an LMDB."""
+    from caffeonspark_tpu.data import LmdbWriter
+    imgs, labels = make_images(64, seed=3)
+    recs = [(b"%06d" % i,
+             Datum(channels=1, height=28, width=28,
+                   data=(imgs[i, 0] * 255).astype(np.uint8).tobytes(),
+                   label=int(labels[i])).to_binary())
+            for i in range(64)]
+    LmdbWriter(str(tmp_path / "lmdb")).write(recs)
+
+    solver_txt = tmp_path / "solver.prototxt"
+    net_txt = tmp_path / "net.prototxt"
+    net_txt.write_text(open(
+        "/root/reference/data/lenet_memory_train_test.prototxt").read()
+        if os.path.exists(
+            "/root/reference/data/lenet_memory_train_test.prototxt")
+        else NET)
+    solver_txt.write_text(f"""
+net: "{net_txt}"
+base_lr: 0.01
+momentum: 0.9
+lr_policy: "inv"
+gamma: 0.0001
+power: 0.75
+display: 5
+max_iter: 12
+snapshot: 10
+snapshot_prefix: "m"
+random_seed: 7
+""")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": "",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    r = subprocess.run(
+        [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
+         "-solver", str(solver_txt), "-train", str(tmp_path / "lmdb"),
+         "-output", str(tmp_path)],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd="/root/repo")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "iter 10/12" in r.stdout or "iter 5/12" in r.stdout
+    assert os.path.exists(tmp_path / "m_iter_10.caffemodel")
+    assert "final model" in r.stdout
+    # resume from the snapshot
+    r2 = subprocess.run(
+        [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
+         "-solver", str(solver_txt), "-train", str(tmp_path / "lmdb"),
+         "-output", str(tmp_path),
+         "-snapshot", str(tmp_path / "m_iter_10.solverstate"),
+         "-iterations", "15"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd="/root/repo")
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert "resumed from iter 10" in r2.stdout
