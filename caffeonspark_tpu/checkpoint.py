@@ -170,10 +170,13 @@ def snapshot(net: Net, params: Params, opt_state: OptState, prefix: str,
         save_caffemodel(model_path, net, params)
 
     st = SolverState(iter=it, learned_net=os.path.basename(model_path))
-    for lname, specs in net.param_layout.items():
-        for bname, _, _ in specs:
-            st.history.append(_to_blobproto(np.asarray(
-                jax.device_get(opt_state.history[lname][bname]))))
+    # history blobs, then second-moment blobs (Adam/AdaDelta/RMSProp) —
+    # restore() splits the doubled list back
+    for hist in (opt_state.history, opt_state.history2):
+        for lname, specs in net.param_layout.items():
+            for bname, _, _ in specs:
+                st.history.append(_to_blobproto(np.asarray(
+                    jax.device_get(hist[lname][bname]))))
     if h5:
         import h5py
         with h5py.File(state_path, "w") as f:
@@ -220,14 +223,18 @@ def restore(net: Net, params: Params, opt_state: OptState,
                          "without model is an error")
     params = copy_layers(net, params, weights_path)
 
+    n_blobs = sum(len(specs) for specs in net.param_layout.values())
     history = {ln: dict(bl) for ln, bl in opt_state.history.items()}
+    history2 = {ln: dict(bl) for ln, bl in opt_state.history2.items()}
     i = 0
-    for lname, specs in net.param_layout.items():
-        for bname, shape, _ in specs:
-            if i < len(hist) and hist[i].size == int(np.prod(shape)):
-                history[lname][bname] = jnp.asarray(
-                    hist[i].reshape(shape))
-            i += 1
+    for dest in (history, history2):
+        for lname, specs in net.param_layout.items():
+            for bname, shape, _ in specs:
+                if i < len(hist) and hist[i].size == int(np.prod(shape)):
+                    dest[lname][bname] = jnp.asarray(
+                        hist[i].reshape(shape))
+                i += 1
+        if len(hist) < 2 * n_blobs:
+            break  # old snapshot without second moments
     return params, OptState(iter=jnp.asarray(it, jnp.int32),
-                            history=history,
-                            history2=opt_state.history2)
+                            history=history, history2=history2)

@@ -125,6 +125,8 @@ class Config:
             if any(r.has("phase") and r.phase == phase
                    for r in lyr.include):
                 out.append(i)
+            elif not lyr.include:   # no rules → layer is in every phase
+                out.append(i)
         return out
 
     @property

@@ -47,6 +47,15 @@ class FeedQueue:
                 if timeout is not None:
                     return False
 
+    def reset(self):
+        """Re-arm a stopped queue (processor restart) and drop leftovers."""
+        self._stopped = False
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                return
+
     def mark_epoch_end(self):
         self._q.put(STOP_MARK)
 

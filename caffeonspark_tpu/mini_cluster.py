@@ -107,6 +107,7 @@ class MiniCluster:
         self.mesh = mesh
         self.psolver = ParallelSolver(self.solver, mesh)
         self.args = args
+        self._is_rank0 = (args.rank or 0) == 0
         self.prefix = os.path.join(
             args.output, self.sp.snapshot_prefix or "model")
         self._stop = False
@@ -165,10 +166,8 @@ class MiniCluster:
         display = self.sp.display or 0
         snap_every = self.sp.snapshot or 0
         it = int(jax.device_get(st.iter))
-        gen = device_prefetch(
-            ({k: v for k, v in b.items()}
-             for b in src.batches(loop=True)), depth=2,
-            sharding=ps.input_shardings())
+        gen = device_prefetch(src.batches(loop=True), depth=2,
+                              sharding=ps.input_shardings())
         t0 = time.time()
         smoothed = None
         while it < max_iter and not self._stop:
@@ -184,30 +183,31 @@ class MiniCluster:
                       f"(smoothed {smoothed:.4f}) "
                       f"lr={float(jax.device_get(out['lr'])):.6f} "
                       f"[{rate:.1f} it/s]")
-            if (snap_every and it % snap_every == 0) \
-                    or self._want_snapshot:
+            if ((snap_every and it % snap_every == 0)
+                    or self._want_snapshot) and self._is_rank0:
                 self._want_snapshot = False
                 m, s = checkpoint.snapshot(
                     solver.train_net, params, st, self.prefix,
                     fmt=self.sp.snapshot_format)
                 print(f"snapshot → {m}")
 
-        if self._stop:
-            # interrupted: write model + state so -snapshot can resume
-            m, s = checkpoint.snapshot(solver.train_net, params, st,
-                                       self.prefix,
-                                       fmt=self.sp.snapshot_format)
-            print(f"stopped at iter {it}; resume with -snapshot {s}")
         model_path = self.args.model or checkpoint.snapshot_filename(
             self.prefix, it, is_state=False,
             h5=self.sp.snapshot_format == 0)
-        if model_path.endswith(".h5"):
-            from .checkpoint import _save_h5_blobs
-            _save_h5_blobs(model_path, solver.train_net, params)
-        else:
-            checkpoint.save_caffemodel(model_path, solver.train_net,
-                                       params)
-        print(f"final model → {model_path}")
+        if self._is_rank0:  # snapshots are rank-0-only (SURVEY §5.4)
+            if self._stop:
+                # interrupted: write model + state so -snapshot resumes
+                m, s = checkpoint.snapshot(solver.train_net, params, st,
+                                           self.prefix,
+                                           fmt=self.sp.snapshot_format)
+                print(f"stopped at iter {it}; resume with -snapshot {s}")
+            if model_path.endswith(".h5"):
+                from .checkpoint import _save_h5_blobs
+                _save_h5_blobs(model_path, solver.train_net, params)
+            else:
+                checkpoint.save_caffemodel(model_path, solver.train_net,
+                                           params)
+            print(f"final model → {model_path}")
         self.final_params = params
         return model_path
 

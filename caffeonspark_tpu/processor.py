@@ -78,12 +78,16 @@ class CaffeProcessor:
         self.rank = rank
         self.solver = Solver(conf.solverParameter, conf.netParam,
                              rank=rank)
+        import jax
+        devices = (jax.devices()[:conf.devices] if conf.devices > 0
+                   else None)  # -devices limits local devices
         if conf.mesh:
             dims = [int(x) for x in conf.mesh.split(",")]
             dims += [1] * (3 - len(dims))
-            mesh = build_mesh(dp=dims[0], tp=dims[1], sp=dims[2])
+            mesh = build_mesh(dp=dims[0], tp=dims[1], sp=dims[2],
+                              devices=devices)
         else:
-            mesh = build_mesh()
+            mesh = build_mesh(devices=devices)
         self.psolver = ParallelSolver(self.solver, mesh)
         self.queues = [FeedQueue(), FeedQueue()]   # 0 train, 1 validation
         self.results: List[Dict[str, Any]] = []
@@ -124,6 +128,8 @@ class CaffeProcessor:
     # -- lifecycle -------------------------------------------------------
     def start(self):
         self._init_params()
+        for q in self.queues:       # re-arm after a previous run stopped
+            q.reset()
         self._thread = threading.Thread(target=self._run_train,
                                         daemon=True)
         self._thread.start()
@@ -242,8 +248,7 @@ class CaffeProcessor:
                 continue
             buf.append(item)
             if len(buf) == src.batch_size:
-                out = eval_step(params, {
-                    k: v for k, v in src.next_batch(buf).items()})
+                out = eval_step(params, src.next_batch(buf))
                 self.validation.add_batch(out)
                 buf = []
                 done += 1

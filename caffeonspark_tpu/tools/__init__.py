@@ -1,0 +1,8 @@
+"""Dataset conversion tools (Binary2Sequence/DataFrame, LMDB2*, COCO
+caption pipeline, Vocab) — the reference's L6 tools layer."""
+
+from .conversions import (coco_to_image_caption, embedding_to_caption,
+                          image_caption_to_embedding)
+from .converters import (binary2dataframe, binary2sequence,
+                         lmdb2dataframe, lmdb2sequence, sequence2lmdb)
+from .vocab import Vocab

@@ -1,0 +1,102 @@
+"""COCO caption pipeline conversions.
+
+Parity with `caffe-grid/.../tools/Conversions.scala`:
+  * `coco_to_image_caption` (:31-87 Coco2ImageCaptionFile): COCO
+    annotation json + image dir → caption DataFrame
+    (id, image path/bytes, caption)
+  * `image_caption_to_embedding` (:146-207 ImageCaption2Embedding):
+    caption DF + Vocab → embedding DataFrame with the LRCN training
+    arrays — input_sentence = [0, w1..wN] (start marker then words),
+    target_sentence = [w1..wN, 0] (words then end marker),
+    cont_sentence = [0, 1, 1, ...] (0 marks sequence start), each
+    padded/truncated to caption_length+1
+  * `embedding_to_caption` (:209-229 Embedding2Caption): inverse mapping
+    for round-trip checks / display
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional
+
+from .vocab import START_END_ID, Vocab
+
+
+def coco_to_image_caption(annotation_json: str, image_root: str,
+                          output_path: Optional[str] = None,
+                          *, embed_image_bytes: bool = True) -> List[Dict]:
+    """COCO captions_*.json → rows (id, image, height, width, caption).
+    Writes parquet when output_path is given."""
+    with open(annotation_json) as f:
+        coco = json.load(f)
+    images = {im["id"]: im for im in coco.get("images", [])}
+    rows: List[Dict] = []
+    for ann in coco.get("annotations", []):
+        im = images.get(ann["image_id"])
+        if im is None:
+            continue
+        row = {"id": str(ann["image_id"]),
+               "height": int(im.get("height", 0)),
+               "width": int(im.get("width", 0)),
+               "caption": ann["caption"]}
+        fname = os.path.join(image_root, im["file_name"])
+        if embed_image_bytes and os.path.exists(fname):
+            with open(fname, "rb") as imf:
+                row["data"] = imf.read()
+        else:
+            row["data"] = b""
+        rows.append(row)
+    if output_path:
+        _write_parquet(rows, output_path)
+    return rows
+
+
+def image_caption_to_embedding(caption_rows: Iterable[Dict], vocab: Vocab,
+                               caption_length: int = 20,
+                               output_path: Optional[str] = None
+                               ) -> List[Dict]:
+    """Caption rows → LRCN embedding rows with input/cont/target arrays
+    of length caption_length+1."""
+    length = caption_length + 1
+    out: List[Dict] = []
+    for row in caption_rows:
+        ids = vocab.encode(row["caption"])[:caption_length]
+        n = len(ids)
+        # target padding is -1 so the loss can ignore_label: -1 — with 0
+        # padding, position 0 (cont=0, input=START) would be identical to
+        # padded positions and the model would learn to emit END first
+        # (lrcn_cos.prototxt cross_entropy_loss loss_param)
+        input_sentence = [START_END_ID] + ids + [0] * (length - n - 1)
+        target_sentence = ids + [START_END_ID] + [-1] * (length - n - 1)
+        cont_sentence = [0] + [1] * n + [0] * (length - n - 1)
+        erow = dict(row)
+        erow.pop("caption", None)
+        erow.update(input_sentence=input_sentence,
+                    target_sentence=target_sentence,
+                    cont_sentence=cont_sentence,
+                    label=0.0)
+        out.append(erow)
+    if output_path:
+        _write_parquet(out, output_path)
+    return out
+
+
+def embedding_to_caption(embedding_rows: Iterable[Dict], vocab: Vocab
+                         ) -> List[Dict]:
+    """Inverse: target_sentence ids → caption text (round-trip check)."""
+    out = []
+    for row in embedding_rows:
+        out.append({"id": row.get("id"),
+                    "caption": vocab.decode(row["target_sentence"])})
+    return out
+
+
+def _write_parquet(rows: List[Dict], path: str) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    cols = {}
+    for k in rows[0].keys():
+        cols[k] = [r.get(k) for r in rows]
+    pq.write_table(pa.table(cols), path)

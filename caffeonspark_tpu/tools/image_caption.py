@@ -1,0 +1,82 @@
+"""Image-caption inference: greedy decoding over a trained LRCN model.
+
+Analog of `caffe-grid/src/main/python/examples/ImageCaption.py` (pyCaffe
+LSTM caption inference, SURVEY §2.8) re-expressed functionally: instead
+of stepping a stateful net one timestep at a time, each decode step runs
+the jitted full-sequence forward on the padded prefix (cont-gated, so
+positions past the prefix are inert) and reads the prediction at the
+last real position.  One fixed shape ⇒ one XLA compilation, reused for
+every step and batch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..net import Net
+from ..proto.caffe import NetParameter, NetState, Phase
+from .vocab import START_END_ID, Vocab
+
+
+def greedy_caption(net: Net, params, image_features: np.ndarray, *,
+                   prob_blob: str = "probs", input_blob: str = "input_sentence",
+                   cont_blob: str = "cont_sentence",
+                   feature_blob: str = "image_features",
+                   max_length: int = 20,
+                   vocab: Optional[Vocab] = None) -> List[List[int]]:
+    """Generate captions for a batch of image feature vectors.
+
+    net: compiled deploy net (lrcn_word_to_preds.deploy.prototxt shape):
+      inputs  input_sentence (T, B), cont_sentence (T, B),
+              image_features (B, F)
+      output  prob_blob (T, B, V)
+    Returns per-image id sequences (END_ID-terminated, excluded)."""
+    import jax
+    import jax.numpy as jnp
+
+    b = image_features.shape[0]
+    t_max = max_length + 1
+
+    @jax.jit
+    def forward(p, inp):
+        blobs, _ = net.apply(p, inp, train=False)
+        return blobs[prob_blob]
+
+    ids = np.zeros((b, t_max), np.int64)      # step 0 = START marker (0)
+    done = np.zeros((b,), bool)
+    for t in range(1, t_max):
+        # cont[pos] = 0 at pos 0 (sequence start), 1 for the live prefix,
+        # 0 beyond it (inert padded tail)
+        tpos = np.arange(t_max)[:, None]
+        cont = ((tpos > 0) & (tpos < t)).astype(np.float32)
+        cont = np.broadcast_to(cont, (t_max, b))
+        inputs = {
+            input_blob: jnp.asarray(ids.T, jnp.float32),
+            cont_blob: jnp.asarray(cont),
+            feature_blob: jnp.asarray(image_features, jnp.float32),
+        }
+        probs = np.asarray(jax.device_get(forward(params, inputs)))
+        nxt = probs[t - 1].argmax(axis=-1)     # (B,)
+        nxt = np.where(done, 0, nxt)
+        ids[:, t] = nxt
+        done |= nxt == START_END_ID
+        if done.all():
+            break
+
+    out: List[List[int]] = []
+    for i in range(b):
+        seq = []
+        for t in range(1, t_max):
+            w = int(ids[i, t])
+            if w == START_END_ID:
+                break
+            seq.append(w)
+        out.append(seq)
+    return out
+
+
+def captions_to_text(id_seqs: Sequence[Sequence[int]], vocab: Vocab
+                     ) -> List[str]:
+    return [vocab.decode(seq) for seq in id_seqs]

@@ -1,0 +1,144 @@
+"""Tool tests — ToolTest.scala analog: converter row counts and the COCO
+caption → vocab → embedding → caption round trip (:86-137)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from caffeonspark_tpu.data import (LmdbReader, LmdbWriter,
+                                   SequenceFileReader)
+from caffeonspark_tpu.data.synthetic import make_images
+from caffeonspark_tpu.proto.caffe import Datum
+from caffeonspark_tpu.tools import (Vocab, binary2dataframe,
+                                    binary2sequence, coco_to_image_caption,
+                                    embedding_to_caption,
+                                    image_caption_to_embedding,
+                                    lmdb2dataframe, lmdb2sequence,
+                                    sequence2lmdb)
+
+CAPTIONS = [
+    "a dog runs across the green park",
+    "a cat sits on the red mat",
+    "the dog and the cat play in the park",
+    "a bird flies over the park",
+]
+
+
+@pytest.fixture()
+def image_dir(tmp_path):
+    import cv2
+    d = tmp_path / "imgs"
+    d.mkdir()
+    imgs, labels = make_images(6, channels=3, height=16, width=16, seed=2)
+    lines = []
+    for i in range(6):
+        img = (imgs[i].transpose(1, 2, 0) * 255).astype(np.uint8)
+        name = f"img{i}.jpg"
+        cv2.imwrite(str(d / name), img)
+        lines.append(f"{name} {int(labels[i])}")
+    (tmp_path / "labels.txt").write_text("\n".join(lines))
+    return d, tmp_path / "labels.txt"
+
+
+def test_binary2sequence_and_back(image_dir, tmp_path):
+    d, labels = image_dir
+    seq = str(tmp_path / "imgs.seq")
+    n = binary2sequence(str(d), seq, str(labels))
+    assert n == 6
+    recs = list(SequenceFileReader(seq))
+    assert len(recs) == 6
+    datum = Datum.from_binary(recs[0][1])
+    assert datum.encoded
+    assert datum.label >= 0
+    # sequence → LMDB → dataframe chain
+    lmdb_dir = str(tmp_path / "lmdb")
+    assert sequence2lmdb(seq, lmdb_dir) == 6
+    with LmdbReader(lmdb_dir) as r:
+        assert r.entries == 6
+    pq_path = str(tmp_path / "df.parquet")
+    assert lmdb2dataframe(lmdb_dir, pq_path) == 6
+    import pyarrow.parquet as pq
+    t = pq.read_table(pq_path)
+    assert t.num_rows == 6
+    assert set(t.column_names) >= {"id", "label", "data", "encoded"}
+
+
+def test_binary2dataframe(image_dir, tmp_path):
+    d, labels = image_dir
+    out = str(tmp_path / "b2d.parquet")
+    assert binary2dataframe(str(d), out, str(labels)) == 6
+    import pyarrow.parquet as pq
+    t = pq.read_table(out)
+    assert t.num_rows == 6
+
+
+def test_lmdb2sequence(tmp_path):
+    recs = [(b"%04d" % i, Datum(channels=1, height=2, width=2,
+                                data=bytes(4), label=i).to_binary())
+            for i in range(10)]
+    LmdbWriter(str(tmp_path / "l")).write(recs)
+    seq = str(tmp_path / "out.seq")
+    assert lmdb2sequence(str(tmp_path / "l"), seq) == 10
+    back = list(SequenceFileReader(seq))
+    assert [k for k, _ in back] == ["%04d" % i for i in range(10)]
+
+
+def test_vocab_build_save_load(tmp_path):
+    v = Vocab.build(CAPTIONS, vocab_size=12)
+    assert v.word_to_id("the") == 2          # most frequent first
+    assert v.word_to_id("zzz_unknown") == 1  # UNK
+    v.save(str(tmp_path / "vocab"))
+    v2 = Vocab.load(str(tmp_path / "vocab"))
+    assert v2.words == v.words
+    assert v2.word_to_id("park") == v.word_to_id("park")
+
+
+def test_caption_embedding_round_trip(tmp_path):
+    """ToolTest.scala:86-137 analog: caption → embedding → caption."""
+    rows = [{"id": str(i), "caption": c, "data": b""}
+            for i, c in enumerate(CAPTIONS)]
+    vocab = Vocab.build(CAPTIONS, vocab_size=100)
+    emb = image_caption_to_embedding(rows, vocab, caption_length=10)
+    e0 = emb[0]
+    assert len(e0["input_sentence"]) == 11
+    assert e0["input_sentence"][0] == 0          # start marker
+    assert e0["cont_sentence"][0] == 0 and e0["cont_sentence"][1] == 1
+    assert e0["target_sentence"][-1] == 0 or 0 in e0["target_sentence"]
+    back = embedding_to_caption(emb, vocab)
+    for orig, rec in zip(CAPTIONS, back):
+        assert rec["caption"] == " ".join(
+            w.lower() for w in orig.split())
+
+
+def test_coco_pipeline_cli(tmp_path, image_dir):
+    d, _ = image_dir
+    coco = {
+        "images": [{"id": i, "file_name": f"img{i}.jpg",
+                    "height": 16, "width": 16} for i in range(4)],
+        "annotations": [{"image_id": i, "caption": CAPTIONS[i]}
+                        for i in range(4)],
+    }
+    cf = tmp_path / "captions.json"
+    cf.write_text(json.dumps(coco))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": "",
+           "PYTHONPATH": "/root/repo"}
+    r = subprocess.run(
+        [sys.executable, "-m", "caffeonspark_tpu.tools.converters",
+         "cocodataset", "-captionFile", str(cf), "-imageRoot", str(d),
+         "-imageCaptionDFDir", str(tmp_path / "capdf"),
+         "-vocabDir", str(tmp_path / "vocab"),
+         "-embeddingDFDir", str(tmp_path / "embdf"),
+         "-vocabSize", "50", "-captionLength", "8"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "cocodataset: 4 records" in r.stdout
+    import pyarrow.parquet as pq
+    t = pq.read_table(str(tmp_path / "embdf" / "embedding.parquet"))
+    assert t.num_rows == 4
+    assert set(t.column_names) >= {"id", "data", "input_sentence",
+                                   "target_sentence", "cont_sentence"}
