@@ -232,13 +232,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             cos.train(src, conf)
 
     if conf.isTest or conf.features:
-        # load trained weights: from this run's training phase, or from
-        # an explicit -model file in test/features-only invocations
-        if conf.modelPath and os.path.exists(conf.modelPath) \
+        # load trained weights: after a training phase the JUST-trained
+        # model wins (even over a -weights finetune source); in
+        # test/features-only runs, -model supplies the weights
+        if conf.isTraining and conf.modelPath \
+                and os.path.exists(conf.modelPath):
+            conf.snapshotModelFile = conf.modelPath
+            conf.snapshotStateFile = ""
+        elif conf.modelPath and os.path.exists(conf.modelPath) \
                 and not conf.snapshotModelFile:
             conf.snapshotModelFile = conf.modelPath
-            if conf.isTraining:
-                conf.snapshotStateFile = ""
         layer = conf.test_data_layer() or conf.train_data_layer()
         src = get_source(layer, phase_train=False, rank=conf.rank,
                          num_ranks=max(1, conf.clusterSize),

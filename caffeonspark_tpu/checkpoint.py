@@ -155,8 +155,8 @@ def snapshot_filename(prefix: str, it: int, *, is_state: bool,
 
 
 def snapshot(net: Net, params: Params, opt_state: OptState, prefix: str,
-             *, fmt: int = SnapshotFormat.BINARYPROTO
-             ) -> Tuple[str, str]:
+             *, fmt: int = SnapshotFormat.BINARYPROTO,
+             solver_type: str = "SGD") -> Tuple[str, str]:
     """Write model + state; returns (model_path, state_path)."""
     it = int(jax.device_get(opt_state.iter))
     h5 = fmt == SnapshotFormat.HDF5
@@ -170,9 +170,13 @@ def snapshot(net: Net, params: Params, opt_state: OptState, prefix: str,
         save_caffemodel(model_path, net, params)
 
     st = SolverState(iter=it, learned_net=os.path.basename(model_path))
-    # history blobs, then second-moment blobs (Adam/AdaDelta/RMSProp) —
-    # restore() splits the doubled list back
-    for hist in (opt_state.history, opt_state.history2):
+    # reference Caffe doubles the history list only for solvers with a
+    # second accumulator (its AdaDelta/Adam do the same) — keeping SGD
+    # states at exactly n_params blobs preserves .solverstate interop
+    hists = ((opt_state.history, opt_state.history2)
+             if solver_type.upper() in ("ADAM", "ADADELTA")
+             else (opt_state.history,))
+    for hist in hists:
         for lname, specs in net.param_layout.items():
             for bname, _, _ in specs:
                 st.history.append(_to_blobproto(np.asarray(

@@ -119,13 +119,15 @@ class Config:
         out = []
         if self.netParam is None:
             return out
+        from .net import layer_included
+        from .proto import NetState
+        state = NetState(phase=phase)
         for i, lyr in enumerate(self.netParam.layer):
             if lyr.type not in ("MemoryData", "CoSData", "Data"):
                 continue
-            if any(r.has("phase") and r.phase == phase
-                   for r in lyr.include):
-                out.append(i)
-            elif not lyr.include:   # no rules → layer is in every phase
+            # full NetStateRule semantics: include rules OR'd, exclude
+            # honored, rule-less layers in every phase
+            if layer_included(lyr, state):
                 out.append(i)
         return out
 

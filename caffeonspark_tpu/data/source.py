@@ -115,31 +115,51 @@ class DataSource:
     def next_batch(self, records: Sequence[ImageRecord]
                    ) -> Dict[str, np.ndarray]:
         """Pack + transform records into the data layer's blobs
-        (ImageDataSource.nextBatch analog, `ImageDataSource.scala:99-163`)."""
+        (ImageDataSource.nextBatch analog, `ImageDataSource.scala:99-163`).
+        All-encoded batches take the native threaded JPEG path
+        (libcos_native, the jcaffe Mat/decode analog) when built."""
         c, h, w = self.image_dims()
         n = len(records)
-        data = np.zeros((n, c, h, w), np.float32)
-        labels = np.zeros((n,), np.float32)
-        for i, (rid, label, rc, rh, rw, encoded, payload) in \
-                enumerate(records):
-            if encoded:
-                arr = decode_image(payload, channels=c, resize_hw=(h, w)
-                                   if (self.resize or (rh, rw) != (h, w))
-                                   else None)
-            else:
-                arr = np.frombuffer(payload, np.uint8).astype(
-                    np.float32).reshape(rc, rh, rw)
-                if (rh, rw) != (h, w):
-                    raise ValueError(
-                        f"record {rid}: {rh}x{rw} != layer {h}x{w} "
-                        "(set -resize for encoded sources)")
-            data[i] = arr
-            labels[i] = label
+        labels = np.asarray([r[1] for r in records], np.float32)
+        if all(r[5] for r in records):
+            data = self._decode_encoded_batch(records, c, h, w)
+        else:
+            data = np.zeros((n, c, h, w), np.float32)
+            for i, (rid, label, rc, rh, rw, encoded, payload) in \
+                    enumerate(records):
+                if encoded:
+                    data[i] = decode_image(
+                        payload, channels=c,
+                        resize_hw=(h, w) if (self.resize
+                                             or (rh, rw) != (h, w))
+                        else None)
+                else:
+                    if (rh, rw) != (h, w):
+                        raise ValueError(
+                            f"record {rid}: {rh}x{rw} != layer {h}x{w} "
+                            "(set -resize for encoded sources)")
+                    data[i] = np.frombuffer(payload, np.uint8).astype(
+                        np.float32).reshape(rc, rh, rw)
         out_names = list(self.layer.top)
         batch = {out_names[0]: self.transformer(data)}
         if len(out_names) > 1:
             batch[out_names[1]] = labels
         return batch
+
+    def _decode_encoded_batch(self, records, c, h, w) -> np.ndarray:
+        from .. import native
+        if native.available():
+            try:
+                return native.decode_batch(
+                    [r[6] for r in records], channels=c, out_h=h,
+                    out_w=w)
+            except ValueError:
+                pass  # corrupt image somewhere: per-image path reports it
+        n = len(records)
+        data = np.zeros((n, c, h, w), np.float32)
+        for i, r in enumerate(records):
+            data[i] = decode_image(r[6], channels=c, resize_hw=(h, w))
+        return data
 
     def batches(self, *, loop: bool = True) -> Iterator[Dict[str, np.ndarray]]:
         """Convenience: records → transformed batches, epoch-looping."""

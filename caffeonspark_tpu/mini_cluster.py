@@ -188,7 +188,8 @@ class MiniCluster:
                 self._want_snapshot = False
                 m, s = checkpoint.snapshot(
                     solver.train_net, params, st, self.prefix,
-                    fmt=self.sp.snapshot_format)
+                    fmt=self.sp.snapshot_format,
+                    solver_type=solver.solver_type)
                 print(f"snapshot → {m}")
 
         model_path = self.args.model or checkpoint.snapshot_filename(
@@ -199,7 +200,8 @@ class MiniCluster:
                 # interrupted: write model + state so -snapshot resumes
                 m, s = checkpoint.snapshot(solver.train_net, params, st,
                                            self.prefix,
-                                           fmt=self.sp.snapshot_format)
+                                           fmt=self.sp.snapshot_format,
+                                           solver_type=solver.solver_type)
                 print(f"stopped at iter {it}; resume with -snapshot {s}")
             if model_path.endswith(".h5"):
                 from .checkpoint import _save_h5_blobs
@@ -209,7 +211,9 @@ class MiniCluster:
                                            params)
             print(f"final model → {model_path}")
         self.final_params = params
-        return model_path
+        # only rank 0 wrote the file; other ranks must not hand out a
+        # path that does not exist
+        return model_path if self._is_rank0 else None
 
 
 def main(argv=None) -> int:

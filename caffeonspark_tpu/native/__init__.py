@@ -1,0 +1,154 @@
+"""ctypes binding + build for the native data-pipeline library.
+
+The reference's native image path (jcaffe Mat → cv::imdecode,
+FloatDataTransformer → caffe::DataTransformer, SURVEY §2.4) lives here
+as `libcos_native.so` (libjpeg decode + threaded NCHW transform).  The
+library builds on demand with g++ (Makefile equivalent: `make -C
+caffeonspark_tpu/native`); when the toolchain or libjpeg is missing,
+callers fall back to the cv2/numpy path in `data.transformer` /
+`data.source` — same semantics, slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libcos_native.so")
+_SRC = os.path.join(_DIR, "cos_native.cpp")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def build(force: bool = False) -> bool:
+    """Compile the shared library; returns True on success."""
+    global _build_failed
+    if os.path.exists(_SO) and not force \
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return True
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _SO, "-ljpeg"]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=120)
+        if r.returncode != 0:
+            _build_failed = True
+            return False
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        _build_failed = True
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed); None when unavailable."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) and not build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.cos_decode_batch.restype = ctypes.c_int
+        lib.cos_decode_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+        lib.cos_transform_batch.restype = None
+        lib.cos_transform_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_ubyte),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+            ctypes.c_float, ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+        lib.cos_native_version.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def decode_batch(images: Sequence[bytes], *, channels: int, out_h: int,
+                 out_w: int, num_threads: int = 0) -> np.ndarray:
+    """JPEG bytes → (N, C, out_h, out_w) float32 BGR planes."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(images)
+    blob = b"".join(images)
+    offsets = np.zeros(n, np.int64)
+    sizes = np.asarray([len(b) for b in images], np.int64)
+    np.cumsum(sizes[:-1], out=offsets[1:]) if n > 1 else None
+    out = np.empty((n, channels, out_h, out_w), np.float32)
+    ok = lib.cos_decode_batch(
+        blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        n, channels, out_h, out_w,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), num_threads)
+    if ok != n:
+        raise ValueError(f"{n - ok}/{n} images failed to decode")
+    return out
+
+
+def transform_batch(batch: np.ndarray, *, crop: int = 0,
+                    h_off: Optional[np.ndarray] = None,
+                    w_off: Optional[np.ndarray] = None,
+                    mirror: Optional[np.ndarray] = None,
+                    mean: Optional[np.ndarray] = None,
+                    scale: float = 1.0,
+                    num_threads: int = 0) -> np.ndarray:
+    """Caffe transform on an (N, C, H, W) float32 batch (native)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    batch = np.ascontiguousarray(batch, np.float32)
+    n, c, h, w = batch.shape
+    oh = crop or h
+    ow = crop or w
+    out = np.empty((n, c, oh, ow), np.float32)
+    zeros = np.zeros(n, np.int32)
+    h_off = np.ascontiguousarray(h_off if h_off is not None else zeros,
+                                 np.int32)
+    w_off = np.ascontiguousarray(w_off if w_off is not None else zeros,
+                                 np.int32)
+    mir = np.ascontiguousarray(
+        mirror if mirror is not None else np.zeros(n, np.uint8), np.uint8)
+    if mean is None:
+        mean_ptr, mode = None, 0
+    elif mean.ndim == 1:
+        mean = np.ascontiguousarray(mean, np.float32)
+        mean_ptr, mode = mean.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_float)), 1
+    else:
+        mean = np.ascontiguousarray(mean, np.float32)
+        assert mean.shape == (c, oh, ow), (mean.shape, (c, oh, ow))
+        mean_ptr, mode = mean.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_float)), 2
+    lib.cos_transform_batch(
+        batch.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n, c, h, w, crop,
+        h_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        w_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        mir.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        mean_ptr, mode, ctypes.c_float(scale),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), num_threads)
+    return out

@@ -1,0 +1,198 @@
+// Native data-pipeline kernels: JPEG decode + batched transform.
+//
+// TPU-native equivalent of the reference's native image path —
+// cv::imdecode via jcaffe Mat (caffe-distri/src/main/cpp/jni/JniMat.cpp)
+// and caffe::DataTransformer via FloatDataTransformer
+// (jni/JniFloatDataTransformer.cpp) — feeding preallocated NCHW float
+// buffers.  Exposed as a plain C ABI for ctypes (no pybind11 in this
+// image).  Threading: one worker per hardware thread across the batch
+// (the transformer-thread-pool analog of CaffeProcessor.scala:54-55).
+//
+// Layout notes: decode emits BGR channel order (OpenCV convention, which
+// Caffe models expect) as planar CHW float32.  Resize is bilinear.
+
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct JpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jmp;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jmp, 1);
+}
+
+// decode JPEG bytes to interleaved rows; returns false on corrupt input
+bool decode_jpeg_raw(const unsigned char* data, long size, int channels,
+                     std::vector<unsigned char>* pixels, int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(data),
+               static_cast<unsigned long>(size));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = channels == 1 ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *h = cinfo.output_height;
+  *w = cinfo.output_width;
+  int comps = cinfo.output_components;
+  pixels->resize(static_cast<size_t>(*h) * *w * comps);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row =
+        pixels->data() + static_cast<size_t>(cinfo.output_scanline) * *w * comps;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// bilinear resize + HWC(RGB) → CHW(BGR) float
+void resize_to_chw(const unsigned char* src, int sh, int sw, int channels,
+                   int dh, int dw, float* dst) {
+  const float ys = dh > 1 ? static_cast<float>(sh - 1) / (dh - 1) : 0.0f;
+  const float xs = dw > 1 ? static_cast<float>(sw - 1) / (dw - 1) : 0.0f;
+  for (int y = 0; y < dh; ++y) {
+    float fy = y * ys;
+    int y0 = static_cast<int>(fy);
+    int y1 = std::min(y0 + 1, sh - 1);
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = x * xs;
+      int x0 = static_cast<int>(fx);
+      int x1 = std::min(x0 + 1, sw - 1);
+      float wx = fx - x0;
+      for (int c = 0; c < channels; ++c) {
+        const float p00 = src[(y0 * sw + x0) * channels + c];
+        const float p01 = src[(y0 * sw + x1) * channels + c];
+        const float p10 = src[(y1 * sw + x0) * channels + c];
+        const float p11 = src[(y1 * sw + x1) * channels + c];
+        float v = p00 * (1 - wy) * (1 - wx) + p01 * (1 - wy) * wx +
+                  p10 * wy * (1 - wx) + p11 * wy * wx;
+        // BGR plane order: plane (channels-1-c) receives RGB channel c
+        int plane = channels == 3 ? 2 - c : c;
+        dst[(static_cast<size_t>(plane) * dh + y) * dw + x] = v;
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode a batch of JPEGs into a preallocated (n, channels, out_h, out_w)
+// float32 buffer (BGR planes).  offsets[i]/sizes[i] locate image i inside
+// `blob`.  Returns the number of successfully decoded images; failed
+// slots are zero-filled.
+int cos_decode_batch(const unsigned char* blob, const long* offsets,
+                     const long* sizes, int n, int channels, int out_h,
+                     int out_w, float* out, int num_threads) {
+  std::atomic<int> ok(0);
+  std::atomic<int> next(0);
+  int nthreads = num_threads > 0
+                     ? num_threads
+                     : static_cast<int>(std::thread::hardware_concurrency());
+  nthreads = std::max(1, std::min(nthreads, n));
+  auto worker = [&]() {
+    std::vector<unsigned char> pixels;
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
+      float* dst = out + static_cast<size_t>(i) * channels * out_h * out_w;
+      int h = 0, w = 0;
+      if (decode_jpeg_raw(blob + offsets[i], sizes[i], channels, &pixels,
+                          &h, &w)) {
+        resize_to_chw(pixels.data(), h, w, channels, out_h, out_w, dst);
+        ok.fetch_add(1);
+      } else {
+        std::memset(dst, 0,
+                    sizeof(float) * channels * out_h * out_w);
+      }
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 0; t < nthreads; ++t) pool.emplace_back(worker);
+  for (auto& t : pool) t.join();
+  return ok.load();
+}
+
+// Caffe transform_param semantics on an NCHW float batch:
+//   out[i] = (crop(mirror(in[i])) - mean) * scale
+// h_off/w_off: per-image crop origins; mirror_flags: per-image 0/1.
+// mean_mode: 0 none, 1 per-channel values (mean[c]), 2 full CHW plane
+// (mean has crop*crop*c elements, already cropped by caller).
+void cos_transform_batch(const float* in, int n, int c, int h, int w,
+                         int crop, const int* h_off, const int* w_off,
+                         const unsigned char* mirror_flags,
+                         const float* mean, int mean_mode, float scale,
+                         float* out, int num_threads) {
+  const int oh = crop > 0 ? crop : h;
+  const int ow = crop > 0 ? crop : w;
+  std::atomic<int> next(0);
+  int nthreads = num_threads > 0
+                     ? num_threads
+                     : static_cast<int>(std::thread::hardware_concurrency());
+  nthreads = std::max(1, std::min(nthreads, n));
+  auto worker = [&]() {
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
+      const float* src = in + static_cast<size_t>(i) * c * h * w;
+      float* dst = out + static_cast<size_t>(i) * c * oh * ow;
+      const int hs = crop > 0 ? h_off[i] : 0;
+      const int ws = crop > 0 ? w_off[i] : 0;
+      const bool mir = mirror_flags && mirror_flags[i];
+      for (int ch = 0; ch < c; ++ch) {
+        for (int y = 0; y < oh; ++y) {
+          const float* srow =
+              src + (static_cast<size_t>(ch) * h + hs + y) * w + ws;
+          float* drow = dst + (static_cast<size_t>(ch) * oh + y) * ow;
+          for (int x = 0; x < ow; ++x) {
+            float v = srow[mir ? (ow - 1 - x) : x];
+            if (mean_mode == 1) {
+              v -= mean[ch];
+            } else if (mean_mode == 2) {
+              v -= mean[(static_cast<size_t>(ch) * oh + y) * ow + x];
+            }
+            drow[x] = v * scale;
+          }
+        }
+      }
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 0; t < nthreads; ++t) pool.emplace_back(worker);
+  for (auto& t : pool) t.join();
+}
+
+// Raw u8 CHW records (LMDB Datum payloads) → float NCHW, batched.
+void cos_u8_to_float_batch(const unsigned char* in, long total,
+                           float* out) {
+  for (long i = 0; i < total; ++i)
+    out[i] = static_cast<float>(in[i]);
+}
+
+int cos_native_version() { return 1; }
+
+}  // extern "C"

@@ -465,6 +465,12 @@ def _lrn(ctx, lp, params, bottoms):
     n = int(p.local_size)
     alpha, beta, k = p.alpha, p.beta, p.k
     if p.norm_region == NormRegion.ACROSS_CHANNELS:
+        from .pallas_kernels import lrn_across_channels, pallas_enabled
+        if pallas_enabled() and x.ndim == 4 and not ctx.train:
+            # fused VMEM-resident kernel on TPU (forward only; training
+            # uses the XLA path so autodiff applies)
+            return [lrn_across_channels(x, local_size=n, alpha=alpha,
+                                        beta=beta, k=k)]
         sq = x * x
         pad = n // 2
         sqp = jnp.pad(sq, ((0, 0), (pad, pad), (0, 0), (0, 0)))

@@ -1,0 +1,81 @@
+"""Pallas TPU kernels for ops XLA doesn't fuse optimally.
+
+LRN ACROSS_CHANNELS (CaffeNet norm1/norm2 hot path): XLA lowers the
+reduce_window over channels to a separate pass over HBM; the Pallas
+kernel keeps each (C, spatial-tile) block resident in VMEM and computes
+square → 5-wide channel-window sum (static shifted adds on the VPU) →
+pow → divide in one fused pass, one HBM read + one write per element.
+
+`lrn_across_channels(x, ...)` pads the flattened spatial dim to the
+128-lane grid, runs the kernel per (batch, tile), and is used by
+`ops.layers._lrn` when running on TPU (fallback: the XLA reduce_window
+path — numerically identical, see tests/test_pallas.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE = 512  # spatial lanes per block (4 × 128)
+
+
+def _lrn_kernel(x_ref, o_ref, *, local_size: int, alpha: float,
+                beta: float, k: float):
+    x = x_ref[0]                     # (C, TILE) resident in VMEM
+    sq = x * x
+    c = x.shape[0]
+    pad = local_size // 2
+    acc = sq
+    for off in range(1, pad + 1):
+        # shift down: channel i accumulates channel i-off
+        down = jnp.concatenate(
+            [jnp.zeros((off, sq.shape[1]), sq.dtype), sq[:-off]], axis=0)
+        up = jnp.concatenate(
+            [sq[off:], jnp.zeros((off, sq.shape[1]), sq.dtype)], axis=0)
+        acc = acc + down + up
+    scale = k + (alpha / local_size) * acc
+    o_ref[0] = x * jnp.exp(-beta * jnp.log(scale))
+
+
+def lrn_across_channels(x: jax.Array, *, local_size: int = 5,
+                        alpha: float = 1e-4, beta: float = 0.75,
+                        k: float = 1.0,
+                        interpret: bool = False) -> jax.Array:
+    """(N, C, H, W) float32 → LRN, Caffe semantics (alpha/local_size)."""
+    n, c, h, w = x.shape
+    hw = h * w
+    padded = (hw + TILE - 1) // TILE * TILE
+    xf = x.reshape(n, c, hw)
+    if padded != hw:
+        xf = jnp.pad(xf, ((0, 0), (0, 0), (0, padded - hw)))
+    kern = functools.partial(_lrn_kernel, local_size=local_size,
+                             alpha=alpha, beta=beta, k=k)
+    out = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((n, c, padded), x.dtype),
+        grid=(n, padded // TILE),
+        in_specs=[pl.BlockSpec((1, c, TILE),
+                               lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, c, TILE), lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(xf)
+    return out[:, :, :hw].reshape(n, c, h, w)
+
+
+def pallas_enabled() -> bool:
+    """Pallas kernels activate on real TPU backends only (CPU tests use
+    interpret=True explicitly)."""
+    import os
+    if os.environ.get("COS_DISABLE_PALLAS"):
+        return False
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False

@@ -79,8 +79,9 @@ class CaffeProcessor:
         self.solver = Solver(conf.solverParameter, conf.netParam,
                              rank=rank)
         import jax
-        devices = (jax.devices()[:conf.devices] if conf.devices > 0
-                   else None)  # -devices limits local devices
+        devices = (jax.local_devices()[:conf.devices]
+                   if conf.devices > 0
+                   else None)  # -devices limits THIS host's devices
         if conf.mesh:
             dims = [int(x) for x in conf.mesh.split(",")]
             dims += [1] * (3 - len(dims))
@@ -261,7 +262,8 @@ class CaffeProcessor:
                               or "model")
         m, s = checkpoint.snapshot(
             self.solver.train_net, self.params, self.opt_state, prefix,
-            fmt=conf.solverParameter.snapshot_format)
+            fmt=conf.solverParameter.snapshot_format,
+            solver_type=self.solver.solver_type)
         if final and conf.modelPath:
             checkpoint.save_caffemodel(conf.modelPath,
                                        self.solver.train_net,

@@ -93,10 +93,5 @@ def embedding_to_caption(embedding_rows: Iterable[Dict], vocab: Vocab
 
 
 def _write_parquet(rows: List[Dict], path: str) -> None:
-    import pyarrow as pa
-    import pyarrow.parquet as pq
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    cols = {}
-    for k in rows[0].keys():
-        cols[k] = [r.get(k) for r in rows]
-    pq.write_table(pa.table(cols), path)
+    from .converters import _write_parquet as impl
+    impl(rows, path)

@@ -105,6 +105,8 @@ def sequence2lmdb(seq_path: str, output: str) -> int:
 def _write_parquet(rows: List[Dict], path: str) -> None:
     import pyarrow as pa
     import pyarrow.parquet as pq
+    if not rows:
+        raise ValueError(f"no rows to write to {path} (empty input?)")
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     pq.write_table(pa.table({k: [r.get(k) for r in rows]

@@ -1,0 +1,85 @@
+"""Native library tests: build, decode parity vs cv2, transform parity
+vs the numpy Transformer (TransformTest.java analog for the native
+path)."""
+
+import numpy as np
+import pytest
+
+from caffeonspark_tpu import native
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not native.available():
+        pytest.skip("native toolchain/libjpeg unavailable")
+    return native.get_lib()
+
+
+def _jpegs(n=6, h=32, w=32):
+    import cv2
+    from caffeonspark_tpu.data.synthetic import make_images
+    imgs, _ = make_images(n, channels=3, height=h, width=w, seed=9)
+    out = []
+    for i in range(n):
+        ok, buf = cv2.imencode(
+            ".jpg", (imgs[i].transpose(1, 2, 0) * 255).astype(np.uint8),
+            [cv2.IMWRITE_JPEG_QUALITY, 95])
+        assert ok
+        out.append(bytes(buf))
+    return out
+
+
+def test_version(lib):
+    assert lib.cos_native_version() == 1
+
+
+def test_decode_batch_matches_cv2(lib):
+    import cv2
+    jpegs = _jpegs()
+    got = native.decode_batch(jpegs, channels=3, out_h=32, out_w=32)
+    assert got.shape == (6, 3, 32, 32)
+    for i, buf in enumerate(jpegs):
+        ref = cv2.imdecode(np.frombuffer(buf, np.uint8),
+                           cv2.IMREAD_COLOR)  # BGR HWC
+        ref = ref.transpose(2, 0, 1).astype(np.float32)
+        # decoders differ slightly (IDCT implementations); tolerance 3/255
+        assert np.mean(np.abs(got[i] - ref)) < 3.0, i
+
+
+def test_decode_grayscale(lib):
+    jpegs = _jpegs()
+    got = native.decode_batch(jpegs, channels=1, out_h=16, out_w=16)
+    assert got.shape == (6, 1, 16, 16)
+    assert got.min() >= 0 and got.max() <= 255
+
+
+def test_decode_corrupt_raises(lib):
+    with pytest.raises(ValueError, match="failed to decode"):
+        native.decode_batch([b"not a jpeg"], channels=3, out_h=8,
+                            out_w=8)
+
+
+def test_transform_matches_numpy(lib):
+    rng = np.random.RandomState(0)
+    batch = rng.rand(4, 3, 12, 12).astype(np.float32) * 255
+    h_off = np.asarray([0, 2, 4, 1], np.int32)
+    w_off = np.asarray([3, 0, 2, 4], np.int32)
+    mirror = np.asarray([0, 1, 0, 1], np.uint8)
+    mean = np.asarray([10.0, 20.0, 30.0], np.float32)
+    got = native.transform_batch(batch, crop=8, h_off=h_off, w_off=w_off,
+                                 mirror=mirror, mean=mean, scale=0.5)
+    for i in range(4):
+        ref = batch[i, :, h_off[i]:h_off[i] + 8, w_off[i]:w_off[i] + 8]
+        if mirror[i]:
+            ref = ref[:, :, ::-1]
+        ref = (ref - mean.reshape(3, 1, 1)) * 0.5
+        np.testing.assert_allclose(got[i], ref, rtol=1e-6)
+
+
+def test_transform_mean_plane(lib):
+    rng = np.random.RandomState(1)
+    batch = rng.rand(2, 1, 6, 6).astype(np.float32)
+    meanp = rng.rand(1, 6, 6).astype(np.float32)
+    got = native.transform_batch(batch, mean=meanp, scale=2.0)
+    np.testing.assert_allclose(got, (batch - meanp[None]) * 2.0,
+                               rtol=1e-6)

@@ -1,0 +1,38 @@
+"""Pallas kernel parity tests (interpret mode on CPU)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from caffeonspark_tpu.ops.pallas_kernels import lrn_across_channels
+
+
+def _xla_lrn(x, n=5, alpha=1e-4, beta=0.75, k=1.0):
+    from jax import lax
+    sq = x * x
+    pad = n // 2
+    sqp = jnp.pad(sq, ((0, 0), (pad, pad), (0, 0), (0, 0)))
+    s = lax.reduce_window(sqp, 0.0, lax.add, (1, n, 1, 1),
+                          (1, 1, 1, 1), "VALID")
+    return x / jnp.power(k + (alpha / n) * s, beta)
+
+
+@pytest.mark.parametrize("shape", [(2, 8, 4, 4), (1, 96, 55, 55),
+                                   (2, 5, 7, 9)])
+def test_lrn_pallas_matches_xla(shape):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32) * 3)
+    ref = _xla_lrn(x)
+    got = lrn_across_channels(x, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_lrn_pallas_alpha_beta_k():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.rand(1, 6, 3, 3).astype(np.float32))
+    ref = _xla_lrn(x, n=3, alpha=0.01, beta=0.5, k=2.0)
+    got = lrn_across_channels(x, local_size=3, alpha=0.01, beta=0.5,
+                              k=2.0, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
