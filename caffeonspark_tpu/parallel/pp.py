@@ -1,0 +1,326 @@
+"""Pipeline parallelism: stage-partitioned nets over devices.
+
+Not present in the reference (SURVEY §2.7: pipeline parallel — no);
+provided as a TPU-native extension for models too large for one chip's
+HBM.  Design (GPipe-style):
+
+  * the layer graph is cut into contiguous stages balanced by parameter
+    count (`partition_layers`), each stage's params pinned to one device;
+  * forward runs per-stage jitted functions with explicit inter-stage
+    `device_put` (the activation hop rides ICI on real hardware);
+  * backward chains `jax.vjp` through the stages in reverse — stage s's
+    parameter cotangents materialize on stage s's device;
+  * microbatches accumulate gradients before one optimizer update
+    (identical numerics to the full batch), and jax's async dispatch
+    overlaps microbatch m's stage k with m+1's earlier stages;
+  * the per-stage optimizer update reuses the Solver's Caffe update rule
+    (lr_mult/decay/momentum) restricted to that stage's layers.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..net import Net, Params
+from ..ops import layers as L
+from ..solver import OptState, Solver, learning_rate
+
+Array = jax.Array
+
+
+def partition_layers(net: Net, num_stages: int) -> List[List[str]]:
+    """Contiguous stages balanced by learnable parameter count, ≥1 layer
+    per stage."""
+    costs = []
+    for lp in net.compute_layers:
+        n = sum(math.prod(s) for _, s, _ in
+                net.param_layout.get(lp.name, []))
+        costs.append((lp.name, max(n, 1)))
+    n = len(costs)
+    num_stages = min(num_stages, n)
+    total = sum(c for _, c in costs)
+    cum = []
+    acc = 0.0
+    for _, c in costs:
+        acc += c
+        cum.append(acc)
+    cuts: List[int] = []
+    prev = 0
+    for s in range(1, num_stages):
+        ideal = total * s / num_stages
+        i = prev + 1
+        while i < n - (num_stages - s) and cum[i - 1] < ideal:
+            i += 1
+        cuts.append(i)
+        prev = i
+    bounds = [0] + cuts + [n]
+    return [[costs[i][0] for i in range(bounds[s], bounds[s + 1])]
+            for s in range(num_stages)]
+
+
+class PipelineSolver:
+    """Stage-partitioned training for a Solver."""
+
+    def __init__(self, solver: Solver, *, num_stages: int,
+                 devices: Optional[Sequence] = None,
+                 num_microbatches: int = 2):
+        self.solver = solver
+        devices = list(devices if devices is not None else jax.devices())
+        assert len(devices) >= num_stages, (
+            f"{num_stages} stages need {num_stages} devices")
+        net = solver.train_net
+        self.net = net
+        self.stages = partition_layers(net, num_stages)
+        self.devices = devices[:len(self.stages)]
+        self.num_microbatches = num_microbatches
+        self.stage_of_layer: Dict[str, int] = {}
+        for i, names in enumerate(self.stages):
+            for nme in names:
+                self.stage_of_layer[nme] = i
+
+        # --- blob routing: per stage, which blobs come in / go out ------
+        by_name = {lp.name: lp for lp in net.compute_layers}
+        input_names = set(net.input_names())
+        produced_by: Dict[str, int] = {b: -1 for b in input_names}
+        self.stage_in: List[Set[str]] = []
+        self.stage_out: List[Set[str]] = [set() for _ in self.stages]
+        for s, names in enumerate(self.stages):
+            ins: Set[str] = set()
+            within: Set[str] = set()
+            for nme in names:
+                for b in by_name[nme].bottom:
+                    if b not in within:
+                        ins.add(b)
+                for t in by_name[nme].top:
+                    within.add(t)
+            # resolve producers BEFORE recording this stage's tops —
+            # in-place layers (relu on its own bottom) re-produce a blob
+            # and would otherwise mask the true upstream stage
+            for b in ins:
+                src = produced_by.get(b)
+                if src is not None and 0 <= src < s:
+                    self.stage_out[src].add(b)
+            for nme in names:
+                for t in by_name[nme].top:
+                    produced_by[t] = s
+            self.stage_in.append(ins)
+        # loss blobs exit whichever stage finally produces them
+        for b, w in net.loss_weights.items():
+            src = produced_by.get(b, -1)
+            if src >= 0:
+                self.stage_out[src].add(b)
+
+        self._stage_fns = None
+        self._update_fns = None
+
+    # ------------------------------------------------------------------
+    def place_params(self, params: Params) -> Params:
+        out: Params = {}
+        for ln, blobs in params.items():
+            dev = self.devices[self.stage_of_layer.get(ln, 0)]
+            out[ln] = {bn: jax.device_put(a, dev)
+                       for bn, a in blobs.items()}
+        return out
+
+    def place_opt_state(self, st: OptState) -> OptState:
+        return OptState(iter=st.iter,
+                        history=self.place_params(st.history),
+                        history2=self.place_params(st.history2))
+
+    def init(self) -> Tuple[Params, OptState]:
+        params, st = self.solver.init()
+        return self.place_params(params), self.place_opt_state(st)
+
+    def stage_params(self, params: Params, s: int) -> Params:
+        return {ln: params[ln] for ln in self.stages[s]
+                if ln in params}
+
+    # ------------------------------------------------------------------
+    def _build_stage_fns(self):
+        if self._stage_fns is not None:
+            return self._stage_fns
+        net = self.net
+        by_name = {lp.name: lp for lp in net.compute_layers}
+        fns = []
+        for s, names in enumerate(self.stages):
+            def stage_fn(sparams, acts, rng, *, _names=tuple(names),
+                         _out=tuple(sorted(self.stage_out[s]))):
+                blobs = dict(acts)
+                ctx = L.Ctx(train=True, rng=rng)
+                for nme in _names:
+                    lp = by_name[nme]
+                    op = L.get_op(lp.type)
+                    ctx.layer_name = nme
+                    lparams = []
+                    if nme in net.param_layout:
+                        pd = sparams[nme]
+                        lparams = [pd[bn] for bn, _, _ in
+                                   net.param_layout[nme]]
+                    tops = op.apply(ctx, lp, lparams,
+                                    [blobs[b] for b in lp.bottom])
+                    for t, v in zip(lp.top, tops):
+                        blobs[t] = v
+                # fwd_state: BatchNorm running-stat updates for this
+                # stage's layers (merged into params by train_step)
+                return ({b: blobs[b] for b in _out}, ctx.state_out)
+
+            fns.append(jax.jit(stage_fn))
+        self._stage_fns = fns
+        return fns
+
+    def _forward_backward(self, params, micro, rng=None):
+        """One microbatch: returns (loss, grads) with grads on each
+        stage's own device."""
+        import jax.random as jrandom
+        if rng is None:
+            rng = jrandom.key(0)
+        fns = self._build_stage_fns()
+        S = len(self.stages)
+        acts: Dict[str, Array] = dict(micro)
+        vjps = []
+        fwd_state: Dict[str, List[Array]] = {}
+        stage_state_shapes = []
+        for s in range(S):
+            ins = {b: jax.device_put(acts[b], self.devices[s])
+                   for b in self.stage_in[s]}
+            sp = self.stage_params(params, s)
+            (outs, st_out), vjp = jax.vjp(
+                lambda p, a, _f=fns[s]: _f(p, a, rng), sp, ins)
+            vjps.append(vjp)
+            stage_state_shapes.append(st_out)
+            fwd_state.update(st_out)
+            acts.update(outs)
+        # total loss (weighted) on the last device
+        loss = jnp.zeros((), jnp.float32)
+        for b, w in self.net.loss_weights.items():
+            loss = loss + w * jnp.sum(
+                jax.device_put(acts[b], self.devices[-1]))
+        # backward: seed cotangents per stage output
+        grads: Params = {}
+        cot: Dict[str, Array] = {
+            b: jnp.full_like(acts[b], w)
+            for b, w in self.net.loss_weights.items()}
+        for s in reversed(range(S)):
+            out_cot = {}
+            for b in self.stage_out[s]:
+                if b in cot:
+                    # POP: in-place layers reuse blob names across stages
+                    # (relu2's 'fc_big' vs conv's 'fc_big'); each stage's
+                    # cotangent belongs to ITS version of the value
+                    out_cot[b] = jax.device_put(cot.pop(b),
+                                                self.devices[s])
+                else:
+                    out_cot[b] = jnp.zeros_like(
+                        jax.device_put(acts[b], self.devices[s]))
+            state_cot = jax.tree_util.tree_map(
+                jnp.zeros_like, stage_state_shapes[s])
+            g_sp, g_in = vjps[s]((out_cot, state_cot))
+            grads.update(g_sp)
+            for b, g in g_in.items():
+                if b in cot:
+                    # same-version fan-out to several consumer stages
+                    dev = next(iter(cot[b].devices()))
+                    cot[b] = cot[b] + jax.device_put(g, dev)
+                else:
+                    cot[b] = g
+        return loss, grads, fwd_state
+
+    # ------------------------------------------------------------------
+    def _build_update_fn(self):
+        if self._update_fns is not None:
+            return self._update_fns
+        solver = self.solver
+
+        def upd(sparams, grads, hist, hist2, it, lr):
+            st = OptState(iter=it, history=hist, history2=hist2)
+            p2, st2 = solver._apply_update(sparams, grads, st, lr)
+            return p2, st2.history, st2.history2
+
+        # one jitted fn; jax specializes per stage's shapes automatically
+        self._update_fns = jax.jit(upd, donate_argnums=(0, 2, 3))
+        return self._update_fns
+
+    def train_step(self):
+        solver = self.solver
+        m = self.num_microbatches
+        clip = solver.param.clip_gradients
+
+        def step(params, state, microbatches, rng):
+            grads_acc: Optional[Params] = None
+            loss_acc = 0.0
+            fwd_state_last = {}
+            for i in range(m):
+                micro = {k: v[i] for k, v in microbatches.items()}
+                loss, grads, fwd_state = self._forward_backward(
+                    params, micro, jax.random.fold_in(rng, i))
+                grads_acc = grads if grads_acc is None else {
+                    ln: {bn: grads_acc[ln][bn] + g
+                         for bn, g in bl.items()}
+                    for ln, bl in grads.items()}
+                loss_acc = loss_acc + loss
+                fwd_state_last.update(fwd_state)
+            grads_mean = {ln: {bn: g / m for bn, g in bl.items()}
+                          for ln, bl in grads_acc.items()}
+            # global clip across ALL stages (per-stage _apply_update
+            # would otherwise clip sub-norms independently); after this
+            # pre-scale the inner per-stage clip is a no-op
+            if clip > 0:
+                sq = sum(jax.device_put(jnp.sum(g * g), self.devices[0])
+                         for bl in grads_mean.values()
+                         for g in bl.values())
+                gnorm = jnp.sqrt(sq)
+                scale = jnp.where(gnorm > clip, clip / gnorm, 1.0)
+                grads_mean = {
+                    ln: {bn: g * jax.device_put(
+                        scale, next(iter(g.devices())))
+                        for bn, g in bl.items()}
+                    for ln, bl in grads_mean.items()}
+            lr = learning_rate(solver.param, state.iter)
+            upd = self._build_update_fn()
+            new_p = {ln: dict(bl) for ln, bl in params.items()}
+            new_h = {ln: dict(bl) for ln, bl in state.history.items()}
+            new_h2 = {ln: dict(bl) for ln, bl in state.history2.items()}
+            for s in range(len(self.stages)):
+                sp = self.stage_params(params, s)
+                if not sp:
+                    continue
+                sg = {ln: grads_mean[ln] for ln in sp}
+                sh = {ln: state.history[ln] for ln in sp}
+                sh2 = {ln: state.history2[ln] for ln in sp}
+                p2, h2_, hh2 = upd(sp, sg, sh, sh2, state.iter, lr)
+                new_p.update(p2)
+                new_h.update(h2_)
+                new_h2.update(hh2)
+            # BatchNorm running stats from the last microbatch's forward
+            new_p = self.net.merge_forward_state(new_p, fwd_state_last)
+            st2 = OptState(iter=state.iter + 1, history=new_h,
+                           history2=new_h2)
+            return new_p, st2, {"loss": loss_acc / m, "lr": lr}
+
+        return step
+
+    def split_microbatches(self, batch: Dict[str, Array]
+                           ) -> Dict[str, Array]:
+        """(B, ...) → (M, B/M, ...); time-major ':T' tops carry batch on
+        axis 1 (like parallel.dp.input_shardings) so they split there."""
+        m = self.num_microbatches
+        tmajor = {n for n, _, kind in self.net.input_specs
+                  if kind.endswith(":T")}
+        out = {}
+        for k, v in batch.items():
+            v = jnp.asarray(v)
+            ax = 1 if k in tmajor else 0
+            b = v.shape[ax]
+            assert b % m == 0, (
+                f"batch {b} not divisible by {m} microbatches")
+            if ax == 0:
+                out[k] = jnp.reshape(v, (m, b // m) + v.shape[1:])
+            else:
+                t = v.shape[0]
+                r = jnp.reshape(v, (t, m, b // m) + v.shape[2:])
+                out[k] = jnp.moveaxis(r, 1, 0)
+        return out

@@ -172,7 +172,15 @@ class Solver:
         t = self.solver_type
         it1 = (state.iter + 1).astype(jnp.float32)
 
-        # regularization + clip on the full flattened gradient
+        # Caffe order (SGDSolver::ApplyUpdate): ClipGradients on the raw
+        # diffs FIRST, then Regularize per param
+        if sp.clip_gradients > 0:
+            leaves = jax.tree_util.tree_leaves(grads)
+            gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
+            scale = jnp.where(gnorm > sp.clip_gradients,
+                              sp.clip_gradients / gnorm, 1.0)
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+
         def reg(g, w, dm):
             if wd == 0.0 or dm == 0.0:
                 return g
@@ -184,13 +192,6 @@ class Solver:
                               self._decay_mults[ln][bn])
                       for bn, g in bl.items()}
                  for ln, bl in grads.items()}
-
-        if sp.clip_gradients > 0:
-            leaves = jax.tree_util.tree_leaves(grads)
-            gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
-            scale = jnp.where(gnorm > sp.clip_gradients,
-                              sp.clip_gradients / gnorm, 1.0)
-            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
 
         new_p: Params = {}
         new_h: Params = {}
