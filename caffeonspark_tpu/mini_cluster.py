@@ -64,6 +64,8 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="this process's rank")
     p.add_argument("-display_every", type=int, default=None,
                    help="override solver display interval")
+    p.add_argument("-profile", dest="profile", default=None,
+                   help="write a jax.profiler trace to this directory")
     return p
 
 
@@ -162,35 +164,45 @@ class MiniCluster:
         step = ps.train_step()
         self._install_signals()
 
+        from .utils import StepTimer, profile_trace
         max_iter = self.sp.max_iter
         display = self.sp.display or 0
         snap_every = self.sp.snapshot or 0
         it = int(jax.device_get(st.iter))
         gen = device_prefetch(src.batches(loop=True), depth=2,
                               sharding=ps.input_shardings())
-        t0 = time.time()
+        # each step consumes exactly one source batch (device_prefetch
+        # shards it across dp; it does not multiply the record count)
+        timer = StepTimer(batch_size=src.batch_size)
+        timer.start()
         smoothed = None
-        while it < max_iter and not self._stop:
-            batch = next(gen)
-            params, st, out = step(params, st, batch, solver.step_rng(it))
-            it += 1
-            if display and it % display == 0:
-                loss = float(jax.device_get(out["loss"]))
-                smoothed = loss if smoothed is None else (
-                    0.9 * smoothed + 0.1 * loss)
-                rate = it / (time.time() - t0)
-                print(f"iter {it}/{max_iter} loss={loss:.4f} "
-                      f"(smoothed {smoothed:.4f}) "
-                      f"lr={float(jax.device_get(out['lr'])):.6f} "
-                      f"[{rate:.1f} it/s]")
-            if ((snap_every and it % snap_every == 0)
-                    or self._want_snapshot) and self._is_rank0:
-                self._want_snapshot = False
-                m, s = checkpoint.snapshot(
-                    solver.train_net, params, st, self.prefix,
-                    fmt=self.sp.snapshot_format,
-                    solver_type=solver.solver_type)
-                print(f"snapshot → {m}")
+        with profile_trace(self.args.profile):
+            while it < max_iter and not self._stop:
+                batch = next(gen)
+                params, st, out = step(params, st, batch,
+                                       solver.step_rng(it))
+                it += 1
+                timer.tick()
+                if display and it % display == 0:
+                    loss = float(jax.device_get(out["loss"]))
+                    smoothed = loss if smoothed is None else (
+                        0.9 * smoothed + 0.1 * loss)
+                    print(
+                        f"iter {it}/{max_iter} loss={loss:.4f} "
+                        f"(smoothed {smoothed:.4f}) "
+                        f"lr={float(jax.device_get(out['lr'])):.6f} "
+                        f"[{timer.steps_per_sec:.1f} it/s, "
+                        f"{timer.records_per_sec:.0f} img/s]")
+                if ((snap_every and it % snap_every == 0)
+                        or self._want_snapshot) and self._is_rank0:
+                    self._want_snapshot = False
+                    m, s = checkpoint.snapshot(
+                        solver.train_net, params, st, self.prefix,
+                        fmt=self.sp.snapshot_format,
+                        solver_type=solver.solver_type)
+                    print(f"snapshot → {m}")
+        if self._is_rank0:
+            print(timer.summary())
 
         model_path = self.args.model or checkpoint.snapshot_filename(
             self.prefix, it, is_state=False,

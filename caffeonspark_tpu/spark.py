@@ -1,0 +1,144 @@
+"""Spark execution backend (optional — activates when pyspark exists).
+
+The reference's defining integration (SURVEY §3.1): the Spark driver
+parallelizes one task per executor, each executor hosts a CaffeProcessor
+singleton bound to its accelerators, the driver collects server
+addresses, broadcasts the rank→address map, and streams RDD partitions
+into the executor feed queues.  Here the same choreography bootstraps a
+multi-host JAX mesh instead of socket/RDMA servers:
+
+  1. `sc.parallelize(range(clusterSize), clusterSize)` pins one task per
+     executor; task 0's host becomes the `jax.distributed` coordinator
+     (the getLocalAddress/collect round, CaffeOnSpark.scala:113-142);
+  2. every executor calls `distributed_init(coordinator, N, rank)` and
+     builds the global mesh — connect-retry and barrier semantics come
+     from the JAX runtime rather than SocketChannel::Connect;
+  3. training tasks stream their partition's records into
+     `CaffeProcessor.feed_queue` with the same backpressure/STOP_MARK
+     protocol (:192-198), under the lockstep step-count invariant
+     (`parallel.mesh.lockstep_steps` — the minPartSize barrier analog,
+     :185-200);
+  4. rank 0 snapshots; results return as Spark DataFrames.
+
+This environment ships no pyspark, so everything importable here is
+tested only for the no-spark code paths; `require_spark()` raises an
+actionable error otherwise."""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Dict, List, Optional
+
+from .config import Config
+
+
+def spark_available() -> bool:
+    try:
+        import pyspark  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def require_spark():
+    if not spark_available():
+        raise RuntimeError(
+            "pyspark is not installed; use the local engine "
+            "(caffe_on_spark.CaffeOnSpark with no SparkContext) or the "
+            "standalone trainer (mini_cluster)")
+    import pyspark
+    return pyspark
+
+
+def coordinator_port(app_id: str = "", base: int = 47770) -> int:
+    """Deterministic jax.distributed coordinator port, varied per Spark
+    application so back-to-back jobs on one cluster don't collide."""
+    import zlib
+    return base + (zlib.crc32(app_id.encode()) % 199)
+
+
+class SparkEngine:
+    """Driver-side engine dispatching CaffeProcessor work to executors.
+
+    Uses Spark **barrier execution** for the mesh bring-up: the barrier
+    stage guarantees all `clusterSize` tasks run concurrently (or the
+    stage fails fast with Spark's own actionable error — the startup
+    executor-count sanity of CaffeOnSpark.scala:127-133), and
+    `BarrierTaskContext.getTaskInfos()` provides every task's address —
+    the all-gather that replaces the reference's collect round
+    (:113-142).  Task 0's host becomes the jax.distributed coordinator;
+    the coordinator binds inside rank 0's own `distributed_init`, so the
+    advertised host:port is by construction on the right machine."""
+
+    def __init__(self, sc, conf: Config):
+        require_spark()
+        self.sc = sc
+        self.conf = conf
+        self.cluster_size = max(1, conf.clusterSize)
+
+    def setup(self) -> List[Dict[str, Any]]:
+        """Start processors on every executor, multi-host mesh up."""
+        conf_bytes = _pickle_conf(self.conf)
+        n = self.cluster_size
+        port = coordinator_port(self.sc.applicationId)
+
+        def start(it):
+            from pyspark import BarrierTaskContext
+            ctx = BarrierTaskContext.get()
+            rank = ctx.partitionId()
+            infos = ctx.getTaskInfos()
+            coord_host = infos[0].address.split(":")[0]
+            ctx.barrier()          # everyone resolved the coordinator
+            from .parallel import distributed_init
+            from .processor import CaffeProcessor
+            conf = _unpickle_conf(conf_bytes)
+            distributed_init(f"{coord_host}:{port}", n, rank)
+            proc = CaffeProcessor.instance(conf, rank=rank)
+            proc.start()
+            yield {"rank": rank, "host": socket.gethostname()}
+
+        plan = (self.sc.parallelize(range(n), n).barrier()
+                .mapPartitions(start).collect())
+        assert sorted(p["rank"] for p in plan) == list(range(n))
+        return sorted(plan, key=lambda p: p["rank"])
+
+    def feed_partitions(self, rdd, queue_idx: int = 0) -> int:
+        """Stream records of each partition into the local processor's
+        feed queue (the mapPartitions feed loop, :204-227)."""
+        def feed(it):
+            from .processor import CaffeProcessor
+            proc = CaffeProcessor.instance()
+            fed = 0
+            for rec in it:
+                if not proc.feed_queue(queue_idx, rec):
+                    break
+                fed += 1
+            proc.mark_epoch_end(queue_idx)
+            yield fed
+
+        return sum(rdd.mapPartitions(feed).collect())
+
+    def shutdown(self):
+        def stop(rank):
+            from .processor import CaffeProcessor
+            try:
+                CaffeProcessor.instance().stop()
+            except AssertionError:
+                pass
+            return rank
+
+        n = self.cluster_size
+        self.sc.parallelize(range(n), n).map(stop).collect()
+
+
+def _pickle_conf(conf: Config) -> bytes:
+    import pickle
+    state = {k: getattr(conf, k) for k in vars(conf.args)}
+    state["protoFile"] = conf.protoFile
+    return pickle.dumps(state)
+
+
+def _unpickle_conf(blob: bytes) -> Config:
+    import pickle
+    state = pickle.loads(blob)
+    return Config([], **state)

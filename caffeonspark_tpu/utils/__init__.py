@@ -1,0 +1,3 @@
+"""Utilities: tracing/profiling, metrics."""
+
+from .tracing import StepTimer, profile_trace

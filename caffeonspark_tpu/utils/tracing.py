@@ -1,0 +1,73 @@
+"""Tracing / profiling: the idiomatic superset of the reference's
+observability (SURVEY §5.1 — glog iteration display + manual PerfTest/
+Simulator drivers; no structured tracing).
+
+  * StepTimer — per-step wall-clock with EMA smoothing, records/sec, and
+    the solver `display` cadence (Caffe's "Iteration N, loss = ..." log)
+  * profile_trace — context manager around jax.profiler.trace; produces
+    a TensorBoard-loadable trace directory of XLA device timelines
+    (enable in mini_cluster with -profile DIR)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+
+class StepTimer:
+    def __init__(self, *, batch_size: int = 0, ema: float = 0.05):
+        self.batch_size = batch_size
+        self.ema = ema
+        self._t0: Optional[float] = None
+        self._last: Optional[float] = None
+        self.step_time: Optional[float] = None   # EMA seconds/step
+        self.steps = 0
+
+    def start(self) -> None:
+        self._t0 = self._last = time.perf_counter()
+
+    def tick(self) -> float:
+        """Call once per completed step; returns this step's seconds."""
+        now = time.perf_counter()
+        if self._last is None:
+            self.start()
+            self._last = now
+            return 0.0
+        dt = now - self._last
+        self._last = now
+        self.steps += 1
+        self.step_time = dt if self.step_time is None else (
+            (1 - self.ema) * self.step_time + self.ema * dt)
+        return dt
+
+    @property
+    def steps_per_sec(self) -> float:
+        return 1.0 / self.step_time if self.step_time else 0.0
+
+    @property
+    def records_per_sec(self) -> float:
+        return self.batch_size * self.steps_per_sec
+
+    def summary(self) -> str:
+        """Totals use wall-clock averages (steps/total), not the EMA —
+        the EMA reflects only recent steps and would disagree with the
+        printed total time after a long first-compile step."""
+        total = (time.perf_counter() - self._t0) if self._t0 else 0.0
+        avg = self.steps / total if total > 0 else 0.0
+        return (f"{self.steps} steps in {total:.1f}s "
+                f"({avg:.1f} it/s"
+                + (f", {self.batch_size * avg:.0f} rec/s"
+                   if self.batch_size else "") + ")")
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """jax.profiler trace when log_dir is set; no-op otherwise."""
+    if not log_dir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(log_dir):
+        yield

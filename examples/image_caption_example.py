@@ -1,0 +1,52 @@
+"""LRCN image-caption inference — the ImageCaption.py example of the
+reference (SURVEY §2.8): load a trained captioner, embed images to
+features, greedily decode captions.
+
+Run (after training an LRCN model and building a vocab):
+    python examples/image_caption_example.py \
+        -net word_to_preds.deploy.prototxt \
+        -weights lrcn.caffemodel -vocabDir vocab/ \
+        -embeddingDFDir embdf/
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main(argv=None):
+    from caffeonspark_tpu import checkpoint
+    from caffeonspark_tpu.net import Net
+    from caffeonspark_tpu.proto import NetState, Phase, read_net
+    from caffeonspark_tpu.tools import Vocab
+    from caffeonspark_tpu.tools.image_caption import (captions_to_text,
+                                                      greedy_caption)
+
+    p = argparse.ArgumentParser()
+    p.add_argument("-net", required=True)
+    p.add_argument("-weights", required=True)
+    p.add_argument("-vocabDir", required=True)
+    p.add_argument("-embeddingDFDir", required=True,
+                   help="parquet with image feature vectors")
+    p.add_argument("-featureColumn", default="image_features")
+    p.add_argument("-captionLength", type=int, default=20)
+    a = p.parse_args(argv if argv is not None else sys.argv[1:])
+
+    import jax
+    net = Net(read_net(a.net), NetState(phase=Phase.TEST))
+    params = net.init(jax.random.key(0))
+    params = checkpoint.copy_layers(net, params, a.weights)
+    vocab = Vocab.load(a.vocabDir)
+
+    import pyarrow.parquet as pq
+    t = pq.read_table(a.embeddingDFDir)
+    feats = np.asarray(t.column(a.featureColumn).to_pylist(), np.float32)
+    seqs = greedy_caption(net, params, feats,
+                          max_length=a.captionLength)
+    for i, text in enumerate(captions_to_text(seqs, vocab)):
+        print(f"{i}: {text}")
+
+
+if __name__ == "__main__":
+    main()

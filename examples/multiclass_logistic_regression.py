@@ -1,0 +1,61 @@
+"""DL-features → logistic regression — the MyMLPipeline /
+MultiClassLogisticRegression.py example of the reference (SURVEY §2.8):
+extract deep features with a trained convnet, then fit a linear
+classifier on them (numpy softmax regression stands in for MLlib LR).
+
+Run:
+    python examples/multiclass_logistic_regression.py \
+        -conf solver.prototxt -weights model.caffemodel \
+        -features ip1 -label label
+"""
+
+import sys
+
+import numpy as np
+
+
+def softmax_regression(X, y, *, num_classes, lr=0.1, epochs=200):
+    n, d = X.shape
+    W = np.zeros((d, num_classes), np.float32)
+    b = np.zeros((num_classes,), np.float32)
+    yi = y.astype(int)
+    for _ in range(epochs):
+        z = X @ W + b
+        z -= z.max(axis=1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=1, keepdims=True)
+        p[np.arange(n), yi] -= 1.0
+        W -= lr * (X.T @ p) / n
+        b -= lr * p.mean(axis=0)
+    return W, b
+
+
+def main(argv=None):
+    from caffeonspark_tpu.caffe_on_spark import CaffeOnSpark
+    from caffeonspark_tpu.config import Config
+    from caffeonspark_tpu.data import get_source
+
+    conf = Config(argv if argv is not None else sys.argv[1:])
+    if not conf.features:
+        conf.features = "ip1"
+    if not conf.label:
+        conf.label = "label"
+    cos = CaffeOnSpark()
+    layer = conf.test_data_layer() or conf.train_data_layer()
+    src = get_source(layer, phase_train=False, resize=conf.resize)
+    df = cos.features(src, conf)
+
+    feat_col = conf.features.split(",")[0]
+    X = np.asarray([r[feat_col] for r in df.rows], np.float32)
+    y = np.asarray([r[conf.label][0] for r in df.rows], np.float32)
+    num_classes = int(y.max()) + 1
+    W, b = softmax_regression(X, y, num_classes=num_classes)
+    acc = float(((X @ W + b).argmax(axis=1) == y.astype(int)).mean())
+    print(f"logistic regression on {feat_col}: {len(df)} samples, "
+          f"{X.shape[1]} dims, {num_classes} classes, "
+          f"train accuracy {acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

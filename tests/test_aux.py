@@ -1,0 +1,157 @@
+"""Aux subsystem tests: tracing, spark gating, examples, CIFAR-10 quick
+workload (BASELINE.md parity), and the -profile flag."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from caffeonspark_tpu.utils import StepTimer, profile_trace
+
+
+def test_step_timer():
+    t = StepTimer(batch_size=32)
+    t.start()
+    for _ in range(5):
+        time.sleep(0.01)
+        t.tick()
+    assert t.steps == 5
+    assert 0.005 < t.step_time < 0.2
+    assert t.records_per_sec > 100
+    assert "steps in" in t.summary()
+
+
+def test_profile_trace_writes(tmp_path):
+    import jax.numpy as jnp
+    d = str(tmp_path / "trace")
+    with profile_trace(d):
+        jnp.sum(jnp.ones((100, 100))).block_until_ready()
+    assert os.path.isdir(d)
+    assert any(os.scandir(d)), "trace directory is empty"
+    # no-op path
+    with profile_trace(None):
+        pass
+
+
+def test_spark_gating():
+    from caffeonspark_tpu import spark
+    if spark.spark_available():
+        pytest.skip("pyspark installed; gating paths not applicable")
+    with pytest.raises(RuntimeError, match="pyspark is not installed"):
+        spark.require_spark()
+    port = spark.coordinator_port("app-123")
+    assert 1024 < port < 65536
+    assert port == spark.coordinator_port("app-123")   # deterministic
+    # conf pickling round trip (the broadcast analog)
+    from caffeonspark_tpu.config import Config
+    conf = Config(["-clusterSize", "3", "-devices", "2",
+                   "-outputFormat", "parquet"])
+    blob = spark._pickle_conf(conf)
+    conf2 = spark._unpickle_conf(blob)
+    assert conf2.clusterSize == 3
+    assert conf2.devices == 2
+    assert conf2.outputFormat == "parquet"
+
+
+def _cifar_fixture(tmp_path):
+    from caffeonspark_tpu.data import LmdbWriter
+    from caffeonspark_tpu.data.synthetic import make_images
+    from caffeonspark_tpu.proto.caffe import Datum
+    imgs, labels = make_images(256, channels=3, height=32, width=32,
+                               seed=8)
+    recs = [(b"%06d" % i,
+             Datum(channels=3, height=32, width=32,
+                   data=(imgs[i] * 255).astype(np.uint8).tobytes(),
+                   label=int(labels[i])).to_binary())
+            for i in range(256)]
+    LmdbWriter(str(tmp_path / "cifar_lmdb")).write(recs)
+
+
+def test_cifar10_quick_workload(tmp_path):
+    """The CIFAR-10 quick benchmark config (BASELINE.md) trains on
+    synthetic 32x32x3 data through the unmodified reference net."""
+    ref = "/root/reference/data/cifar10_quick_train_test.prototxt"
+    if not os.path.exists(ref):
+        pytest.skip("reference configs not mounted")
+    import jax.numpy as jnp
+    from caffeonspark_tpu.data import get_source
+    from caffeonspark_tpu.proto import SolverParameter, read_net
+    from caffeonspark_tpu.solver import Solver
+    _cifar_fixture(tmp_path)
+    npm = read_net(ref)
+    for lyr in npm.layer:
+        if lyr.type == "MemoryData":
+            lyr.memory_data_param.source = str(tmp_path / "cifar_lmdb")
+            lyr.memory_data_param.batch_size = 32
+            lyr.clear("transform_param")   # no mean.binaryproto here
+    # cifar10_quick's gaussian std=0.0001 init plateaus ~200 iters while
+    # symmetry breaks (the reference trains it 4000 iters); by 400 the
+    # loss collapses (measured: 2.30 → 0.02 on the synthetic task)
+    sp = SolverParameter.from_text(
+        "base_lr: 0.01 momentum: 0.9 weight_decay: 0.004 "
+        "lr_policy: 'fixed' max_iter: 400 random_seed: 4")
+    s = Solver(sp, npm)
+    src = get_source(s.train_net.data_layers[0], phase_train=True,
+                     seed=1)
+    params, st = s.init()
+    step = s.jit_train_step()
+    losses = []
+    gen = src.batches(loop=True)
+    for i in range(400):
+        b = next(gen)
+        b = {k: jnp.asarray(v) * (1 / 256.0 if k == "data" else 1.0)
+             for k, v in b.items()}
+        params, st, out = step(params, st, b, s.step_rng(i))
+        losses.append(float(out["loss"]))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_logistic_regression_example(tmp_path):
+    """examples/multiclass_logistic_regression.py end-to-end."""
+    from caffeonspark_tpu.data import LmdbWriter
+    from caffeonspark_tpu.data.synthetic import make_images
+    from caffeonspark_tpu.proto.caffe import Datum
+    imgs, labels = make_images(128, seed=12)
+    recs = [(b"%06d" % i,
+             Datum(channels=1, height=28, width=28,
+                   data=(imgs[i, 0] * 255).astype(np.uint8).tobytes(),
+                   label=int(labels[i])).to_binary())
+            for i in range(128)]
+    LmdbWriter(str(tmp_path / "lmdb")).write(recs)
+    net = tmp_path / "net.prototxt"
+    net.write_text(f'''
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "LMDB"
+  memory_data_param {{ source: "{tmp_path}/lmdb" batch_size: 16
+    channels: 1 height: 28 width: 28 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param {{ num_output: 8 kernel_size: 5 stride: 2
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }}
+layer {{ name: "ip1" type: "InnerProduct" bottom: "conv1" top: "ip1"
+  inner_product_param {{ num_output: 32
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
+  bottom: "label" top: "loss" }}''')
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f'net: "{net}"\nbase_lr: 0.01\nmomentum: 0.9\n'
+                      'lr_policy: "fixed"\nmax_iter: 40\n'
+                      'snapshot_prefix: "m"\nrandom_seed: 6\n')
+    sys.path.insert(0, "/root/repo/examples")
+    try:
+        import multiclass_logistic_regression as ex
+        acc = ex.main(["-conf", str(solver), "-features", "ip1",
+                       "-label", "label"])
+    finally:
+        sys.path.pop(0)
+    # untrained conv features of the synthetic gratings still beat
+    # 10-class chance (0.1) by a wide margin
+    assert acc > 0.25, acc
