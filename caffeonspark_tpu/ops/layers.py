@@ -466,11 +466,10 @@ def _lrn(ctx, lp, params, bottoms):
     alpha, beta, k = p.alpha, p.beta, p.k
     if p.norm_region == NormRegion.ACROSS_CHANNELS:
         from .pallas_kernels import lrn_across_channels, pallas_enabled
-        if pallas_enabled() and x.ndim == 4 and not ctx.train:
-            # fused VMEM-resident kernel on TPU (forward only; training
-            # uses the XLA path so autodiff applies)
-            return [lrn_across_channels(x, local_size=n, alpha=alpha,
-                                        beta=beta, k=k)]
+        if pallas_enabled() and x.ndim == 4:
+            # fused VMEM-resident kernel on TPU, with a matching fused
+            # VJP kernel so the training path stays on Pallas
+            return [lrn_across_channels(x, n, alpha, beta, k)]
         sq = x * x
         pad = n // 2
         sqp = jnp.pad(sq, ((0, 0), (pad, pad), (0, 0), (0, 0)))

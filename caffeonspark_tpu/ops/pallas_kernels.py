@@ -24,49 +24,137 @@ from jax.experimental.pallas import tpu as pltpu
 TILE = 512  # spatial lanes per block (4 × 128)
 
 
-def _lrn_kernel(x_ref, o_ref, *, local_size: int, alpha: float,
+def _window_sum(v: jax.Array, pad: int) -> jax.Array:
+    """Σ over the symmetric channel window via static shifted adds (VPU)."""
+    acc = v
+    for off in range(1, pad + 1):
+        down = jnp.concatenate(
+            [jnp.zeros((off, v.shape[1]), v.dtype), v[:-off]], axis=0)
+        up = jnp.concatenate(
+            [v[off:], jnp.zeros((off, v.shape[1]), v.dtype)], axis=0)
+        acc = acc + down + up
+    return acc
+
+
+def _lrn_kernel(x_ref, o_ref, s_ref, *, local_size: int, alpha: float,
                 beta: float, k: float):
     x = x_ref[0]                     # (C, TILE) resident in VMEM
-    sq = x * x
-    c = x.shape[0]
     pad = local_size // 2
-    acc = sq
-    for off in range(1, pad + 1):
-        # shift down: channel i accumulates channel i-off
-        down = jnp.concatenate(
-            [jnp.zeros((off, sq.shape[1]), sq.dtype), sq[:-off]], axis=0)
-        up = jnp.concatenate(
-            [sq[off:], jnp.zeros((off, sq.shape[1]), sq.dtype)], axis=0)
-        acc = acc + down + up
-    scale = k + (alpha / local_size) * acc
+    scale = k + (alpha / local_size) * _window_sum(x * x, pad)
+    s_ref[0] = scale
     o_ref[0] = x * jnp.exp(-beta * jnp.log(scale))
 
 
-def lrn_across_channels(x: jax.Array, *, local_size: int = 5,
-                        alpha: float = 1e-4, beta: float = 0.75,
-                        k: float = 1.0,
-                        interpret: bool = False) -> jax.Array:
-    """(N, C, H, W) float32 → LRN, Caffe semantics (alpha/local_size)."""
+def _lrn_kernel_fwd_only(x_ref, o_ref, *, local_size: int, alpha: float,
+                         beta: float, k: float):
+    """Inference variant: no scale residual output (XLA cannot DCE an
+    unused output of an opaque kernel, so a separate kernel saves an
+    activation-sized HBM write on the eval path)."""
+    x = x_ref[0]
+    pad = local_size // 2
+    scale = k + (alpha / local_size) * _window_sum(x * x, pad)
+    o_ref[0] = x * jnp.exp(-beta * jnp.log(scale))
+
+
+def _lrn_bwd_kernel(x_ref, s_ref, dy_ref, dx_ref, *, local_size: int,
+                    alpha: float, beta: float):
+    """dx = dy·s^{-β} − (2αβ/n)·x·Σ_{i∈W} dy_i·x_i·s_i^{-β-1}."""
+    x = x_ref[0]
+    s = s_ref[0]
+    dy = dy_ref[0]
+    pad = local_size // 2
+    s_nb = jnp.exp(-beta * jnp.log(s))        # s^{-β}
+    u = dy * x * s_nb / s                      # dy·x·s^{-β-1}
+    dx_ref[0] = dy * s_nb - (2.0 * alpha * beta / local_size) * x \
+        * _window_sum(u, pad)
+
+
+def _pad_flat(x):
     n, c, h, w = x.shape
     hw = h * w
     padded = (hw + TILE - 1) // TILE * TILE
     xf = x.reshape(n, c, hw)
     if padded != hw:
         xf = jnp.pad(xf, ((0, 0), (0, 0), (0, padded - hw)))
+    return xf, hw, padded
+
+
+def _block_spec(c):
+    return pl.BlockSpec((1, c, TILE), lambda i, j: (i, 0, j),
+                        memory_space=pltpu.VMEM)
+
+
+def _lrn_fwd_call(x, local_size, alpha, beta, k, interpret):
+    n, c, h, w = x.shape
+    xf, hw, padded = _pad_flat(x)
     kern = functools.partial(_lrn_kernel, local_size=local_size,
+                             alpha=alpha, beta=beta, k=k)
+    out, scale = pl.pallas_call(
+        kern,
+        out_shape=(jax.ShapeDtypeStruct((n, c, padded), x.dtype),
+                   jax.ShapeDtypeStruct((n, c, padded), x.dtype)),
+        grid=(n, padded // TILE),
+        in_specs=[_block_spec(c)],
+        out_specs=(_block_spec(c), _block_spec(c)),
+        interpret=interpret,
+    )(xf)
+    return (out[:, :, :hw].reshape(n, c, h, w),
+            scale[:, :, :hw].reshape(n, c, h, w))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def lrn_across_channels(x: jax.Array, local_size: int = 5,
+                        alpha: float = 1e-4, beta: float = 0.75,
+                        k: float = 1.0,
+                        interpret: bool = False) -> jax.Array:
+    """(N, C, H, W) float32 → LRN, Caffe semantics (alpha/local_size).
+    Differentiable: a second fused kernel computes the exact VJP using
+    saved denominators, so training runs on the Pallas path too; the
+    undifferentiated primal uses a residual-free kernel."""
+    n, c, h, w = x.shape
+    xf, hw, padded = _pad_flat(x)
+    kern = functools.partial(_lrn_kernel_fwd_only, local_size=local_size,
                              alpha=alpha, beta=beta, k=k)
     out = pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((n, c, padded), x.dtype),
         grid=(n, padded // TILE),
-        in_specs=[pl.BlockSpec((1, c, TILE),
-                               lambda i, j: (i, 0, j),
-                               memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((1, c, TILE), lambda i, j: (i, 0, j),
-                               memory_space=pltpu.VMEM),
+        in_specs=[_block_spec(c)],
+        out_specs=_block_spec(c),
         interpret=interpret,
     )(xf)
     return out[:, :, :hw].reshape(n, c, h, w)
+
+
+def _lrn_vjp_fwd(x, local_size, alpha, beta, k, interpret):
+    out, scale = _lrn_fwd_call(x, local_size, alpha, beta, k, interpret)
+    return out, (x, scale)
+
+
+def _lrn_vjp_bwd(local_size, alpha, beta, k, interpret, res, dy):
+    x, scale = res
+    n, c, h, w = x.shape
+    xf, hw, padded = _pad_flat(x)
+    sf, _, _ = _pad_flat(scale)
+    # padded scale regions are 0 → guard: set them to 1 (u is 0 there)
+    if padded != hw:
+        mask = jnp.arange(padded) < hw
+        sf = jnp.where(mask[None, None, :], sf, 1.0)
+    dyf, _, _ = _pad_flat(dy)
+    kern = functools.partial(_lrn_bwd_kernel, local_size=local_size,
+                             alpha=alpha, beta=beta)
+    dx = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((x.shape[0], c, padded), x.dtype),
+        grid=(x.shape[0], padded // TILE),
+        in_specs=[_block_spec(c), _block_spec(c), _block_spec(c)],
+        out_specs=_block_spec(c),
+        interpret=interpret,
+    )(xf, sf, dyf)
+    return (dx[:, :, :hw].reshape(n, c, h, w),)
+
+
+lrn_across_channels.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)
 
 
 def pallas_enabled() -> bool:

@@ -23,7 +23,7 @@ def test_lrn_pallas_matches_xla(shape):
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(*shape).astype(np.float32) * 3)
     ref = _xla_lrn(x)
-    got = lrn_across_channels(x, interpret=True)
+    got = lrn_across_channels(x, 5, 1e-4, 0.75, 1.0, True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-6)
 
@@ -32,7 +32,28 @@ def test_lrn_pallas_alpha_beta_k():
     rng = np.random.RandomState(1)
     x = jnp.asarray(rng.rand(1, 6, 3, 3).astype(np.float32))
     ref = _xla_lrn(x, n=3, alpha=0.01, beta=0.5, k=2.0)
-    got = lrn_across_channels(x, local_size=3, alpha=0.01, beta=0.5,
-                              k=2.0, interpret=True)
+    got = lrn_across_channels(x, 3, 0.01, 0.5, 2.0, True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("shape", [(2, 8, 4, 4), (1, 12, 9, 11)])
+def test_lrn_pallas_grad_matches_xla(shape):
+    """The fused VJP kernel must match autodiff through the XLA path
+    (uses larger alpha so the scale term contributes meaningfully)."""
+    import jax
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    dy = jnp.asarray(rng.randn(*shape).astype(np.float32))
+
+    def f_ref(x):
+        return jnp.sum(_xla_lrn(x, n=5, alpha=0.05, beta=0.75) * dy)
+
+    def f_pallas(x):
+        return jnp.sum(
+            lrn_across_channels(x, 5, 0.05, 0.75, 1.0, True) * dy)
+
+    g_ref = jax.grad(f_ref)(x)
+    g_pal = jax.grad(f_pallas)(x)
+    np.testing.assert_allclose(np.asarray(g_pal), np.asarray(g_ref),
+                               rtol=3e-4, atol=3e-5)
