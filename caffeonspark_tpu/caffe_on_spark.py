@@ -123,8 +123,13 @@ class CaffeOnSpark:
             val_gen = _record_loop(source_validation)
             max_iter = sp.max_iter
             fed = 0
+            drops_seen = 0
             while fed < max_iter and proc._thread.is_alive():
-                for _ in range(test_interval * train_bs):
+                # top up for batches the processor dropped (bad records)
+                # so its iteration count stays in lockstep with the plan
+                extra = proc.dropped_batches - drops_seen
+                drops_seen = proc.dropped_batches
+                for _ in range((test_interval + extra) * train_bs):
                     if not proc.feed_queue(0, next(train_gen)):
                         break
                 fed += test_interval

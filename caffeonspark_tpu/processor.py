@@ -16,12 +16,15 @@ barrier.
 
 from __future__ import annotations
 
+import logging
 import os
 import queue
 import threading
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+_LOG = logging.getLogger(__name__)
 
 from . import checkpoint
 from .config import Config
@@ -98,6 +101,8 @@ class CaffeProcessor:
         self._stopped = False
         # set by trainWithValidation: only then does anyone feed queue 1
         self.interleave_validation = False
+        self.dropped_batches = 0      # driver reads this to re-sync feeds
+        self._consecutive_drops = 0
         self.params = None
         self.opt_state = None
 
@@ -189,8 +194,34 @@ class CaffeProcessor:
                 return         # terminal sentinel
             buf.append(item)
             if len(buf) == src.batch_size:
-                yield src.next_batch(buf)
+                batch = self._pack_or_drop(src, buf)
+                if batch is not None:
+                    yield batch
                 buf = []
+
+    MAX_CONSECUTIVE_DROPS = 20
+
+    def _pack_or_drop(self, src, buf):
+        """Pack a batch; a bad record (corrupt JPEG, shape mismatch)
+        drops the batch with a warning and training continues — the
+        reference's per-iteration failure tolerance
+        (CaffeProcessor.scala:449-451).  A run of consecutive failures
+        means a systematic config error and aborts instead of spinning
+        forever."""
+        try:
+            batch = src.next_batch(buf)
+            self._consecutive_drops = 0
+            return batch
+        except Exception as e:
+            self._consecutive_drops += 1
+            self.dropped_batches += 1
+            _LOG.warning("dropping batch after record error: %s", e)
+            if self._consecutive_drops >= self.MAX_CONSECUTIVE_DROPS:
+                raise RuntimeError(
+                    f"{self._consecutive_drops} consecutive batch "
+                    f"failures — systematic data/config error; last: "
+                    f"{e}") from e
+            return None
 
     def _run_train(self):
         try:
@@ -249,8 +280,10 @@ class CaffeProcessor:
                 continue
             buf.append(item)
             if len(buf) == src.batch_size:
-                out = eval_step(params, src.next_batch(buf))
-                self.validation.add_batch(out)
+                batch = self._pack_or_drop(src, buf)
+                if batch is not None:
+                    out = eval_step(params, batch)
+                    self.validation.add_batch(out)
                 buf = []
                 done += 1
         self.validation.finish_round()

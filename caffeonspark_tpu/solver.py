@@ -244,22 +244,98 @@ class Solver:
     # ------------------------------------------------------------------
     def train_step_fn(self):
         """The pure (params, opt_state, inputs, rng) step — wrap with jit
-        or hand to parallel.dp for mesh execution."""
+        or hand to parallel.dp for mesh execution.
+
+        With `iter_size > 1` (gradient accumulation, solver prototxt),
+        the incoming batch is reshaped to (iter_size, B/iter_size, ...)
+        INSIDE the step (so every caller's (B, ...) contract still
+        holds) and a `lax.scan` accumulates gradients over the
+        sub-batches before ONE optimizer update — Caffe's
+        Normalize-by-iter_size semantics.  BatchNorm running stats are
+        threaded through the scan carry so each forward compounds them
+        (Caffe updates per forward); reported output blobs are the mean
+        over sub-batches."""
         net = self.train_net
+        iter_size = max(1, int(self.param.iter_size))
+        tmajor = {n for n, _, kind in net.input_specs
+                  if kind.endswith(":T")}
+        stat_layers = net.stat_param_layers()
+
+        def loss_and_grads(params, inputs, rng):
+            def loss_fn(p):
+                total, (blobs, fwd_state) = net.loss(p, inputs,
+                                                     train=True, rng=rng)
+                return total, (blobs, fwd_state)
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        def _split(inputs):
+            out = {}
+            for k, v in inputs.items():
+                ax = 1 if k in tmajor else 0
+                b = v.shape[ax]
+                if b % iter_size:
+                    raise ValueError(
+                        f"batch {b} not divisible by iter_size "
+                        f"{iter_size} (input {k!r})")
+                if ax == 0:
+                    out[k] = v.reshape((iter_size, b // iter_size)
+                                       + v.shape[1:])
+                else:
+                    t = v.shape[0]
+                    r = v.reshape((t, iter_size, b // iter_size)
+                                  + v.shape[2:])
+                    out[k] = jnp.moveaxis(r, 1, 0)
+            return out
 
         def step(params: Params, state: OptState,
                  inputs: Dict[str, Array], rng: Array):
-            def loss_fn(p):
-                total, (blobs, fwd_state) = net.loss(p, inputs, train=True,
-                                                     rng=rng)
-                return total, (blobs, fwd_state)
-            (loss, (blobs, fwd_state)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+            if iter_size == 1:
+                (loss, (blobs, fwd_state)), grads = loss_and_grads(
+                    params, inputs, rng)
+                outputs = {name: blobs[name]
+                           for name in net.output_blobs}
+            else:
+                subs = _split(inputs)
+
+                def body(carry, xs):
+                    stats, gacc, oacc = carry
+                    sub, sub_rng = xs
+                    p = {**params, **stats}
+                    (l, (blobs, fwd)), g = loss_and_grads(p, sub,
+                                                          sub_rng)
+                    gacc = jax.tree_util.tree_map(jnp.add, gacc, g)
+                    oacc = {name: oacc[name] + blobs[name]
+                            for name in oacc}
+                    merged = net.merge_forward_state(
+                        {ln: stats[ln] for ln in stats}, fwd)
+                    return (merged, gacc, oacc), None
+
+                zero_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+                # output shapes for the RUNTIME sub-batch (construction
+                # shapes in net.blob_shapes carry the config batch size)
+                sub0 = jax.tree_util.tree_map(lambda v: v[0], subs)
+                out_abs = jax.eval_shape(
+                    lambda p, s: {n: net.apply(p, s, train=True,
+                                               rng=rng)[0][n]
+                                  for n in net.output_blobs},
+                    params, sub0)
+                zero_o = {n: jnp.zeros(a.shape, a.dtype)
+                          for n, a in out_abs.items()}
+                stats0 = {ln: params[ln] for ln in stat_layers}
+                rngs = jax.random.split(rng, iter_size)
+                (stats, gsum, osum), _ = jax.lax.scan(
+                    body, (stats0, zero_g, zero_o), (subs, rngs))
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / iter_size, gsum)
+                outputs = {name: v / iter_size
+                           for name, v in osum.items()}
+                fwd_state = {ln: [stats[ln][bn] for bn, _, _ in
+                                  net.param_layout[ln]]
+                             for ln in stat_layers}
             lr = learning_rate(self.param, state.iter)
             params2, state2 = self._apply_update(params, grads, state, lr)
-            # BatchNorm running stats updated by the forward pass
+            # BatchNorm running stats updated by the forward pass(es)
             params2 = net.merge_forward_state(params2, fwd_state)
-            outputs = {name: blobs[name] for name in net.output_blobs}
             outputs["lr"] = lr
             return params2, state2, outputs
 

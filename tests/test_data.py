@@ -168,6 +168,49 @@ def test_lmdb_source_rank_sharding(tmp_path):
     assert len(ids) == 40
 
 
+def test_corrupt_record_drops_batch_and_continues(tmp_path):
+    """Per-iteration failure tolerance: a corrupt encoded record drops
+    its batch with a warning; training proceeds on good batches."""
+    import jax.numpy as jnp
+    from caffeonspark_tpu.config import Config
+    from caffeonspark_tpu.caffe_on_spark import CaffeOnSpark
+    from caffeonspark_tpu.processor import CaffeProcessor
+    recs = []
+    imgs, labels = make_images(48, seed=6)
+    import cv2
+    for i in range(48):
+        ok, buf = cv2.imencode(
+            ".jpg", (imgs[i, 0] * 255).astype(np.uint8))
+        data = b"CORRUPT!" if i == 5 else bytes(buf)
+        recs.append((b"%06d" % i,
+                     Datum(encoded=True, data=data,
+                           label=int(labels[i])).to_binary()))
+    LmdbWriter(str(tmp_path / "lmdb")).write(recs)
+    net = tmp_path / "net.prototxt"
+    net.write_text(f'''
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "LMDB"
+  memory_data_param {{ source: "{tmp_path}/lmdb" batch_size: 16
+    channels: 1 height: 28 width: 28 }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}''')
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f'net: "{net}"\nbase_lr: 0.01\n'
+                      'lr_policy: "fixed"\nmax_iter: 6\n'
+                      'snapshot_prefix: "x"\nrandom_seed: 2\n')
+    conf = Config(["-conf", str(solver), "-train",
+                   "-output", str(tmp_path), "-resize"])
+    cos = CaffeOnSpark()
+    src = get_source(conf.train_data_layer(), phase_train=True,
+                     resize=True)
+    cos.train(src, conf)   # must complete despite the corrupt record
+    proc = CaffeProcessor.instance()
+    assert getattr(proc, "dropped_batches", 0) >= 1
+
+
 def test_end_to_end_lmdb_lenet(tmp_path):
     """The minimum end-to-end slice (SURVEY §7): unmodified reference
     LeNet solver config + LMDB source → train steps reduce loss."""

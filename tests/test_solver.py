@@ -185,6 +185,35 @@ def test_lenet_convergence_gate():
     assert acc > 0.8, f"convergence gate failed: accuracy {acc}"
 
 
+def test_iter_size_accumulation_matches_big_batch():
+    """iter_size=2 with half batches == one update on the full batch
+    (Caffe gradient-accumulation semantics)."""
+    sp1 = SolverParameter.from_text(
+        "base_lr: 0.1 momentum: 0.9 lr_policy: 'fixed' random_seed: 3")
+    sp2 = SolverParameter.from_text(
+        "base_lr: 0.1 momentum: 0.9 lr_policy: 'fixed' random_seed: 3 "
+        "iter_size: 2")
+    npm = NetParameter.from_text(SMALL_NET)
+    a = Solver(sp1, npm)
+    b = Solver(sp2, npm)
+    pa, sta = a.init()
+    pb, stb = b.init()
+    data, label = next(batches(64, 32, seed=4, scale=1 / 256.0))
+    full = {"data": jnp.asarray(data), "label": jnp.asarray(label)}
+    step_a = a.jit_train_step()
+    step_b = b.jit_train_step()      # splits (B,...) internally
+    rng = a.step_rng(0)
+    pa, sta, oa = step_a(pa, sta, full, rng)
+    pb, stb, ob = step_b(pb, stb, full, rng)
+    # same data, VALID normalization over equal splits → identical grads
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(pa["ip2"]["weight"])),
+        np.asarray(jax.device_get(pb["ip2"]["weight"])),
+        rtol=2e-5, atol=2e-7)
+    assert float(ob["loss"]) == pytest.approx(float(oa["loss"]),
+                                              rel=2e-5)
+
+
 def test_batchnorm_stats_flow_to_inference():
     """BN running stats accumulated during training must normalize
     test-mode activations (merge_forward_state path)."""
