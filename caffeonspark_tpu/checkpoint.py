@@ -69,6 +69,8 @@ def params_to_net_param(net: Net, params: Params) -> NetParameter:
 
 
 def save_caffemodel(path: str, net: Net, params: Params) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
     with open(path, "wb") as f:
         f.write(params_to_net_param(net, params).to_binary())
 
@@ -123,6 +125,7 @@ def copy_layers(net: Net, params: Params, weights_path: str, *,
 
 def _save_h5_blobs(path: str, net: Net, params: Params) -> None:
     import h5py
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with h5py.File(path, "w") as f:
         data = f.create_group("data")
         for lname, specs in net.param_layout.items():
