@@ -107,14 +107,25 @@ def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], *,
                     ) -> Iterator[Dict[str, jax.Array]]:
     """Asynchronously stage `depth` batches onto the device (the
     double-buffered QueuePair analog). jax transfers are async: calling
-    device_put for batch N+1 while N computes overlaps H2D with compute."""
+    device_put for batch N+1 while N computes overlaps H2D with compute.
+
+    Multi-host: when the mesh spans processes, each process's batch is
+    its LOCAL shard of the global batch (per-device batch semantics —
+    'batch sizes in prototxt files are per device'); the global array is
+    assembled with make_array_from_process_local_data."""
     buf = collections.deque()
+    multiproc = jax.process_count() > 1
+
+    def put_one(v, sh):
+        if sh is None:
+            return jax.device_put(v)
+        if multiproc:
+            return jax.make_array_from_process_local_data(sh, v)
+        return jax.device_put(v, sh)
 
     def put(b):
-        if sharding is not None:
-            return {k: jax.device_put(v, sharding[k] if isinstance(
-                sharding, dict) else sharding) for k, v in b.items()}
-        return {k: jax.device_put(v) for k, v in b.items()}
+        return {k: put_one(v, sharding[k] if isinstance(sharding, dict)
+                           else sharding) for k, v in b.items()}
 
     for b in batches:
         buf.append(put(b))

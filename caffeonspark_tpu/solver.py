@@ -115,7 +115,12 @@ class Solver:
         seed = solver_param.random_seed
         if seed < 0:
             seed = 1701  # caffe uses a clock seed; fixed default for replay
-        # per-rank decorrelation: seed = random_seed + rank
+        # weight init must be IDENTICAL on every rank (the reference
+        # syncs weights at start via the on_start exchange; with SPMD
+        # replication, identical init IS the sync) — only the
+        # per-iteration dropout/augment stream is rank-decorrelated
+        # (seed = random_seed + rank, CaffeNet.cpp:614-618)
+        self.init_key = jax.random.key(int(seed))
         self.key = jax.random.key(int(seed) + rank)
         self.solver_type = (solver_param.type or "SGD").upper()
 
@@ -154,7 +159,7 @@ class Solver:
 
     # ------------------------------------------------------------------
     def init(self) -> Tuple[Params, OptState]:
-        params = self.train_net.init(self.key)
+        params = self.train_net.init(self.init_key)
         return params, self.init_state(params)
 
     def init_state(self, params: Params) -> OptState:
