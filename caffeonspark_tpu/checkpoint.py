@@ -76,11 +76,18 @@ def save_caffemodel(path: str, net: Net, params: Params) -> None:
 
 
 def load_caffemodel_blobs(path: str) -> Dict[str, list]:
-    """caffemodel → {layer_name: [np arrays]} (unmatched layers kept)."""
+    """caffemodel → {layer_name: [np arrays]} (unmatched layers kept).
+    Reads both the modern `layer` field and the deprecated V1 `layers`
+    field, so published legacy models (original bvlc_reference zoo)
+    import directly."""
     with open(path, "rb") as f:
         npm = NetParameter.from_binary(f.read())
-    return {lp.name: [_from_blobproto(bp) for bp in lp.blobs]
-            for lp in npm.layer if lp.blobs}
+    out = {lp.name: [_from_blobproto(bp) for bp in lp.blobs]
+           for lp in npm.layer if lp.blobs}
+    for lp in npm.layers:            # V1 legacy
+        if lp.blobs and lp.name not in out:
+            out[lp.name] = [_from_blobproto(bp) for bp in lp.blobs]
+    return out
 
 
 def copy_layers(net: Net, params: Params, weights_path: str, *,

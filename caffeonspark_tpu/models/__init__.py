@@ -1,3 +1,3 @@
 """Model zoo (LeNet, CaffeNet, ...) as programmatic NetParameters."""
 
-from .zoo import caffenet, lenet
+from .zoo import caffenet, googlenet, lenet, vgg16

@@ -121,3 +121,147 @@ def lenet(batch_size: int = 64) -> NetParameter:
         if lyr.type == "MemoryData":
             lyr.memory_data_param.batch_size = batch_size
     return npm
+
+
+def vgg16(batch_size: int = 32, num_classes: int = 1000) -> NetParameter:
+    """VGG-16 (Simonyan & Zisserman): 13 conv3x3 + 3 fc."""
+    t = f"""
+name: "VGG16"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param {{ batch_size: {batch_size} channels: 3
+    height: 224 width: 224 }} }}
+"""
+    cfg = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+    bottom = "data"
+    for block, (n, reps) in enumerate(cfg, 1):
+        for r in range(1, reps + 1):
+            name = f"conv{block}_{r}"
+            t += _CONV.format(name=name, bottom=bottom, n=n, k=3,
+                              extra="pad: 1", std=0.01, bias=0)
+            bottom = name
+        t += f"""
+layer {{ name: "pool{block}" type: "Pooling" bottom: "{bottom}"
+  top: "pool{block}" pooling_param {{ pool: MAX kernel_size: 2
+  stride: 2 }} }}
+"""
+        bottom = f"pool{block}"
+    for i, n in ((6, 4096), (7, 4096)):
+        t += _FC.format(name=f"fc{i}", bottom=bottom, n=n, std=0.005,
+                        bias=1)
+        t += f"""
+layer {{ name: "relu{i}" type: "ReLU" bottom: "fc{i}" top: "fc{i}" }}
+layer {{ name: "drop{i}" type: "Dropout" bottom: "fc{i}" top: "fc{i}"
+  dropout_param {{ dropout_ratio: 0.5 }} }}
+"""
+        bottom = f"fc{i}"
+    t += _FC.format(name="fc8", bottom=bottom, n=num_classes, std=0.01,
+                    bias=0)
+    t += """
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "fc8"
+  bottom: "label" top: "loss" }
+layer { name: "accuracy" type: "Accuracy" bottom: "fc8" bottom: "label"
+  top: "accuracy" include { phase: TEST } }
+"""
+    return parse_net_prototxt(t)
+
+
+def _inception(t: str, name: str, bottom: str, c1, c3r, c3, c5r, c5,
+               pp) -> str:
+    """One GoogLeNet inception module: 1x1 / 3x3 / 5x5 / pool-proj
+    branches concatenated on channels."""
+    t += _CONV.format(name=f"{name}/1x1", bottom=bottom, n=c1, k=1,
+                      extra="", std=0.03, bias=0.2)
+    t += _CONV.format(name=f"{name}/3x3_reduce", bottom=bottom, n=c3r,
+                      k=1, extra="", std=0.09, bias=0.2)
+    t += _CONV.format(name=f"{name}/3x3", bottom=f"{name}/3x3_reduce",
+                      n=c3, k=3, extra="pad: 1", std=0.03, bias=0.2)
+    t += _CONV.format(name=f"{name}/5x5_reduce", bottom=bottom, n=c5r,
+                      k=1, extra="", std=0.2, bias=0.2)
+    t += _CONV.format(name=f"{name}/5x5", bottom=f"{name}/5x5_reduce",
+                      n=c5, k=5, extra="pad: 2", std=0.03, bias=0.2)
+    t += f"""
+layer {{ name: "{name}/pool" type: "Pooling" bottom: "{bottom}"
+  top: "{name}/pool" pooling_param {{ pool: MAX kernel_size: 3 stride: 1
+  pad: 1 }} }}
+"""
+    t += _CONV.format(name=f"{name}/pool_proj", bottom=f"{name}/pool",
+                      n=pp, k=1, extra="", std=0.1, bias=0.2)
+    t += f"""
+layer {{ name: "{name}/output" type: "Concat"
+  bottom: "{name}/1x1" bottom: "{name}/3x3" bottom: "{name}/5x5"
+  bottom: "{name}/pool_proj" top: "{name}/output" }}
+"""
+    return t
+
+
+def googlenet(batch_size: int = 32, num_classes: int = 1000
+              ) -> NetParameter:
+    """GoogLeNet / Inception-v1 (bvlc_googlenet topology, main head)."""
+    t = f"""
+name: "GoogLeNet"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param {{ batch_size: {batch_size} channels: 3
+    height: 224 width: 224 }} }}
+"""
+    t += _CONV.format(name="conv1/7x7_s2", bottom="data", n=64, k=7,
+                      extra="pad: 3 stride: 2", std=0.01, bias=0.2)
+    t += """
+layer { name: "pool1_3x3_s2" type: "Pooling" bottom: "conv1/7x7_s2"
+  top: "pool1" pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+layer { name: "pool1_norm1" type: "LRN" bottom: "pool1" top: "norm1"
+  lrn_param { local_size: 5 alpha: 0.0001 beta: 0.75 } }
+"""
+    t += _CONV.format(name="conv2/3x3_reduce", bottom="norm1", n=64, k=1,
+                      extra="", std=0.09, bias=0.2)
+    t += _CONV.format(name="conv2/3x3", bottom="conv2/3x3_reduce",
+                      n=192, k=3, extra="pad: 1", std=0.03, bias=0.2)
+    t += """
+layer { name: "conv2_norm2" type: "LRN" bottom: "conv2/3x3" top: "norm2"
+  lrn_param { local_size: 5 alpha: 0.0001 beta: 0.75 } }
+layer { name: "pool2_3x3_s2" type: "Pooling" bottom: "norm2"
+  top: "pool2" pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+"""
+    t = _inception(t, "inception_3a", "pool2", 64, 96, 128, 16, 32, 32)
+    t = _inception(t, "inception_3b", "inception_3a/output",
+                   128, 128, 192, 32, 96, 64)
+    t += """
+layer { name: "pool3_3x3_s2" type: "Pooling"
+  bottom: "inception_3b/output" top: "pool3"
+  pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+"""
+    t = _inception(t, "inception_4a", "pool3", 192, 96, 208, 16, 48, 64)
+    t = _inception(t, "inception_4b", "inception_4a/output",
+                   160, 112, 224, 24, 64, 64)
+    t = _inception(t, "inception_4c", "inception_4b/output",
+                   128, 128, 256, 24, 64, 64)
+    t = _inception(t, "inception_4d", "inception_4c/output",
+                   112, 144, 288, 32, 64, 64)
+    t = _inception(t, "inception_4e", "inception_4d/output",
+                   256, 160, 320, 32, 128, 128)
+    t += """
+layer { name: "pool4_3x3_s2" type: "Pooling"
+  bottom: "inception_4e/output" top: "pool4"
+  pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+"""
+    t = _inception(t, "inception_5a", "pool4", 256, 160, 320, 32, 128,
+                   128)
+    t = _inception(t, "inception_5b", "inception_5a/output",
+                   384, 192, 384, 48, 128, 128)
+    t += f"""
+layer {{ name: "pool5_7x7_s1" type: "Pooling"
+  bottom: "inception_5b/output" top: "pool5"
+  pooling_param {{ pool: AVE global_pooling: true }} }}
+layer {{ name: "pool5_drop" type: "Dropout" bottom: "pool5" top: "pool5"
+  dropout_param {{ dropout_ratio: 0.4 }} }}
+layer {{ name: "loss3/classifier" type: "InnerProduct" bottom: "pool5"
+  top: "loss3/classifier"
+  param {{ lr_mult: 1 decay_mult: 1 }} param {{ lr_mult: 2 decay_mult: 0 }}
+  inner_product_param {{ num_output: {num_classes}
+    weight_filler {{ type: "xavier" }}
+    bias_filler {{ type: "constant" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "loss3/classifier"
+  bottom: "label" top: "loss" }}
+layer {{ name: "accuracy" type: "Accuracy" bottom: "loss3/classifier"
+  bottom: "label" top: "accuracy" include {{ phase: TEST }} }}
+"""
+    return parse_net_prototxt(t)

@@ -871,7 +871,9 @@ def _lstm_params(lp, shapes):
     bf = _filler(rp.bias_filler if rp.has("bias_filler") else None)
     specs = [("W_xc", (4 * n, d), wf), ("b_c", (4 * n,), bf),
              ("W_hc", (4 * n, n), wf)]
-    if len(shapes) > 2:  # static input bottom
+    # bottoms: x, cont[, x_static][, c_0, h_0 (expose_hidden)]
+    n_state = 2 if rp.expose_hidden else 0
+    if len(shapes) - n_state > 2:  # static input bottom present
         ds = math.prod(shapes[2][1:])
         specs.append(("W_xc_static", (4 * n, ds), wf))
     return specs
@@ -883,16 +885,23 @@ def _lstm(ctx, lp, params, bottoms):
     cont gates both h_{t-1} and c_{t-1} (sequence restart ⇒ zero state).
     Time loop is a `lax.scan` — XLA compiles one fused step, the MXU sees
     a (B,D)x(D,4N) matmul per step; the big x-projection for ALL steps is
-    hoisted out of the scan as one (T*B,D)x(D,4N) matmul."""
+    hoisted out of the scan as one (T*B,D)x(D,4N) matmul.
+
+    expose_hidden: bottoms gain [h_0, c_0] ((1,B,N) or (B,N)) after any
+    static input; tops gain [h_T, c_T] — Caffe's LSTMLayer orders the
+    recurrent blobs h-first (RecurrentInputBlobNames) — enabling chunked
+    sequences and O(T) incremental decoding."""
     rp = lp.recurrent_param
     n = int(rp.num_output)
+    expose = bool(rp.expose_hidden)
     x, cont = bottoms[0], bottoms[1]
     t_steps, batch = x.shape[0], x.shape[1]
     xf = x.reshape(t_steps, batch, -1)
     w_xc, b_c, w_hc = params[0], params[1], params[2]
+    has_static = len(params) > 3
     # hoisted input projection: one big MXU matmul over all timesteps
     xproj = jnp.einsum("tbd,gd->tbg", xf, w_xc) + b_c
-    if len(bottoms) > 2:
+    if has_static:
         xproj = xproj + (bottoms[2].reshape(batch, -1) @ params[3].T)
 
     cont_f = cont.reshape(t_steps, batch, 1).astype(xf.dtype)
@@ -912,9 +921,16 @@ def _lstm(ctx, lp, params, bottoms):
         h = o * jnp.tanh(c)
         return (h, c), h
 
-    h0 = jnp.zeros((batch, n), xf.dtype)
-    c0 = jnp.zeros((batch, n), xf.dtype)
-    (_, _), hs = lax.scan(step, (h0, c0), (xproj, cont_f))
+    if expose:
+        si = 2 + (1 if has_static else 0)
+        h0 = bottoms[si].reshape(batch, n).astype(xf.dtype)
+        c0 = bottoms[si + 1].reshape(batch, n).astype(xf.dtype)
+    else:
+        h0 = jnp.zeros((batch, n), xf.dtype)
+        c0 = jnp.zeros((batch, n), xf.dtype)
+    (h_t, c_t), hs = lax.scan(step, (h0, c0), (xproj, cont_f))
+    if expose:
+        return [hs, h_t.reshape(1, batch, n), c_t.reshape(1, batch, n)]
     return [hs]
 
 

@@ -603,6 +603,45 @@ class LayerParameter(Message):
     ]
 
 
+# ---------------------------------------------------------------------------
+# V1 legacy layers (deprecated upstream format still used by many published
+# .caffemodel files, e.g. the original bvlc_reference_caffenet.caffemodel)
+# ---------------------------------------------------------------------------
+
+# V1LayerParameter.LayerType enum value → modern string type
+V1_LAYER_TYPES = {
+    35: "AbsVal", 1: "Accuracy", 30: "ArgMax", 2: "BNLL", 3: "Concat",
+    37: "ContrastiveLoss", 4: "Convolution", 5: "Data", 39: "Deconvolution",
+    6: "Dropout", 32: "DummyData", 7: "EuclideanLoss", 25: "Eltwise",
+    38: "Exp", 8: "Flatten", 9: "HDF5Data", 10: "HDF5Output", 28: "HingeLoss",
+    11: "Im2col", 12: "ImageData", 13: "InfogainLoss", 14: "InnerProduct",
+    15: "LRN", 29: "MemoryData", 16: "MultinomialLogisticLoss", 34: "MVN",
+    17: "Pooling", 26: "Power", 18: "ReLU", 19: "Sigmoid",
+    27: "SigmoidCrossEntropyLoss", 36: "Silence", 20: "Softmax",
+    21: "SoftmaxWithLoss", 22: "Split", 33: "Slice", 23: "TanH",
+    24: "WindowData", 31: "Threshold",
+}
+
+
+class V1LayerParameter(Message):
+    """Just enough of the deprecated layer message to import weights:
+    name/type/blobs (+ topology for completeness)."""
+    FIELDS = [
+        Field(2, "bottom", STRING, repeated=True),
+        Field(3, "top", STRING, repeated=True),
+        Field(4, "name", STRING),
+        Field(5, "type", ENUM,
+              enum=Enum("V1LayerType", NONE=0, **{f"T{k}": k
+                                                  for k in V1_LAYER_TYPES})),
+        Field(6, "blobs", MESSAGE, message=BlobProto, repeated=True),
+        Field(7, "blobs_lr", FLOAT, repeated=True),
+        Field(8, "weight_decay", FLOAT, repeated=True),
+    ]
+
+    def type_name(self) -> str:
+        return V1_LAYER_TYPES.get(int(self.type), f"V1:{int(self.type)}")
+
+
 class NetParameter(Message):
     FIELDS = [
         Field(1, "name", STRING),
@@ -613,6 +652,8 @@ class NetParameter(Message):
         Field(6, "state", MESSAGE, message=NetState),
         Field(7, "debug_info", BOOL, default=False),
         Field(100, "layer", MESSAGE, message=LayerParameter, repeated=True),
+        Field(2, "layers", MESSAGE, message=V1LayerParameter,
+              repeated=True),
     ]
 
 

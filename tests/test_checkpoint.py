@@ -108,6 +108,31 @@ def test_finetune_copy_layers(tmp_path):
         np.asarray(jax.device_get(p3["ip"]["weight"])))
 
 
+def test_v1_legacy_caffemodel_import(tmp_path):
+    """Published legacy models use the deprecated V1 `layers` field;
+    copy_layers must import their blobs by name."""
+    from caffeonspark_tpu.proto.caffe import (BlobProto, BlobShape,
+                                              NetParameter as NP,
+                                              V1LayerParameter)
+    s, params, st = _trained()
+    w = np.asarray(jax.device_get(params["conv1"]["weight"]))
+    legacy = NP(name="legacy")
+    v1 = V1LayerParameter(name="conv1", type=4)   # 4 = Convolution
+    v1.blobs.append(BlobProto(
+        shape=BlobShape(dim=list(w.shape)), data=w.ravel()))
+    legacy.layers.append(v1)
+    mp = tmp_path / "legacy.caffemodel"
+    mp.write_bytes(legacy.to_binary())
+
+    s2 = Solver(SolverParameter.from_text(SOLVER),
+                NetParameter.from_text(NET))
+    p2, _ = s2.init()
+    p3 = checkpoint.copy_layers(s2.train_net, p2, str(mp))
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(p3["conv1"]["weight"])), w, rtol=1e-6)
+    assert V1LayerParameter(type=4).type_name() == "Convolution"
+
+
 def test_state_without_model_errors(tmp_path):
     s, params, st = _trained()
     prefix = str(tmp_path / "x")

@@ -278,6 +278,38 @@ def test_lstm_cont_gating():
                                rtol=1e-5)
 
 
+def test_lstm_expose_hidden_chunked_equals_full():
+    """Running T=8 in one pass must equal two T=4 chunks with the
+    exposed (c,h) state handed across (expose_hidden parity)."""
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx, _lstm_params
+    from caffeonspark_tpu.ops.fillers import fill
+    lp_full = LayerParameter.from_text(
+        'name: "l" type: "LSTM" bottom: "x" bottom: "cont" top: "h" '
+        'recurrent_param { num_output: 4 weight_filler { type: "uniform"'
+        ' min: -0.2 max: 0.2 } }')
+    lp_exp = LayerParameter.from_text(
+        'name: "l" type: "LSTM" bottom: "x" bottom: "cont" '
+        'bottom: "h0" bottom: "c0" top: "h" top: "hT" top: "cT" '
+        'recurrent_param { num_output: 4 expose_hidden: true '
+        'weight_filler { type: "uniform" min: -0.2 max: 0.2 } }')
+    specs = _lstm_params(lp_full, [(8, 2, 3), (8, 2)])
+    key = jax.random.key(5)
+    params = [fill(jax.random.fold_in(key, i), f, s)
+              for i, (_, s, f) in enumerate(specs)]
+    x = jax.random.normal(jax.random.key(6), (8, 2, 3))
+    cont = jnp.ones((8, 2)).at[0].set(0.0)
+    h_full = get_op("LSTM").apply(Ctx(), lp_full, params, [x, cont])[0]
+    z = jnp.zeros((1, 2, 4))
+    h1, hT1, cT1 = get_op("LSTM").apply(
+        Ctx(), lp_exp, params, [x[:4], cont[:4], z, z])
+    # continuation chunk: cont=1 at the boundary carries the state in
+    h2, _, _ = get_op("LSTM").apply(
+        Ctx(), lp_exp, params, [x[4:], jnp.ones((4, 2)), hT1, cT1])
+    np.testing.assert_allclose(np.asarray(h_full),
+                               np.concatenate([h1, h2]), rtol=1e-5)
+
+
 @pytest.mark.skipif(not HAS_REF, reason="reference configs not mounted")
 @pytest.mark.parametrize("fname,phase", [
     ("lenet_memory_train_test.prototxt", Phase.TRAIN),

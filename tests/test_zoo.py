@@ -1,0 +1,44 @@
+"""Model zoo construction tests: shape inference + parameter counts for
+the ImageNet-class families (shape-only — forwards at these sizes are
+bench/TPU territory)."""
+
+from caffeonspark_tpu.models import caffenet, googlenet, lenet, vgg16
+from caffeonspark_tpu.net import Net
+from caffeonspark_tpu.proto import NetState, Phase
+
+
+def test_lenet_params():
+    net = Net(lenet(batch_size=8))
+    assert net.num_params() == 431_080
+
+
+def test_caffenet_params():
+    net = Net(caffenet(batch_size=8))
+    # AlexNet/CaffeNet published parameter count
+    assert net.num_params() == 60_965_224
+    assert net.blob_shapes["fc8"] == (8, 1000)
+
+
+def test_vgg16_params():
+    net = Net(vgg16(batch_size=2))
+    # VGG-16 published parameter count
+    assert net.num_params() == 138_357_544
+    assert net.blob_shapes["pool5"] == (2, 512, 7, 7)
+    assert net.blob_shapes["fc8"] == (2, 1000)
+
+
+def test_googlenet_shapes():
+    net = Net(googlenet(batch_size=2), NetState(phase=Phase.TEST))
+    bs = net.blob_shapes
+    assert bs["inception_3a/output"] == (2, 256, 28, 28)
+    assert bs["inception_4e/output"] == (2, 832, 14, 14)
+    assert bs["inception_5b/output"] == (2, 1024, 7, 7)
+    assert bs["pool5"] == (2, 1024, 1, 1)
+    assert bs["loss3/classifier"] == (2, 1000)
+    # bvlc_googlenet main-trunk parameter count is ~6.99M
+    assert 6_500_000 < net.num_params() < 7_500_000
+    # layer names follow the published bvlc_googlenet.caffemodel naming
+    # so copy_layers-based finetuning matches by name
+    assert "conv1/7x7_s2" in net.param_layout
+    assert "inception_3a/1x1" in net.param_layout
+    assert "loss3/classifier" in net.param_layout
