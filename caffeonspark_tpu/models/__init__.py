@@ -1,3 +1,3 @@
 """Model zoo (LeNet, CaffeNet, ...) as programmatic NetParameters."""
 
-from .zoo import caffenet, googlenet, lenet, vgg16
+from .zoo import caffenet, googlenet, lenet, resnet50, vgg16

@@ -165,6 +165,91 @@ layer { name: "accuracy" type: "Accuracy" bottom: "fc8" bottom: "label"
     return parse_net_prototxt(t)
 
 
+_CONV_BN = """
+layer {{ name: "{name}" type: "Convolution" bottom: "{bottom}" top: "{name}"
+  param {{ lr_mult: 1 decay_mult: 1 }}
+  convolution_param {{ num_output: {n} kernel_size: {k} {extra}
+    bias_term: false weight_filler {{ type: "msra" }} }} }}
+layer {{ name: "bn_{name}" type: "BatchNorm" bottom: "{name}" top: "{name}" }}
+layer {{ name: "scale_{name}" type: "Scale" bottom: "{name}" top: "{name}"
+  scale_param {{ bias_term: true }} }}
+"""
+
+
+def _res_block(t: str, name: str, bottom: str, mid: int, out: int,
+               stride: int, project: bool) -> str:
+    """ResNet bottleneck: 1x1(mid) → 3x3(mid) → 1x1(out) + identity/
+    projection shortcut, Eltwise SUM, ReLU."""
+    t += _CONV_BN.format(name=f"{name}_branch2a", bottom=bottom, n=mid,
+                         k=1, extra=f"stride: {stride}")
+    t += (f'\nlayer {{ name: "{name}_branch2a_relu" type: "ReLU" '
+          f'bottom: "{name}_branch2a" top: "{name}_branch2a" }}\n')
+    t += _CONV_BN.format(name=f"{name}_branch2b",
+                         bottom=f"{name}_branch2a", n=mid, k=3,
+                         extra="pad: 1")
+    t += (f'\nlayer {{ name: "{name}_branch2b_relu" type: "ReLU" '
+          f'bottom: "{name}_branch2b" top: "{name}_branch2b" }}\n')
+    t += _CONV_BN.format(name=f"{name}_branch2c",
+                         bottom=f"{name}_branch2b", n=out, k=1, extra="")
+    if project:
+        t += _CONV_BN.format(name=f"{name}_branch1", bottom=bottom,
+                             n=out, k=1, extra=f"stride: {stride}")
+        shortcut = f"{name}_branch1"
+    else:
+        shortcut = bottom
+    t += f"""
+layer {{ name: "{name}" type: "Eltwise" bottom: "{shortcut}"
+  bottom: "{name}_branch2c" top: "{name}" }}
+layer {{ name: "{name}_relu" type: "ReLU" bottom: "{name}"
+  top: "{name}" }}
+"""
+    return t
+
+
+def resnet50(batch_size: int = 32, num_classes: int = 1000
+             ) -> NetParameter:
+    """ResNet-50 (He et al.): bottleneck residual stacks with
+    BatchNorm+Scale, Eltwise shortcuts — the post-AlexNet ImageNet
+    workhorse, exercising BN/Scale/Eltwise at scale."""
+    t = f"""
+name: "ResNet50"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param {{ batch_size: {batch_size} channels: 3
+    height: 224 width: 224 }} }}
+"""
+    t += _CONV_BN.format(name="conv1", bottom="data", n=64, k=7,
+                         extra="pad: 3 stride: 2")
+    t += """
+layer { name: "conv1_relu" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+"""
+    cfg = [("res2", 64, 256, 3, 1), ("res3", 128, 512, 4, 2),
+           ("res4", 256, 1024, 6, 2), ("res5", 512, 2048, 3, 2)]
+    bottom = "pool1"
+    for stage, mid, out, blocks, stride in cfg:
+        for b in range(blocks):
+            name = f"{stage}{chr(ord('a') + b)}"
+            t = _res_block(t, name, bottom, mid, out,
+                           stride if b == 0 else 1, project=(b == 0))
+            bottom = name
+    t += f"""
+layer {{ name: "pool5" type: "Pooling" bottom: "{bottom}" top: "pool5"
+  pooling_param {{ pool: AVE global_pooling: true }} }}
+layer {{ name: "fc1000" type: "InnerProduct" bottom: "pool5"
+  top: "fc1000"
+  param {{ lr_mult: 1 decay_mult: 1 }} param {{ lr_mult: 2 decay_mult: 0 }}
+  inner_product_param {{ num_output: {num_classes}
+    weight_filler {{ type: "xavier" }}
+    bias_filler {{ type: "constant" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "fc1000"
+  bottom: "label" top: "loss" }}
+layer {{ name: "accuracy" type: "Accuracy" bottom: "fc1000"
+  bottom: "label" top: "accuracy" include {{ phase: TEST }} }}
+"""
+    return parse_net_prototxt(t)
+
+
 def _inception(t: str, name: str, bottom: str, c1, c3r, c3, c5r, c5,
                pp) -> str:
     """One GoogLeNet inception module: 1x1 / 3x3 / 5x5 / pool-proj

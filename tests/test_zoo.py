@@ -2,7 +2,8 @@
 the ImageNet-class families (shape-only — forwards at these sizes are
 bench/TPU territory)."""
 
-from caffeonspark_tpu.models import caffenet, googlenet, lenet, vgg16
+from caffeonspark_tpu.models import (caffenet, googlenet, lenet,
+                                     resnet50, vgg16)
 from caffeonspark_tpu.net import Net
 from caffeonspark_tpu.proto import NetState, Phase
 
@@ -25,6 +26,45 @@ def test_vgg16_params():
     assert net.num_params() == 138_357_544
     assert net.blob_shapes["pool5"] == (2, 512, 7, 7)
     assert net.blob_shapes["fc8"] == (2, 1000)
+
+
+def test_resnet50_shapes():
+    import jax.numpy as jnp
+    import numpy as np
+    net = Net(resnet50(batch_size=2))
+    bs = net.blob_shapes
+    assert bs["res2c"] == (2, 256, 56, 56)
+    assert bs["res3d"] == (2, 512, 28, 28)
+    assert bs["res4f"] == (2, 1024, 14, 14)
+    assert bs["res5c"] == (2, 2048, 7, 7)
+    assert bs["pool5"] == (2, 2048, 1, 1)
+    # ResNet-50 published parameter count (conv+fc 25.55M) + BN stats
+    stat_layers = set(net.stat_param_layers())
+    n_weights = sum(
+        int(np.prod(s))
+        for ln, specs in net.param_layout.items()
+        for bn_, s, _ in specs
+        if ln not in stat_layers)
+    assert 25_500_000 < n_weights < 25_700_000
+    # one training step end-to-end at tiny spatial size (BN+Scale+
+    # Eltwise backward path)
+    from caffeonspark_tpu.proto import SolverParameter
+    from caffeonspark_tpu.solver import Solver
+    npm = resnet50(batch_size=2, num_classes=10)
+    for lyr in npm.layer:
+        if lyr.type == "MemoryData":
+            lyr.memory_data_param.height = 64
+            lyr.memory_data_param.width = 64
+    s = Solver(SolverParameter.from_text(
+        "base_lr: 0.01 momentum: 0.9 lr_policy: 'fixed' random_seed: 1"),
+        npm)
+    params, st = s.init()
+    step = s.jit_train_step()
+    inp = {"data": jnp.asarray(
+        np.random.RandomState(0).rand(2, 3, 64, 64), jnp.float32),
+        "label": jnp.zeros((2,))}
+    params, st, out = step(params, st, inp, s.step_rng(0))
+    assert np.isfinite(float(out["loss"]))
 
 
 def test_googlenet_shapes():
