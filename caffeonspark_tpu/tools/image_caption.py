@@ -65,10 +65,15 @@ def greedy_caption(net: Net, params, image_features: np.ndarray, *,
         if done.all():
             break
 
+    return _trim_sequences(ids)
+
+
+def _trim_sequences(ids: np.ndarray) -> List[List[int]]:
+    """ids (B, T+1) with column 0 = START → END-trimmed id lists."""
     out: List[List[int]] = []
-    for i in range(b):
+    for i in range(ids.shape[0]):
         seq = []
-        for t in range(1, t_max):
+        for t in range(1, ids.shape[1]):
             w = int(ids[i, t])
             if w == START_END_ID:
                 break
@@ -80,3 +85,105 @@ def greedy_caption(net: Net, params, image_features: np.ndarray, *,
 def captions_to_text(id_seqs: Sequence[Sequence[int]], vocab: Vocab
                      ) -> List[str]:
     return [vocab.decode(seq) for seq in id_seqs]
+
+
+# ---------------------------------------------------------------------------
+# O(T) incremental decoding via expose_hidden
+# ---------------------------------------------------------------------------
+
+def expose_lstm_states(net_param: NetParameter, *, batch: int,
+                       time_steps: int = 1) -> NetParameter:
+    """Clone a deploy NetParameter into a stepped variant: every LSTM
+    gets `expose_hidden` with `<name>__h0/__c0` net inputs and
+    `<name>__hT/__cT` tops, and time-major CoSData tops shrink to
+    `time_steps` — so one forward advances the recurrence by one step
+    instead of re-running the whole prefix (O(T) total decode vs O(T²)
+    for the padded-prefix `greedy_caption`)."""
+    from ..proto.caffe import BlobShape
+    npm = net_param.clone()
+    # legacy `input_dim:` nets: normalize to input_shape before appending
+    # state inputs (Net indexes input_shape for ALL inputs once any exist)
+    if npm.input and not npm.input_shape and npm.input_dim:
+        dims = list(npm.input_dim)
+        for i in range(len(npm.input)):
+            npm.input_shape.append(
+                BlobShape(dim=dims[4 * i:4 * i + 4]))
+        npm.clear("input_dim")
+    for lyr in npm.layer:
+        if lyr.type == "CoSData":
+            for top in lyr.cos_data_param.top:
+                if top.transpose:
+                    top.channels = time_steps
+            lyr.cos_data_param.batch_size = batch
+        if lyr.type != "LSTM":
+            continue
+        rp = lyr.recurrent_param
+        rp.expose_hidden = True
+        n = int(rp.num_output)
+        h0, c0 = f"{lyr.name}__h0", f"{lyr.name}__c0"
+        lyr.bottom.extend([h0, c0])
+        lyr.top.extend([f"{lyr.name}__hT", f"{lyr.name}__cT"])
+        for name in (h0, c0):
+            npm.input.append(name)
+            npm.input_shape.append(BlobShape(dim=[1, batch, n]))
+    return npm
+
+
+def incremental_greedy_caption(net_param: NetParameter, params,
+                               extra_inputs: dict, *,
+                               batch: int,
+                               prob_blob: str = "probs",
+                               input_blob: str = "input_sentence",
+                               cont_blob: str = "cont_sentence",
+                               max_length: int = 20) -> List[List[int]]:
+    """Greedy decode stepping the recurrence one token at a time.
+    `extra_inputs` carries the non-sequence inputs (image features).
+    One T=1 compile; LSTM states flow through the exposed tops."""
+    import jax
+    import jax.numpy as jnp
+
+    stepped = expose_lstm_states(net_param, batch=batch, time_steps=1)
+    net = Net(stepped, NetState(phase=Phase.TEST))
+    lstm_names = [lp.name for lp in net.compute_layers
+                  if lp.type == "LSTM"]
+
+    @jax.jit
+    def forward(p, inp):
+        blobs, _ = net.apply(p, inp, train=False)
+        out = {prob_blob: blobs[prob_blob]}
+        for nme in lstm_names:
+            out[f"{nme}__hT"] = blobs[f"{nme}__hT"]
+            out[f"{nme}__cT"] = blobs[f"{nme}__cT"]
+        return out
+
+    states = {}
+    for nme in lstm_names:
+        n = next(int(lp.recurrent_param.num_output)
+                 for lp in net.compute_layers if lp.name == nme)
+        states[f"{nme}__h0"] = jnp.zeros((1, batch, n), jnp.float32)
+        states[f"{nme}__c0"] = jnp.zeros((1, batch, n), jnp.float32)
+
+    fixed = {k: jnp.asarray(v) for k, v in extra_inputs.items()}
+    ids = np.zeros((batch, max_length + 1), np.int64)
+    done = np.zeros((batch,), bool)
+    for t in range(1, max_length + 1):
+        inputs = {
+            input_blob: jnp.asarray(ids[:, t - 1:t].T, jnp.float32),
+            cont_blob: jnp.full((1, batch),
+                                0.0 if t == 1 else 1.0, jnp.float32),
+            **fixed,
+            **states,
+        }
+        out = forward(params, inputs)
+        probs = np.asarray(jax.device_get(out[prob_blob]))
+        nxt = probs[0].argmax(axis=-1)
+        nxt = np.where(done, 0, nxt)
+        ids[:, t] = nxt
+        done |= nxt == START_END_ID
+        for nme in lstm_names:
+            states[f"{nme}__h0"] = out[f"{nme}__hT"]
+            states[f"{nme}__c0"] = out[f"{nme}__cT"]
+        if done.all():
+            break
+
+    return _trim_sequences(ids)

@@ -118,6 +118,16 @@ def test_lrcn_memorizes_and_decodes():
     matches = sum(t == e for t, e in zip(texts, expect))
     assert matches >= 3, list(zip(texts, expect))
 
+    # O(T) incremental decoder (expose_hidden stepping) must produce the
+    # SAME sequences as the padded-prefix decoder
+    from caffeonspark_tpu.tools.image_caption import \
+        incremental_greedy_caption
+    seqs2 = incremental_greedy_caption(
+        NetParameter.from_text(DEPLOY_NET), params,
+        {"image_features": feats}, batch=feats.shape[0],
+        max_length=T - 1)
+    assert seqs2 == seqs, (seqs2, seqs)
+
 
 def test_reference_lrcn_config_trains():
     """The real lrcn_cos.prototxt (CaffeNet → 2×LSTM captioner) takes
