@@ -836,6 +836,35 @@ def _hinge_loss(ctx, lp, params, bottoms):
     return [jnp.sum(margin) / n]
 
 
+@register("MultinomialLogisticLoss", is_loss=True)
+def _mll_loss(ctx, lp, params, bottoms):
+    """-log(p[label]) on an already-softmaxed bottom (legacy pairing of
+    Softmax + MultinomialLogisticLoss)."""
+    probs, labels = bottoms[0], bottoms[1]
+    n = probs.shape[0]
+    lbl = labels.astype(jnp.int32).reshape(n)
+    p = probs.reshape(n, -1)[jnp.arange(n), lbl]
+    return [-jnp.sum(jnp.log(jnp.maximum(p, 1e-20))) / n]
+
+
+@register("InfogainLoss", is_loss=True)
+def _infogain_loss(ctx, lp, params, bottoms):
+    """Infogain-weighted multinomial loss: -(1/N) Σ_n Σ_k H[label_n, k]
+    · log(p_nk).  The infogain matrix H arrives as bottom[2] (or, in
+    Caffe, from infogain_loss_param.source — supply it as a bottom
+    here; H = identity degenerates to MultinomialLogisticLoss)."""
+    probs, labels = bottoms[0], bottoms[1]
+    n, k = probs.shape[0], probs.reshape(probs.shape[0], -1).shape[1]
+    if len(bottoms) > 2:
+        h = bottoms[2].reshape(k, k)
+    else:
+        h = jnp.eye(k, dtype=probs.dtype)
+    lbl = labels.astype(jnp.int32).reshape(n)
+    logp = jnp.log(jnp.maximum(probs.reshape(n, k), 1e-20))
+    rows = h[lbl]                       # (N, K) infogain row per sample
+    return [-jnp.sum(rows * logp) / n]
+
+
 @register("Accuracy")
 def _accuracy(ctx, lp, params, bottoms):
     p = lp.accuracy_param

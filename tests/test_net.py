@@ -88,6 +88,31 @@ def test_slice_indivisible_raises():
         get_op("Slice").apply(Ctx(), lp, [], [jnp.ones((2, 10))])
 
 
+def test_infogain_and_mll_losses():
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx
+    probs = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]])
+    labels = jnp.asarray([0.0, 1.0])
+    mll = get_op("MultinomialLogisticLoss").apply(
+        Ctx(), LayerParameter.from_text(
+            'name: "l" type: "MultinomialLogisticLoss" bottom: "p" '
+            'bottom: "y" top: "loss"'), [], [probs, labels])[0]
+    expect = -(np.log(0.7) + np.log(0.8)) / 2
+    assert float(mll) == pytest.approx(expect, rel=1e-6)
+    # identity infogain == MLL
+    lp = LayerParameter.from_text(
+        'name: "l" type: "InfogainLoss" bottom: "p" bottom: "y" '
+        'top: "loss"')
+    ig = get_op("InfogainLoss").apply(Ctx(), lp, [], [probs, labels])[0]
+    assert float(ig) == pytest.approx(expect, rel=1e-6)
+    # off-diagonal H penalizes confusing class 0 with class 1
+    h = jnp.asarray([[1.0, 0.5, 0.0], [0.0, 1.0, 0.0], [0.0, 0.0, 1.0]])
+    ig2 = get_op("InfogainLoss").apply(Ctx(), lp, [],
+                                       [probs, labels, h])[0]
+    expect2 = -((np.log(0.7) + 0.5 * np.log(0.2)) + np.log(0.8)) / 2
+    assert float(ig2) == pytest.approx(expect2, rel=1e-6)
+
+
 def test_loss_normalize_legacy():
     from caffeonspark_tpu.proto.caffe import LayerParameter
     from caffeonspark_tpu.ops.layers import get_op, Ctx
@@ -324,6 +349,33 @@ def test_reference_nets_forward(fname, phase):
                          rng=jax.random.key(1))
     for out in net.output_blobs:
         assert np.all(np.isfinite(np.asarray(blobs[out]))), out
+
+
+@pytest.mark.skipif(not HAS_REF, reason="reference configs not mounted")
+def test_all_reference_nets_construct():
+    """Every net prototxt shipped with the reference compiles (shape
+    inference + param specs) in both phases, under the solver's stages
+    where one exists — the full parity surface, construction-level."""
+    import glob
+    stages_by_net = {
+        "lrcn_cos.prototxt": ["freeze-convnet", "factored", "2-layer"],
+    }
+    count = 0
+    for path in sorted(glob.glob(os.path.join(REF_DATA, "*.prototxt"))):
+        name = os.path.basename(path)
+        if "solver" in name:
+            continue
+        npm = read_net(path)
+        for phase in (Phase.TRAIN, Phase.TEST):
+            stages = list(stages_by_net.get(name, []))
+            if name == "lrcn_cos.prototxt" and phase == Phase.TEST:
+                stages.append("test-on-train")
+            net = Net(npm, NetState(phase=phase, stage=stages))
+            if net.compute_layers:
+                assert net.blob_shapes
+                net.init(jax.random.key(0))   # fillers resolve
+                count += 1
+    assert count >= 16  # 9 nets × 2 phases, minus empty filtered combos
 
 
 @pytest.mark.skipif(not HAS_REF, reason="reference configs not mounted")
