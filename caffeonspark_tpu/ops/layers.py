@@ -857,6 +857,15 @@ def _infogain_loss(ctx, lp, params, bottoms):
     n, k = probs.shape[0], probs.reshape(probs.shape[0], -1).shape[1]
     if len(bottoms) > 2:
         h = bottoms[2].reshape(k, k)
+    elif lp.has("infogain_loss_param") \
+            and lp.infogain_loss_param.source:
+        # load H from the binaryproto at trace time (constant in the
+        # compiled program) — the standard Caffe configuration
+        import numpy as _np
+        from ..proto.caffe import BlobProto
+        with open(lp.infogain_loss_param.source, "rb") as f:
+            bp = BlobProto.from_binary(f.read())
+        h = jnp.asarray(_np.asarray(bp.data, _np.float32).reshape(k, k))
     else:
         h = jnp.eye(k, dtype=probs.dtype)
     lbl = labels.astype(jnp.int32).reshape(n)

@@ -68,6 +68,84 @@ def greedy_caption(net: Net, params, image_features: np.ndarray, *,
     return _trim_sequences(ids)
 
 
+def beam_caption(net_param: NetParameter, params, extra_inputs: dict, *,
+                 batch: int, beam: int = 3,
+                 prob_blob: str = "probs",
+                 input_blob: str = "input_sentence",
+                 cont_blob: str = "cont_sentence",
+                 max_length: int = 20) -> List[List[int]]:
+    """Beam-search decoding over the incremental (expose_hidden)
+    stepper — the LRCN captioning decode of the reference's
+    ImageCaption example, batched: all B·K beams advance in one forward
+    per step; LSTM states are gathered by parent beam on device."""
+    import jax
+    import jax.numpy as jnp
+
+    bk = batch * beam
+    lstm_names, states, forward = _make_stepper(net_param, bk,
+                                                prob_blob)
+
+    @jax.jit
+    def gather_states(states, parent_global):
+        return {k: v[:, parent_global] for k, v in states.items()}
+
+    # every beam of an image shares its feature vector
+    fixed = {k: jnp.repeat(jnp.asarray(v), beam, axis=0)
+             for k, v in extra_inputs.items()}
+
+    NEG = -1e30
+    scores = np.full((batch, beam), NEG, np.float64)
+    scores[:, 0] = 0.0                 # beams start identical: only one live
+    ids = np.zeros((batch, beam, max_length + 1), np.int64)
+    finished = np.zeros((batch, beam), bool)
+
+    for t in range(1, max_length + 1):
+        words = ids[:, :, t - 1].reshape(bk)
+        inputs = {
+            input_blob: jnp.asarray(words[None, :], jnp.float32),
+            cont_blob: jnp.full((1, bk), 0.0 if t == 1 else 1.0,
+                                jnp.float32),
+            **fixed,
+            **{f"{nme}__h0": states[f"{nme}__h0"]
+               for nme in lstm_names},
+            **{f"{nme}__c0": states[f"{nme}__c0"]
+               for nme in lstm_names},
+        }
+        probs_dev, new_states = forward(params, inputs)
+        logp = np.log(np.maximum(np.asarray(
+            jax.device_get(probs_dev))[0], 1e-20))
+        v = logp.shape[-1]
+        logp = logp.reshape(batch, beam, v)
+        # finished beams may only extend with END at zero cost
+        cand = scores[:, :, None] + logp
+        fin_row = np.full((v,), NEG)
+        fin_row[START_END_ID] = 0.0
+        cand = np.where(finished[:, :, None],
+                        scores[:, :, None] + fin_row[None, None, :],
+                        cand)
+        flat = cand.reshape(batch, beam * v)
+        top = np.argsort(-flat, axis=1)[:, :beam]
+        parent = top // v
+        word = top % v
+        scores = np.take_along_axis(flat, top, axis=1)
+        ids = np.take_along_axis(
+            ids, parent[:, :, None], axis=1)
+        ids[:, :, t] = word
+        finished = np.take_along_axis(finished, parent, axis=1) \
+            | (word == START_END_ID)
+        parent_global = (np.arange(batch)[:, None] * beam
+                         + parent).reshape(bk)
+        gathered = gather_states(new_states, jnp.asarray(parent_global))
+        states = {f"{nme}__{s}0": gathered[f"{nme}__{s}"]
+                  for nme in lstm_names for s in ("h", "c")}
+        if finished.all():
+            break
+
+    best = scores.argmax(axis=1)
+    best_ids = ids[np.arange(batch), best]
+    return _trim_sequences(best_ids)
+
+
 def _trim_sequences(ids: np.ndarray) -> List[List[int]]:
     """ids (B, T+1) with column 0 = START → END-trimmed id lists."""
     out: List[List[int]] = []
@@ -129,6 +207,34 @@ def expose_lstm_states(net_param: NetParameter, *, batch: int,
     return npm
 
 
+def _make_stepper(net_param: NetParameter, batch: int, prob_blob: str):
+    """Shared expose_hidden stepping harness: returns (lstm_names,
+    init_states, forward) where forward(params, inputs) → (probs,
+    {"<lstm>__h"/"__c": state tops})."""
+    import jax
+    import jax.numpy as jnp
+
+    stepped = expose_lstm_states(net_param, batch=batch, time_steps=1)
+    net = Net(stepped, NetState(phase=Phase.TEST))
+    lstm_names = [lp.name for lp in net.compute_layers
+                  if lp.type == "LSTM"]
+
+    @jax.jit
+    def forward(p, inp):
+        blobs, _ = net.apply(p, inp, train=False)
+        return (blobs[prob_blob],
+                {f"{nme}__{s}": blobs[f"{nme}__{s}T"]
+                 for nme in lstm_names for s in ("h", "c")})
+
+    states = {}
+    for nme in lstm_names:
+        n = next(int(lp.recurrent_param.num_output)
+                 for lp in net.compute_layers if lp.name == nme)
+        states[f"{nme}__h0"] = jnp.zeros((1, batch, n), jnp.float32)
+        states[f"{nme}__c0"] = jnp.zeros((1, batch, n), jnp.float32)
+    return lstm_names, states, forward
+
+
 def incremental_greedy_caption(net_param: NetParameter, params,
                                extra_inputs: dict, *,
                                batch: int,
@@ -142,26 +248,8 @@ def incremental_greedy_caption(net_param: NetParameter, params,
     import jax
     import jax.numpy as jnp
 
-    stepped = expose_lstm_states(net_param, batch=batch, time_steps=1)
-    net = Net(stepped, NetState(phase=Phase.TEST))
-    lstm_names = [lp.name for lp in net.compute_layers
-                  if lp.type == "LSTM"]
-
-    @jax.jit
-    def forward(p, inp):
-        blobs, _ = net.apply(p, inp, train=False)
-        out = {prob_blob: blobs[prob_blob]}
-        for nme in lstm_names:
-            out[f"{nme}__hT"] = blobs[f"{nme}__hT"]
-            out[f"{nme}__cT"] = blobs[f"{nme}__cT"]
-        return out
-
-    states = {}
-    for nme in lstm_names:
-        n = next(int(lp.recurrent_param.num_output)
-                 for lp in net.compute_layers if lp.name == nme)
-        states[f"{nme}__h0"] = jnp.zeros((1, batch, n), jnp.float32)
-        states[f"{nme}__c0"] = jnp.zeros((1, batch, n), jnp.float32)
+    lstm_names, states, forward = _make_stepper(net_param, batch,
+                                                prob_blob)
 
     fixed = {k: jnp.asarray(v) for k, v in extra_inputs.items()}
     ids = np.zeros((batch, max_length + 1), np.int64)
@@ -174,15 +262,14 @@ def incremental_greedy_caption(net_param: NetParameter, params,
             **fixed,
             **states,
         }
-        out = forward(params, inputs)
-        probs = np.asarray(jax.device_get(out[prob_blob]))
+        probs_dev, new_states = forward(params, inputs)
+        probs = np.asarray(jax.device_get(probs_dev))
         nxt = probs[0].argmax(axis=-1)
         nxt = np.where(done, 0, nxt)
         ids[:, t] = nxt
         done |= nxt == START_END_ID
-        for nme in lstm_names:
-            states[f"{nme}__h0"] = out[f"{nme}__hT"]
-            states[f"{nme}__c0"] = out[f"{nme}__cT"]
+        states = {f"{nme}__{s}0": new_states[f"{nme}__{s}"]
+                  for nme in lstm_names for s in ("h", "c")}
         if done.all():
             break
 

@@ -128,6 +128,21 @@ def test_lrcn_memorizes_and_decodes():
         max_length=T - 1)
     assert seqs2 == seqs, (seqs2, seqs)
 
+    # beam search: beam=1 ≡ greedy; beam=3 still decodes the memorized
+    # captions (they dominate the learned distribution)
+    from caffeonspark_tpu.tools.image_caption import beam_caption
+    seqs_b1 = beam_caption(NetParameter.from_text(DEPLOY_NET), params,
+                           {"image_features": feats},
+                           batch=feats.shape[0], beam=1,
+                           max_length=T - 1)
+    assert seqs_b1 == seqs
+    seqs_b3 = beam_caption(NetParameter.from_text(DEPLOY_NET), params,
+                           {"image_features": feats},
+                           batch=feats.shape[0], beam=3,
+                           max_length=T - 1)
+    texts_b3 = captions_to_text(seqs_b3, vocab)
+    assert sum(t == e for t, e in zip(texts_b3, expect)) >= 3, texts_b3
+
 
 def test_reference_lrcn_config_trains():
     """The real lrcn_cos.prototxt (CaffeNet → 2×LSTM captioner) takes
