@@ -148,11 +148,18 @@ class Net:
 
     def __init__(self, net_param: NetParameter, state: Optional[NetState] = None,
                  input_shapes: Optional[Dict[str, Sequence[int]]] = None,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, remat: Optional[bool] = None):
         self.net_param = net_param
         self.state = state or NetState(phase=Phase.TRAIN)
         self.name = net_param.name
         self.dtype = dtype
+        # rematerialization: recompute layer activations in the backward
+        # pass instead of storing them — trades MXU FLOPs for HBM
+        # (jax.checkpoint per layer); COS_REMAT=1 enables globally
+        if remat is None:
+            import os
+            remat = os.environ.get("COS_REMAT") == "1"
+        self.remat = bool(remat)
 
         self.layers: List[LayerParameter] = [
             lp for lp in net_param.layer if layer_included(lp, self.state)]
@@ -301,7 +308,18 @@ class Net:
                 lparams = [pd[bname]
                            for bname, _, _ in self.param_layout[lp.name]]
             bottoms = [blobs[b] for b in lp.bottom]
-            tops = op.apply(ctx, lp, lparams, bottoms)
+            if self.remat and train and lparams \
+                    and lp.type != "BatchNorm":
+                # only parameterized layers are checkpointed — wrapping
+                # elementwise ops would just block XLA fusion; BatchNorm
+                # is excluded because its running-stat side channel
+                # (ctx.state_out) must not cross the remat boundary
+                fn = jax.checkpoint(
+                    lambda p, b, op=op, lp=lp, ctx=ctx:
+                    op.apply(ctx, lp, p, b))
+                tops = fn(lparams, bottoms)
+            else:
+                tops = op.apply(ctx, lp, lparams, bottoms)
             for name, val in zip(lp.top, tops):
                 blobs[name] = val
         return blobs, ctx.state_out

@@ -185,6 +185,29 @@ def test_lenet_convergence_gate():
     assert acc > 0.8, f"convergence gate failed: accuracy {acc}"
 
 
+def test_remat_matches_no_remat():
+    """jax.checkpoint rematerialization must not change numerics."""
+    npm = NetParameter.from_text(SMALL_NET)
+    sp = SolverParameter.from_text(SOLVER_TXT)
+    a = Solver(sp, npm)
+    pa, sta = a.init()
+    b = Solver(sp, npm)
+    b.train_net.remat = True
+    pb, stb = b.init()
+    data, label = next(batches(64, 32, seed=9, scale=1 / 256.0))
+    inp = {"data": jnp.asarray(data), "label": jnp.asarray(label)}
+    step_a = a.jit_train_step()
+    step_b = b.jit_train_step()
+    for i in range(2):
+        pa, sta, oa = step_a(pa, sta, inp, a.step_rng(i))
+        pb, stb, ob = step_b(pb, stb, inp, b.step_rng(i))
+        assert float(oa["loss"]) == pytest.approx(float(ob["loss"]),
+                                                  rel=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(pa["ip2"]["weight"])),
+        np.asarray(jax.device_get(pb["ip2"]["weight"])), rtol=1e-6)
+
+
 def test_iter_size_accumulation_matches_big_batch():
     """iter_size=2 with half batches == one update on the full batch
     (Caffe gradient-accumulation semantics)."""
