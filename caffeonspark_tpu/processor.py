@@ -257,7 +257,7 @@ class CaffeProcessor:
                 if it >= max_iter:
                     break
             self.params, self.opt_state = params, st
-            if self.rank == 0:
+            if self.rank == 0 and sp.snapshot_after_train:
                 self._snapshot(final=True)
         except BaseException as e:     # surfaced on stop()/join()
             self._error = e

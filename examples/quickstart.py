@@ -1,0 +1,113 @@
+"""Quickstart: synthetic dataset → LMDB → train → validate → test →
+feature extraction, end to end in one script (no flags needed).
+
+    python examples/quickstart.py [workdir]
+
+Demonstrates the full reference workflow on generated data: builds an
+MNIST-shaped LMDB with the bulk writer, writes solver/net prototxts,
+trains LeNet with interleaved validation through the CaffeOnSpark
+facade, runs test() means and features() extraction, and reloads the
+snapshot for finetuning."""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+
+def main(workdir=None):
+    from caffeonspark_tpu.caffe_on_spark import CaffeOnSpark
+    from caffeonspark_tpu.config import Config
+    from caffeonspark_tpu.data import LmdbWriter, get_source
+    from caffeonspark_tpu.data.synthetic import make_images
+    from caffeonspark_tpu.models.zoo import LENET
+    from caffeonspark_tpu.proto.caffe import Datum
+
+    work = workdir or tempfile.mkdtemp(prefix="cos_quickstart_")
+    os.makedirs(work, exist_ok=True)
+    print(f"workdir: {work}")
+
+    # 1. dataset → LMDB (setup-mnist.sh analog, synthetic)
+    for split, n, seed in (("train", 512, 1), ("test", 128, 99)):
+        imgs, labels = make_images(n, seed=seed)
+        recs = [(b"%08d" % i,
+                 Datum(channels=1, height=28, width=28,
+                       data=(imgs[i, 0] * 255).astype(np.uint8)
+                       .tobytes(), label=int(labels[i])).to_binary())
+                for i in range(n)]
+        LmdbWriter(os.path.join(work, f"{split}_lmdb")).write(recs)
+    print("LMDBs written")
+
+    # 2. configs: parse the zoo LeNet, point its data layer at the
+    # train LMDB, and clone a TEST-phase twin for the test LMDB
+    from caffeonspark_tpu.proto import parse_net_prototxt
+    from caffeonspark_tpu.proto.caffe import Phase
+    npm = parse_net_prototxt(LENET)
+    data = next(l for l in npm.layer if l.type == "MemoryData")
+    from caffeonspark_tpu.proto.caffe import NetStateRule
+    data.source_class = "LMDB"
+    data.memory_data_param.source = os.path.join(work, "train_lmdb")
+    data.memory_data_param.batch_size = 32
+    data.include.append(NetStateRule(phase=Phase.TRAIN))
+    test_data = data.clone()
+    test_data.include[0].phase = Phase.TEST
+    test_data.memory_data_param.source = os.path.join(work, "test_lmdb")
+    npm.layer.insert(1, test_data)
+    net_path = os.path.join(work, "lenet.prototxt")
+    with open(net_path, "w") as f:
+        f.write(npm.to_text())
+    solver_path = os.path.join(work, "solver.prototxt")
+    with open(solver_path, "w") as f:
+        f.write(f"""net: "{net_path}"
+test_iter: 4
+test_interval: 50
+base_lr: 0.01
+momentum: 0.9
+weight_decay: 0.0005
+lr_policy: "inv"
+gamma: 0.0001
+power: 0.75
+display: 50
+max_iter: 200
+snapshot: 100
+snapshot_prefix: "lenet"
+random_seed: 42
+""")
+
+    # 3. train with interleaved validation
+    conf = Config(["-conf", solver_path, "-train", "-output", work])
+    cos = CaffeOnSpark()
+    train_src = get_source(conf.train_data_layer(), phase_train=True)
+    val_src = get_source(conf.test_data_layer(), phase_train=False)
+    vdf = cos.trainWithValidation(train_src, val_src, conf)
+    print("validation rounds:",
+          [{k: round(v, 4) for k, v in r.items()} for r in vdf.rows])
+
+    # 4. test(): per-output means over the test set
+    conf.modelPath = os.path.join(work, "model.caffemodel")
+    from caffeonspark_tpu import checkpoint
+    from caffeonspark_tpu.processor import CaffeProcessor
+    proc = CaffeProcessor.instance()
+    checkpoint.save_caffemodel(conf.modelPath, proc.solver.train_net,
+                               proc.params)
+    result = cos.test(val_src, conf)
+    print("test():", {k: [round(x, 4) for x in v[:3]]
+                      for k, v in result.items()})
+
+    # 5. features(): SampleID + blobs DataFrame → json
+    fconf = Config(["-conf", solver_path, "-features", "ip1,ip2",
+                    "-label", "label",
+                    "-weights", conf.modelPath])
+    fdf = cos.features(val_src, fconf)
+    out = os.path.join(work, "features.json")
+    fdf.write(out, "json")
+    print(f"features: {len(fdf)} rows → {out}")
+
+    acc = result.get("accuracy", [0.0])[0]
+    print(f"final test accuracy: {acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

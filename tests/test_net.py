@@ -88,6 +88,56 @@ def test_slice_indivisible_raises():
         get_op("Slice").apply(Ctx(), lp, [], [jnp.ones((2, 10))])
 
 
+def test_fcn_deconv_segmentation_trains():
+    """FCN-style dense prediction: conv encoder → Deconvolution
+    upsample → Crop to input size → per-pixel SoftmaxWithLoss; the
+    Deconvolution/Crop backward path trains end-to-end."""
+    npm = NetParameter.from_text("""
+name: "mini_fcn"
+layer { name: "data" type: "Input" top: "data" top: "label"
+  input_param { shape { dim: 2 dim: 1 dim: 16 dim: 16 }
+                shape { dim: 2 dim: 16 dim: 16 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 3 pad: 1 stride: 2
+    weight_filler { type: "msra" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "score" type: "Convolution" bottom: "conv1" top: "score"
+  convolution_param { num_output: 3 kernel_size: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "upscore" type: "Deconvolution" bottom: "score"
+  top: "upscore"
+  convolution_param { num_output: 3 kernel_size: 4 stride: 2 pad: 1
+    bias_term: false weight_filler { type: "bilinear" } } }
+layer { name: "crop" type: "Crop" bottom: "upscore" bottom: "data"
+  top: "cropped" crop_param { axis: 2 } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "cropped"
+  bottom: "label" top: "loss"
+  loss_param { ignore_label: -1 } softmax_param { axis: 1 } }
+""")
+    from caffeonspark_tpu.proto import SolverParameter
+    from caffeonspark_tpu.solver import Solver
+    s = Solver(SolverParameter.from_text(
+        "base_lr: 0.3 momentum: 0.9 lr_policy: 'fixed' random_seed: 2"),
+        npm)
+    assert s.train_net.blob_shapes["upscore"] == (2, 3, 16, 16)
+    assert s.train_net.blob_shapes["cropped"] == (2, 3, 16, 16)
+    params, st = s.init()
+    step = s.jit_train_step()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(2, 1, 16, 16), jnp.float32)
+    # per-pixel labels: left half class 0, right half class 1
+    lab = np.zeros((2, 16, 16), np.float32)
+    lab[:, :, 8:] = 1.0
+    lab_j = jnp.asarray(lab)
+    losses = []
+    for i in range(120):
+        params, st, out = step(params, st,
+                               {"data": x, "label": lab_j},
+                               s.step_rng(i))
+        losses.append(float(out["loss"]))
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
 def test_infogain_and_mll_losses():
     from caffeonspark_tpu.proto.caffe import LayerParameter
     from caffeonspark_tpu.ops.layers import get_op, Ctx
