@@ -1,0 +1,95 @@
+"""DisplayUtils: visualize images/captions from result DataFrames.
+
+Analog of `caffe-grid/src/main/python/com/yahoo/ml/caffe/
+DisplayUtils.py` (notebook image/caption display, SURVEY §2.8) —
+headless-friendly: renders to PNG files (or inline in a notebook when
+one is attached) via matplotlib."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _to_hwc(img) -> np.ndarray:
+    """Accepts CHW float/uint8, HWC, flat bytes; returns HWC uint8
+    (BGR→RGB flip for 3-channel, matching the cv2 decode convention)."""
+    arr = np.asarray(img)
+    if arr.ndim == 3 and arr.shape[0] in (1, 3):
+        arr = arr.transpose(1, 2, 0)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.shape[2] == 1:
+        arr = np.repeat(arr, 3, axis=2)
+    elif arr.shape[2] == 3:
+        arr = arr[:, :, ::-1]          # BGR → RGB
+    if arr.dtype != np.uint8:
+        lo, hi = float(arr.min()), float(arr.max())
+        arr = ((arr - lo) / (hi - lo + 1e-9) * 255).astype(np.uint8)
+    return arr
+
+
+def show_image_grid(images: Sequence, *, labels: Optional[Sequence] = None,
+                    cols: int = 4, output: Optional[str] = None):
+    """Grid of images (CHW arrays, HWC arrays, or encoded bytes) with
+    optional per-image labels/captions; saves to `output` PNG when
+    given, else returns the matplotlib figure."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    decoded = []
+    for im in images:
+        if isinstance(im, (bytes, bytearray)):
+            from ..data.source import decode_image
+            im = decode_image(bytes(im), channels=3)
+        decoded.append(_to_hwc(im))
+    n = len(decoded)
+    rows = (n + cols - 1) // cols
+    fig, axes = plt.subplots(rows, cols,
+                             figsize=(3 * cols, 3 * rows), squeeze=False)
+    for i in range(rows * cols):
+        ax = axes[i // cols][i % cols]
+        ax.axis("off")
+        if i < n:
+            ax.imshow(decoded[i])
+            if labels is not None and i < len(labels):
+                ax.set_title(str(labels[i]), fontsize=9)
+    fig.tight_layout()
+    if output:
+        fig.savefig(output, dpi=80)
+        plt.close(fig)
+        return output
+    return fig
+
+
+def show_captions(rows: Sequence[Dict], *, image_col: str = "data",
+                  caption_col: str = "caption", cols: int = 3,
+                  output: Optional[str] = None):
+    """Image+caption grid from caption-DataFrame rows (the reference's
+    notebook caption display)."""
+    images = [r[image_col] for r in rows]
+    captions = [r.get(caption_col, "") for r in rows]
+    return show_image_grid(images, labels=captions, cols=cols,
+                           output=output)
+
+
+def show_features_histogram(df_rows: Sequence[Dict], column: str,
+                            output: Optional[str] = None, bins: int = 50):
+    """Histogram of a feature column's values across all rows."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    vals = np.concatenate([np.asarray(r[column], np.float64).ravel()
+                           for r in df_rows])
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.hist(vals, bins=bins)
+    ax.set_title(f"{column} ({vals.size} values)")
+    fig.tight_layout()
+    if output:
+        fig.savefig(output, dpi=80)
+        plt.close(fig)
+        return output
+    return fig

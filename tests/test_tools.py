@@ -114,6 +114,29 @@ def test_caption_embedding_round_trip(tmp_path):
             w.lower() for w in orig.split())
 
 
+def test_display_utils(tmp_path):
+    from caffeonspark_tpu.tools.display_utils import (
+        show_captions, show_features_histogram, show_image_grid)
+    from caffeonspark_tpu.data.synthetic import make_images
+    import cv2
+    imgs, labels = make_images(5, channels=3, height=16, width=16,
+                               seed=1)
+    out = show_image_grid([imgs[i] for i in range(5)],
+                          labels=[str(l) for l in labels[:5]],
+                          output=str(tmp_path / "grid.png"))
+    assert os.path.getsize(out) > 1000
+    ok, buf = cv2.imencode(".jpg",
+                           (imgs[0].transpose(1, 2, 0) * 255)
+                           .astype(np.uint8))
+    rows = [{"data": bytes(buf), "caption": "a test image"}]
+    out2 = show_captions(rows, output=str(tmp_path / "cap.png"))
+    assert os.path.getsize(out2) > 1000
+    out3 = show_features_histogram(
+        [{"f": [0.1, 0.5]}, {"f": [0.9]}], "f",
+        output=str(tmp_path / "hist.png"))
+    assert os.path.getsize(out3) > 1000
+
+
 def test_coco_pipeline_cli(tmp_path, image_dir):
     d, _ = image_dir
     coco = {
