@@ -172,6 +172,24 @@ def test_vector_mean():
     assert vector_mean(df, "v") == [2.0, 3.0]
 
 
+def test_dataframe_write_parquet_and_select(tmp_path):
+    df = DataFrame([{"SampleID": "a", "f": [1.0, 2.0], "label": 0.0},
+                    {"SampleID": "b", "f": [3.0, 4.0], "label": 1.0}])
+    p = str(tmp_path / "out.parquet")
+    df.write(p, "parquet")
+    import pyarrow.parquet as pq
+    t = pq.read_table(p)
+    assert t.num_rows == 2
+    assert set(t.column_names) == {"SampleID", "f", "label"}
+    assert t.column("f").to_pylist()[1] == [3.0, 4.0]
+    sel = df.select("SampleID", "label")
+    assert sel.columns == ["SampleID", "label"]
+    assert sel.rows[0] == {"SampleID": "a", "label": 0.0}
+    import pytest as _pt
+    with _pt.raises(ValueError, match="outputFormat"):
+        df.write(str(tmp_path / "x.bad"), "xml")
+
+
 def test_cli_end_to_end(setup):
     """spark-submit-style CLI: -train + -test in one invocation."""
     tmp, solver = setup
