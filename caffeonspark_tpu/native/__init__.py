@@ -6,7 +6,10 @@ as `libcos_native.so` (libjpeg decode + threaded NCHW transform).  The
 library builds on demand with g++ (Makefile equivalent: `make -C
 caffeonspark_tpu/native`); when the toolchain or libjpeg is missing,
 callers fall back to the cv2/numpy path in `data.transformer` /
-`data.source` — same semantics, slower.
+`data.source` — same semantics.  Measured (tools/simulator.py): on a
+single core the cv2 fallback is competitive (its SIMD decode beats
+plain libjpeg); the native path's win is its thread pool on multi-core
+executor hosts and independence from cv2.
 """
 
 from __future__ import annotations

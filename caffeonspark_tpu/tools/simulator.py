@@ -1,0 +1,127 @@
+"""Simulator: standalone data-pipeline throughput driver.
+
+Analog of `caffe-distri/src/main/java/com/yahoo/ml/jcaffe/
+Simulator.java:18-119` (decode+transform loop, no Spark, SURVEY §2.4)
+— measures the host-side image pipeline in isolation: JPEG decode →
+crop/mirror/mean/scale transform → NCHW float batches, comparing the
+native (libjpeg C++, threaded) and python (cv2/numpy) paths.
+
+    python -m caffeonspark_tpu.tools.simulator \
+        [-imageRoot DIR | -synthetic N] [-batch 4] [-iterations 50] \
+        [-height 227 -width 227 -channels 3] [-path native|python|both]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List
+
+import numpy as np
+
+
+def _load_images(args) -> List[bytes]:
+    if args.imageRoot:
+        import os
+        from .converters import IMAGE_EXTS
+        out = []
+        for name in sorted(os.listdir(args.imageRoot)):
+            if os.path.splitext(name)[1].lower() in IMAGE_EXTS:
+                with open(os.path.join(args.imageRoot, name), "rb") as f:
+                    out.append(f.read())
+        if not out:
+            raise SystemExit(f"no images under {args.imageRoot}")
+        return out
+    import cv2
+    from ..data.synthetic import make_images
+    imgs, _ = make_images(args.synthetic, channels=3, height=256,
+                          width=256, seed=0)
+    out = []
+    for i in range(args.synthetic):
+        ok, buf = cv2.imencode(
+            ".jpg", (imgs[i].transpose(1, 2, 0) * 255).astype(np.uint8))
+        assert ok
+        out.append(bytes(buf))
+    return out
+
+
+def run(args) -> dict:
+    from ..data.transformer import Transformer
+    from ..proto.caffe import TransformationParameter
+
+    jpegs = _load_images(args)
+    n = args.batch
+    tp = TransformationParameter(
+        crop_size=min(args.height, args.width) if args.crop else 0,
+        mirror=True, mean_value=[104.0, 117.0, 123.0][:args.channels],
+        scale=1.0)
+    transformer = Transformer(tp, phase_train=True, seed=0)
+    results = {}
+
+    paths = (["native", "python"] if args.path == "both"
+             else [args.path])
+    for path in paths:
+        if path == "native":
+            from .. import native
+            if not native.available():
+                print("native library unavailable; skipping",
+                      file=sys.stderr)
+                continue
+
+            def decode(batch_bytes):
+                return native.decode_batch(
+                    batch_bytes, channels=args.channels,
+                    out_h=args.height, out_w=args.width)
+        else:
+            from ..data.source import decode_image
+
+            def decode(batch_bytes):
+                return np.stack([
+                    decode_image(b, channels=args.channels,
+                                 resize_hw=(args.height, args.width))
+                    for b in batch_bytes])
+
+        # warmup
+        batch_bytes = [jpegs[i % len(jpegs)] for i in range(n)]
+        transformer(decode(batch_bytes))
+        t0 = time.perf_counter()
+        for it in range(args.iterations):
+            batch_bytes = [jpegs[(it * n + i) % len(jpegs)]
+                           for i in range(n)]
+            arr = decode(batch_bytes)
+            out = transformer(arr)
+        dt = time.perf_counter() - t0
+        ips = n * args.iterations / dt
+        results[path] = ips
+        print(f"{path:7s}: {args.iterations} x batch {n} "
+              f"({args.height}x{args.width}x{args.channels}) in "
+              f"{dt:.2f}s = {ips:.1f} images/sec  "
+              f"out={tuple(out.shape)}")
+    if len(results) == 2:
+        print(f"native speedup: "
+              f"{results['native'] / results['python']:.2f}x")
+    return results
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="simulator")
+    p.add_argument("-imageRoot", default=None,
+                   help="directory of real images")
+    p.add_argument("-synthetic", type=int, default=64,
+                   help="generate N synthetic JPEGs instead")
+    p.add_argument("-batch", type=int, default=4)
+    p.add_argument("-iterations", type=int, default=50)
+    p.add_argument("-height", type=int, default=227)
+    p.add_argument("-width", type=int, default=227)
+    p.add_argument("-channels", type=int, default=3)
+    p.add_argument("-crop", action="store_true",
+                   help="apply random crop in the transform")
+    p.add_argument("-path", choices=["native", "python", "both"],
+                   default="both")
+    run(p.parse_args(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

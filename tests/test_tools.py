@@ -114,6 +114,19 @@ def test_caption_embedding_round_trip(tmp_path):
             w.lower() for w in orig.split())
 
 
+def test_simulator_cli():
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": "",
+           "PYTHONPATH": "/root/repo"}
+    r = subprocess.run(
+        [sys.executable, "-m", "caffeonspark_tpu.tools.simulator",
+         "-synthetic", "8", "-batch", "4", "-iterations", "3",
+         "-height", "64", "-width", "64"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr[-600:]
+    assert "images/sec" in r.stdout
+
+
 def test_display_utils(tmp_path):
     from caffeonspark_tpu.tools.display_utils import (
         show_captions, show_features_histogram, show_image_grid)
