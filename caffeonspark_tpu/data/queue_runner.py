@@ -102,6 +102,27 @@ def transformer_pool(feed: FeedQueue, batch_size: int, pack: Callable,
     return threads
 
 
+def combine_batches(batches: Iterator[Dict[str, np.ndarray]], k: int,
+                    time_major: frozenset = frozenset()
+                    ) -> Iterator[Dict[str, np.ndarray]]:
+    """Concatenate k consecutive batches along the batch axis (axis 1
+    for time-major keys) — feeds iter_size>1 steps, which consume
+    (iter_size·B, ...) per call and split internally
+    (solver.train_step_fn)."""
+    if k <= 1:
+        yield from batches
+        return
+    buf: list = []
+    for b in batches:
+        buf.append(b)
+        if len(buf) == k:
+            yield {key: np.concatenate(
+                [x[key] for x in buf],
+                axis=1 if key in time_major else 0)
+                for key in buf[0]}
+            buf = []
+
+
 def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], *,
                     depth: int = 2, sharding=None
                     ) -> Iterator[Dict[str, jax.Array]]:

@@ -169,8 +169,14 @@ class MiniCluster:
         display = self.sp.display or 0
         snap_every = self.sp.snapshot or 0
         it = int(jax.device_get(st.iter))
-        gen = device_prefetch(src.batches(loop=True), depth=2,
-                              sharding=ps.input_shardings())
+        from .data.queue_runner import combine_batches
+        tmajor = frozenset(
+            n for n, _, kind in solver.train_net.input_specs
+            if kind.endswith(":T"))
+        gen = device_prefetch(
+            combine_batches(src.batches(loop=True),
+                            max(1, self.sp.iter_size), tmajor),
+            depth=2, sharding=ps.input_shardings())
         # each step consumes exactly one source batch (device_prefetch
         # shards it across dp; it does not multiply the record count)
         timer = StepTimer(batch_size=src.batch_size)

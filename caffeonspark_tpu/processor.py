@@ -239,8 +239,14 @@ class CaffeProcessor:
                 self.validation = ValidationReport(
                     solver.test_net.output_blobs)
             it = int(jax.device_get(self.opt_state.iter))
-            gen = device_prefetch(self._train_batches(), depth=2,
-                                  sharding=ps.input_shardings())
+            from .data.queue_runner import combine_batches
+            tmajor = frozenset(
+                n for n, _, kind in solver.train_net.input_specs
+                if kind.endswith(":T"))
+            gen = device_prefetch(
+                combine_batches(self._train_batches(),
+                                max(1, sp.iter_size), tmajor),
+                depth=2, sharding=ps.input_shardings())
             params, st = self.params, self.opt_state
             for batch in gen:
                 params, st, out = step(params, st, batch,

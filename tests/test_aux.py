@@ -110,6 +110,48 @@ def test_cifar10_quick_workload(tmp_path):
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
 
 
+def test_mini_cluster_iter_size(tmp_path):
+    """iter_size: 2 through the standalone CLI: feeds 2×batch records
+    per optimizer step and completes max_iter steps."""
+    from caffeonspark_tpu.data import LmdbWriter
+    from caffeonspark_tpu.data.synthetic import make_images
+    from caffeonspark_tpu.proto.caffe import Datum
+    imgs, labels = make_images(64, seed=13)
+    recs = [(b"%06d" % i,
+             Datum(channels=1, height=28, width=28,
+                   data=(imgs[i, 0] * 255).astype(np.uint8).tobytes(),
+                   label=int(labels[i])).to_binary())
+            for i in range(64)]
+    LmdbWriter(str(tmp_path / "lmdb")).write(recs)
+    net = tmp_path / "net.prototxt"
+    net.write_text(f'''
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "LMDB"
+  memory_data_param {{ source: "{tmp_path}/lmdb" batch_size: 8
+    channels: 1 height: 28 width: 28 }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}''')
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f'net: "{net}"\nbase_lr: 0.01\n'
+                      'lr_policy: "fixed"\ndisplay: 2\nmax_iter: 6\n'
+                      'iter_size: 2\nsnapshot_prefix: "i"\n'
+                      'random_seed: 3\n')
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": "",
+           "PYTHONPATH": "/root/repo"}
+    r = subprocess.run(
+        [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
+         "-solver", str(solver), "-output", str(tmp_path)],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd="/root/repo")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "iter 6/6" in r.stdout
+    assert os.path.exists(tmp_path / "i_iter_6.caffemodel")
+
+
 def test_logistic_regression_example(tmp_path):
     """examples/multiclass_logistic_regression.py end-to-end."""
     from caffeonspark_tpu.data import LmdbWriter
