@@ -898,6 +898,47 @@ def _accuracy(ctx, lp, params, bottoms):
 
 
 # ---------------------------------------------------------------------------
+# attention (extension: long-context, time-major like the recurrent layers)
+# ---------------------------------------------------------------------------
+
+def _mha_params(lp, shapes):
+    ap = lp.attention_param
+    d_model = math.prod(shapes[0][2:]) if len(shapes[0]) > 2 else 1
+    h = int(ap.num_heads)
+    hd = int(ap.head_dim)
+    wf = _filler(ap.weight_filler if ap.has("weight_filler") else None,
+                 "xavier")
+    return [("W_qkv", (3 * h * hd, d_model), wf),
+            ("W_o", (d_model, h * hd), wf)]
+
+
+@register("MultiHeadAttention", params=_mha_params)
+def _mha(ctx, lp, params, bottoms):
+    """Multi-head self-attention on time-major (T, B, D) input —
+    extension beyond the reference (SURVEY §5.7: it has no attention at
+    all).  Under jit on a mesh, GSPMD partitions the attention einsums
+    along whatever axes the activations carry; for explicit
+    sequence-parallel ring execution use `parallel.sp.ring_attention`
+    (same math, shard_map + ppermute) in a hand-rolled step."""
+    ap = lp.attention_param
+    x = bottoms[0]
+    t_steps, batch = x.shape[0], x.shape[1]
+    h, hd = int(ap.num_heads), int(ap.head_dim)
+    xf = x.reshape(t_steps, batch, -1)
+    qkv = jnp.einsum("tbd,ed->tbe", xf, params[0])
+    qkv = qkv.reshape(t_steps, batch, 3, h, hd)
+    # (B, H, T, hd)
+    q, k, v = (jnp.moveaxis(qkv[:, :, i], (0, 1, 2), (2, 0, 1))
+               for i in range(3))
+    from ..parallel.sp import attention as _plain_attention
+    o = _plain_attention(q, k, v, causal=bool(ap.causal))
+    # back to (T, B, H*hd)
+    o = jnp.moveaxis(o, (0, 1, 2), (1, 2, 0)).reshape(t_steps, batch,
+                                                      h * hd)
+    return [jnp.einsum("tbe,de->tbd", o, params[1])]
+
+
+# ---------------------------------------------------------------------------
 # recurrent layers (time-major (T, B, ·), cont-gated — Caffe RecurrentLayer)
 # ---------------------------------------------------------------------------
 

@@ -529,6 +529,20 @@ class CoSDataParameter(Message):
     ]
 
 
+class AttentionParameter(Message):
+    """Extension (no reference equivalent): multi-head self-attention for
+    long-context models.  The layer computes fused O(T²) attention that
+    GSPMD partitions over whatever mesh axes the activations carry; for
+    explicit O(T/S)-memory ring execution over the sp axis use
+    `parallel.sp.ring_attention` directly."""
+    FIELDS = [
+        Field(1, "num_heads", UINT32, default=1),
+        Field(2, "head_dim", UINT32, default=64),
+        Field(3, "causal", BOOL, default=False),
+        Field(4, "weight_filler", MESSAGE, message=FillerParameter),
+    ]
+
+
 # ---------------------------------------------------------------------------
 # LayerParameter / NetParameter / SolverParameter
 # ---------------------------------------------------------------------------
@@ -549,6 +563,7 @@ class LayerParameter(Message):
         # CoS fork extensions (numbers fork-private; text names are the API)
         Field(147, "source_class", STRING),
         Field(148, "cos_data_param", MESSAGE, message=CoSDataParameter),
+        Field(149, "attention_param", MESSAGE, message=AttentionParameter),
         # layer-specific params (upstream numbers)
         Field(100, "transform_param", MESSAGE,
               message=TransformationParameter),
