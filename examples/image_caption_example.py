@@ -31,6 +31,8 @@ def main(argv=None):
                    help="parquet with image feature vectors")
     p.add_argument("-featureColumn", default="image_features")
     p.add_argument("-captionLength", type=int, default=20)
+    p.add_argument("-beam", type=int, default=1,
+                   help="beam width (1 = greedy incremental decode)")
     a = p.parse_args(argv if argv is not None else sys.argv[1:])
 
     import jax
@@ -42,8 +44,15 @@ def main(argv=None):
     import pyarrow.parquet as pq
     t = pq.read_table(a.embeddingDFDir)
     feats = np.asarray(t.column(a.featureColumn).to_pylist(), np.float32)
-    seqs = greedy_caption(net, params, feats,
-                          max_length=a.captionLength)
+    if a.beam > 1:
+        from caffeonspark_tpu.tools.image_caption import beam_caption
+        seqs = beam_caption(read_net(a.net), params,
+                            {a.featureColumn: feats},
+                            batch=feats.shape[0], beam=a.beam,
+                            max_length=a.captionLength)
+    else:
+        seqs = greedy_caption(net, params, feats,
+                              max_length=a.captionLength)
     for i, text in enumerate(captions_to_text(seqs, vocab)):
         print(f"{i}: {text}")
 
