@@ -77,6 +77,14 @@ def main():
     # MXU-native matmul/conv precision (bf16 single-pass); Caffe-parity
     # f32 accumulation available via BENCH_PRECISION=highest
     jax.config.update("jax_default_matmul_precision", precision)
+    # persistent XLA compile cache: the 20-40s CaffeNet first-compile is
+    # paid once across bench reruns
+    cache = os.environ.get("JAX_CACHE_DIR", "/tmp/cos_jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:
+        pass
 
     ref = "/root/reference/data/bvlc_reference_net.prototxt"
     if os.path.exists(ref):

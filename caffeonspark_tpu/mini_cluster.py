@@ -76,6 +76,16 @@ class MiniCluster:
         from .proto import read_net, read_solver
         from .solver import Solver
 
+        # persistent XLA compile cache across runs (first TPU compile of
+        # a big net is 20-40s; resumes/retrains hit the cache)
+        cache = os.environ.get("JAX_CACHE_DIR", "/tmp/cos_jax_cache")
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 2)
+        except Exception:
+            pass
+
         distributed_init(args.server, args.cluster, args.rank)
 
         from .config import resolve_net_path
