@@ -146,6 +146,72 @@ def test_state_without_model_errors(tmp_path):
         checkpoint.restore(s2.train_net, p2, st2, state_path)
 
 
+def test_kill9_recovery_from_snapshot(tmp_path):
+    """Failure recovery (SURVEY §5.3): SIGKILL a trainer mid-run, resume
+    from the last periodic snapshot, training completes."""
+    from caffeonspark_tpu.data import LmdbWriter
+    imgs, labels = make_images(64, seed=21)
+    recs = [(b"%06d" % i,
+             Datum(channels=1, height=28, width=28,
+                   data=(imgs[i, 0] * 255).astype(np.uint8).tobytes(),
+                   label=int(labels[i])).to_binary())
+            for i in range(64)]
+    LmdbWriter(str(tmp_path / "lmdb")).write(recs)
+    net = tmp_path / "net.prototxt"
+    net.write_text(f'''
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "LMDB"
+  memory_data_param {{ source: "{tmp_path}/lmdb" batch_size: 8
+    channels: 1 height: 28 width: 28 }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}''')
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f'net: "{net}"\nbase_lr: 0.01\n'
+                      'lr_policy: "fixed"\ndisplay: 100\n'
+                      'max_iter: 100000\nsnapshot: 20\n'
+                      'snapshot_prefix: "k"\nrandom_seed: 4\n')
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": "",
+           "PYTHONPATH": "/root/repo"}
+    import signal, time
+    p = subprocess.Popen(
+        [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
+         "-solver", str(solver), "-output", str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd="/root/repo")
+    # wait for at least one periodic snapshot, then hard-kill
+    deadline = time.time() + 240
+    snap = None
+    while time.time() < deadline:
+        snaps = sorted(f for f in os.listdir(tmp_path)
+                       if f.startswith("k_iter_")
+                       and f.endswith(".solverstate"))
+        if snaps:
+            snap = snaps[-1]
+            break
+        time.sleep(0.5)
+    assert snap, "no periodic snapshot appeared"
+    time.sleep(1.0)
+    p.send_signal(signal.SIGKILL)
+    p.wait(timeout=60)
+    assert p.returncode != 0          # died hard, no graceful shutdown
+
+    # resume from the surviving snapshot and finish a short run
+    r = subprocess.run(
+        [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
+         "-solver", str(solver), "-output", str(tmp_path),
+         "-snapshot", str(tmp_path / snap), "-iterations", "60"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd="/root/repo")
+    assert r.returncode == 0, r.stdout[-1500:]
+    it0 = int(snap.split("_iter_")[1].split(".")[0])
+    assert f"resumed from iter {it0}" in r.stdout
+    assert "final model" in r.stdout
+
+
 def test_mini_cluster_cli(tmp_path):
     """The standalone CLI trainer end-to-end on an LMDB."""
     from caffeonspark_tpu.data import LmdbWriter
