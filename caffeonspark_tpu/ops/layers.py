@@ -938,6 +938,43 @@ def _mha(ctx, lp, params, bottoms):
     return [jnp.einsum("tbe,de->tbd", o, params[1])]
 
 
+def _moe_params(lp, shapes):
+    mp = lp.moe_param
+    d = int(shapes[0][-1])
+    e = int(mp.num_experts)
+    h = int(mp.hidden_dim)
+    wf = _filler(mp.weight_filler if mp.has("weight_filler") else None,
+                 "xavier")
+    return [("router", (d, e), wf), ("W1", (e, d, h), wf),
+            ("W2", (e, h, d), wf)]
+
+
+@register("MixtureOfExperts", params=_moe_params)
+def _moe(ctx, lp, params, bottoms):
+    """Top-1 routed expert FFN on (..., D) input — extension beyond the
+    reference.  Dispatch is a dense one-hot einsum, so under GSPMD the
+    expert-major W1/W2 tensors shard over the `ep` mesh axis
+    (`parallel.dp.tp_param_specs`) and each device computes only its
+    experts' tokens; the router uses a straight-through softmax weight
+    so routing stays differentiable."""
+    router, w1, w2 = params
+    x = bottoms[0]
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    xf = x.reshape(-1, d)                       # (N, D) tokens
+    logits = xf @ router                        # (N, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top = jnp.argmax(probs, axis=-1)            # (N,)
+    onehot = jax.nn.one_hot(top, router.shape[1], dtype=x.dtype)
+    gate = jnp.sum(probs * onehot, axis=-1, keepdims=True)
+    # dense dispatch: (E, N, D) masked tokens → per-expert FFN → combine
+    dispatched = jnp.einsum("ne,nd->end", onehot, xf)
+    hidden = jax.nn.relu(jnp.einsum("end,edh->enh", dispatched, w1))
+    out = jnp.einsum("enh,ehd->end", hidden, w2)
+    combined = jnp.einsum("end,ne->nd", out, onehot)
+    return [(combined * gate).reshape(lead + (d,))]
+
+
 # ---------------------------------------------------------------------------
 # recurrent layers (time-major (T, B, ·), cont-gated — Caffe RecurrentLayer)
 # ---------------------------------------------------------------------------

@@ -62,6 +62,9 @@ def tp_param_specs(net: Net, *, min_features: int = TP_MIN_FEATURES
                 rp = lp.recurrent_param
                 if int(rp.num_output) * 4 >= min_features:
                     spec = P("tp", None)     # (4N, D) gate split
+            elif lp.type == "MixtureOfExperts" and bname in ("W1",
+                                                             "W2"):
+                spec = P("ep", None, None)   # expert-dim split
             specs[lname][bname] = spec
     return specs
 
@@ -73,7 +76,8 @@ class ParallelSolver:
                  tensor_parallel: bool = True):
         self.solver = solver
         self.mesh = mesh
-        self.tp_on = tensor_parallel and mesh.shape.get("tp", 1) > 1
+        self.tp_on = tensor_parallel and (
+            mesh.shape.get("tp", 1) > 1 or mesh.shape.get("ep", 1) > 1)
         net = solver.train_net
         self.param_specs = (tp_param_specs(net) if self.tp_on else
                             {ln: {bn: P() for bn, _, _ in blobs}

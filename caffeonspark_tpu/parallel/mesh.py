@@ -14,6 +14,7 @@ Mesh axes:
   tp — tensor parallel (weight sharding on large InnerProducts)
   sp — sequence parallel (ring attention / long-context)
   pp — pipeline parallel (stage-partitioned nets)
+  ep — expert parallel (MixtureOfExperts expert-dim sharding)
 Axes of size 1 cost nothing; lay dp innermost-last so its collectives
 ride ICI neighbors first.
 """
@@ -26,7 +27,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("pp", "sp", "tp", "dp")
+AXES = ("pp", "ep", "sp", "tp", "dp")
 
 
 def distributed_init(coordinator: Optional[str] = None,
@@ -42,19 +43,20 @@ def distributed_init(coordinator: Optional[str] = None,
 
 
 def build_mesh(*, dp: Optional[int] = None, tp: int = 1, sp: int = 1,
-               pp: int = 1, devices=None) -> Mesh:
-    """Mesh over all devices with named axes (pp, sp, tp, dp); dp is
+               pp: int = 1, ep: int = 1, devices=None) -> Mesh:
+    """Mesh over all devices with named axes (pp, ep, sp, tp, dp); dp is
     inferred as the remainder when unset."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    fixed = tp * sp * pp
+    fixed = tp * sp * pp * ep
     if n % fixed != 0:
-        raise ValueError(f"{n} devices not divisible by tp*sp*pp={fixed}")
+        raise ValueError(
+            f"{n} devices not divisible by tp*sp*pp*ep={fixed}")
     if dp is None:
         dp = n // fixed
     if dp * fixed != n:
-        raise ValueError(f"dp*tp*sp*pp={dp * fixed} != {n} devices")
-    arr = np.asarray(devices).reshape(pp, sp, tp, dp)
+        raise ValueError(f"dp*tp*sp*pp*ep={dp * fixed} != {n} devices")
+    arr = np.asarray(devices).reshape(pp, ep, sp, tp, dp)
     return Mesh(arr, AXES)
 
 

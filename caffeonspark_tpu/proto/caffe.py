@@ -529,6 +529,17 @@ class CoSDataParameter(Message):
     ]
 
 
+class MoEParameter(Message):
+    """Extension (no reference equivalent): top-1 routed
+    mixture-of-experts FFN; the expert dimension shards over the ep
+    mesh axis."""
+    FIELDS = [
+        Field(1, "num_experts", UINT32, default=4),
+        Field(2, "hidden_dim", UINT32, default=256),
+        Field(3, "weight_filler", MESSAGE, message=FillerParameter),
+    ]
+
+
 class AttentionParameter(Message):
     """Extension (no reference equivalent): multi-head self-attention for
     long-context models.  The layer computes fused O(T²) attention that
@@ -564,6 +575,7 @@ class LayerParameter(Message):
         Field(147, "source_class", STRING),
         Field(148, "cos_data_param", MESSAGE, message=CoSDataParameter),
         Field(149, "attention_param", MESSAGE, message=AttentionParameter),
+        Field(150, "moe_param", MESSAGE, message=MoEParameter),
         # layer-specific params (upstream numbers)
         Field(100, "transform_param", MESSAGE,
               message=TransformationParameter),
