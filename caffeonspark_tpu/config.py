@@ -75,7 +75,7 @@ def build_argparser() -> argparse.ArgumentParser:
       help="embedding dataframe dir")
     # mesh extensions (not in the reference)
     a("-mesh", dest="mesh", default="",
-      help="mesh spec dp[,tp[,sp]] per process")
+      help="mesh spec dp[,tp[,sp[,ep]]] per process")
     a("-server", dest="server", default="",
       help="multi-host coordinator host:port")
     a("-rank", dest="rank", type=int, default=0, help="process rank")

@@ -11,7 +11,7 @@ surface mirrors the reference's flags:
         -solver lenet_memory_solver.prototxt \
         [-train /path/override_source] [-net net.prototxt] \
         [-weights model.caffemodel] [-snapshot state.solverstate] \
-        [-iterations N] [-devices dp[,tp[,sp]]] \
+        [-iterations N] [-devices dp[,tp[,sp[,ep]]]] \
         [-server host:port -cluster N -rank I]   # multi-host
 
 Signal actions match the reference (`caffe_mini_cluster.cpp:55-60`):
@@ -50,7 +50,7 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("-iterations", dest="iterations", type=int,
                    default=None, help="override max_iter")
     p.add_argument("-devices", dest="devices", default=None,
-                   help="mesh spec dp[,tp[,sp]] (default: all devices dp)")
+                   help="mesh spec dp[,tp[,sp[,ep]]] (default: all devices dp)")
     p.add_argument("-model", dest="model", default=None,
                    help="final model output path")
     p.add_argument("-output", dest="output", default=".",
@@ -111,9 +111,8 @@ class MiniCluster:
         self.solver = Solver(self.sp, self.net_param,
                              rank=args.rank or 0)
         if args.devices:
-            dims = [int(x) for x in args.devices.split(",")]
-            dims += [1] * (3 - len(dims))
-            mesh = build_mesh(dp=dims[0], tp=dims[1], sp=dims[2])
+            from .processor import _parse_mesh_spec
+            mesh = build_mesh(**_parse_mesh_spec(args.devices))
         else:
             mesh = build_mesh()
         self.mesh = mesh

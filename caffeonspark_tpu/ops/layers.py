@@ -943,10 +943,19 @@ def _moe_params(lp, shapes):
     d = int(shapes[0][-1])
     e = int(mp.num_experts)
     h = int(mp.hidden_dim)
-    wf = _filler(mp.weight_filler if mp.has("weight_filler") else None,
-                 "xavier")
-    return [("router", (d, e), wf), ("W1", (e, d, h), wf),
-            ("W2", (e, h, d), wf)]
+    if mp.has("weight_filler"):
+        wf = _filler(mp.weight_filler)
+        return [("router", (d, e), wf), ("W1", (e, d, h), wf),
+                ("W2", (e, h, d), wf)]
+    # explicit xavier-equivalent uniform bounds: the generic fan
+    # heuristic (fan_in = count/shape[0]) misreads these layouts —
+    # router is (in, out) and W1/W2 carry a leading expert dim
+    def unif(fan_in):
+        s = math.sqrt(3.0 / fan_in)
+        return FillerParameter(type="uniform", min=-s, max=s)
+
+    return [("router", (d, e), unif(d)), ("W1", (e, d, h), unif(d)),
+            ("W2", (e, h, d), unif(h))]
 
 
 @register("MixtureOfExperts", params=_moe_params)

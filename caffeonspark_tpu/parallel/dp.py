@@ -82,6 +82,23 @@ class ParallelSolver:
         self.param_specs = (tp_param_specs(net) if self.tp_on else
                             {ln: {bn: P() for bn, _, _ in blobs}
                              for ln, blobs in net.param_layout.items()})
+        # divisibility guard: every sharded param dim must divide by its
+        # mesh axis (an opaque XLA partition error otherwise)
+        shapes = {ln: {bn: s for bn, s, _ in blobs}
+                  for ln, blobs in net.param_layout.items()}
+        for ln, blobs in self.param_specs.items():
+            for bn, spec in blobs.items():
+                for dim_i, ax in enumerate(spec):
+                    if ax is None:
+                        continue
+                    size = mesh.shape.get(ax, 1)
+                    dim = shapes[ln][bn][dim_i]
+                    if size > 1 and dim % size != 0:
+                        raise ValueError(
+                            f"layer {ln!r} blob {bn!r}: dim {dim_i} "
+                            f"(size {dim}) not divisible by mesh axis "
+                            f"{ax!r} (size {size}) — adjust "
+                            f"num_experts/num_output or the mesh")
         self.param_sharding = {
             ln: {bn: NamedSharding(mesh, spec)
                  for bn, spec in blobs.items()}

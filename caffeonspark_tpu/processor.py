@@ -34,6 +34,18 @@ from .parallel import ParallelSolver, build_mesh
 from .solver import Solver
 
 
+def _parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """'dp[,tp[,sp[,ep]]]' → build_mesh kwargs; rejects extra dims
+    instead of silently dropping them."""
+    dims = [int(x) for x in spec.split(",")]
+    names = ["dp", "tp", "sp", "ep"]
+    if len(dims) > len(names):
+        raise ValueError(
+            f"mesh spec {spec!r} has {len(dims)} dims; expected at most "
+            f"{len(names)} ({','.join(names)})")
+    return dict(zip(names, dims))
+
+
 class ValidationReport:
     """Accumulates per-output means over batch × test_iter
     (updateValidationReport analog)."""
@@ -86,10 +98,8 @@ class CaffeProcessor:
                    if conf.devices > 0
                    else None)  # -devices limits THIS host's devices
         if conf.mesh:
-            dims = [int(x) for x in conf.mesh.split(",")]
-            dims += [1] * (3 - len(dims))
-            mesh = build_mesh(dp=dims[0], tp=dims[1], sp=dims[2],
-                              devices=devices)
+            mesh = build_mesh(devices=devices,
+                              **_parse_mesh_spec(conf.mesh))
         else:
             mesh = build_mesh(devices=devices)
         self.psolver = ParallelSolver(self.solver, mesh)
