@@ -183,14 +183,19 @@ class CaffeOnSpark:
 
 
 def _record_loop(source: DataSource):
-    """Endless record generator (the repeated RDD re-feed, :204-227)."""
+    """Endless record generator (the repeated RDD re-feed, :204-227);
+    train-phase sources emit a per-epoch shuffled order."""
+    epoch = 0
     while True:
         n = 0
-        for rec in source.records():
+        records = (source.shuffled_records(epoch) if source.phase_train
+                   else source.records())
+        for rec in records:
             n += 1
             yield rec
         if n == 0:
             raise ValueError("data source produced no records")
+        epoch += 1
 
 
 def source_conf(source: DataSource) -> Config:

@@ -110,19 +110,5 @@ class DataFrameSource(DataSource):
             out[col] = self._pack_top(top, vals)
         return out
 
-    def batches(self, *, loop: bool = True):
-        buf: List[Dict] = []
-        while True:
-            got = False
-            for row in self.rows():
-                got = True
-                buf.append(row)
-                if len(buf) == self.batch_size:
-                    yield self.next_batch(buf)
-                    buf = []
-            if not got:
-                return
-            if not loop:
-                if buf:
-                    yield self.next_batch(buf)
-                return
+    # batches() comes from the DataSource base: records() returns rows()
+    # here, so the shared shuffle/epoch logic applies unchanged.

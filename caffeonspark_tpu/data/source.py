@@ -161,12 +161,42 @@ class DataSource:
             data[i] = decode_image(r[6], channels=c, resize_hw=(h, w))
         return data
 
-    def batches(self, *, loop: bool = True) -> Iterator[Dict[str, np.ndarray]]:
-        """Convenience: records → transformed batches, epoch-looping."""
+    SHUFFLE_BUFFER = 4096
+
+    def shuffled_records(self, epoch: int) -> Iterator[ImageRecord]:
+        """Streaming shuffle over records(): a bounded reservoir buffer
+        (capacity SHUFFLE_BUFFER) emits a random resident element as
+        each new record arrives — order varies per epoch and per rank
+        but is fully determined by (seed, rank, epoch).  The reference
+        gets its shuffling from randomized LMDB keys + Spark partition
+        order; a streaming buffer is the TPU-feed equivalent."""
+        rng = np.random.RandomState(
+            (self.seed + self.rank * 9973 + epoch * 131071) & 0x7FFFFFFF)
         buf: List[ImageRecord] = []
+        for rec in self.records():
+            if len(buf) < self.SHUFFLE_BUFFER:
+                buf.append(rec)
+                continue
+            j = rng.randint(0, len(buf))
+            out, buf[j] = buf[j], rec
+            yield out
+        rng.shuffle(buf)
+        yield from buf
+
+    def batches(self, *, loop: bool = True,
+                shuffle: Optional[bool] = None
+                ) -> Iterator[Dict[str, np.ndarray]]:
+        """Convenience: records → transformed batches, epoch-looping.
+        Shuffles by default in the TRAIN phase."""
+        if shuffle is None:
+            shuffle = self.phase_train
+        buf: List[ImageRecord] = []
+        epoch = 0
         while True:
             got_any = False
-            for rec in self.records():
+            records = (self.shuffled_records(epoch) if shuffle
+                       else self.records())
+            for rec in records:
                 got_any = True
                 buf.append(rec)
                 if len(buf) == self.batch_size:
@@ -178,6 +208,7 @@ class DataSource:
                 if buf:
                     yield self.next_batch(buf)
                 return
+            epoch += 1
 
 
 class LMDB(DataSource):

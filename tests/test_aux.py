@@ -88,12 +88,12 @@ def test_cifar10_quick_workload(tmp_path):
             lyr.memory_data_param.source = str(tmp_path / "cifar_lmdb")
             lyr.memory_data_param.batch_size = 32
             lyr.clear("transform_param")   # no mean.binaryproto here
-    # cifar10_quick's gaussian std=0.0001 init plateaus ~200 iters while
-    # symmetry breaks (the reference trains it 4000 iters); by 400 the
-    # loss collapses (measured: 2.30 → 0.02 on the synthetic task)
+    # cifar10_quick's gaussian std=0.0001 init plateaus ~400 iters while
+    # symmetry breaks (the reference trains it 4000 iters); by 700 the
+    # loss collapses (measured: 2.30 → 0.04 with shuffled feeding)
     sp = SolverParameter.from_text(
         "base_lr: 0.01 momentum: 0.9 weight_decay: 0.004 "
-        "lr_policy: 'fixed' max_iter: 400 random_seed: 4")
+        "lr_policy: 'fixed' max_iter: 700 random_seed: 4")
     s = Solver(sp, npm)
     src = get_source(s.train_net.data_layers[0], phase_train=True,
                      seed=1)
@@ -101,7 +101,7 @@ def test_cifar10_quick_workload(tmp_path):
     step = s.jit_train_step()
     losses = []
     gen = src.batches(loop=True)
-    for i in range(400):
+    for i in range(700):
         b = next(gen)
         b = {k: jnp.asarray(v) * (1 / 256.0 if k == "data" else 1.0)
              for k, v in b.items()}

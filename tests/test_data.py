@@ -152,6 +152,31 @@ def test_lmdb_source_spi(tmp_path):
     assert 0.0 <= b0["data"].max() <= 1.0   # scaled
 
 
+def test_shuffled_records(tmp_path):
+    """Train-phase batches shuffle: deterministic per (seed, epoch),
+    different across epochs and seeds, and a permutation of the data."""
+    _mnist_style_lmdb(str(tmp_path), n=40)
+    lp = LayerParameter.from_text(f'''
+        name: "data" type: "MemoryData" top: "data" top: "label"
+        source_class: "LMDB"
+        memory_data_param {{ source: "{tmp_path}" batch_size: 5
+          channels: 1 height: 28 width: 28 }}''')
+    src = get_source(lp, phase_train=True, seed=7)
+    e0 = [r[0] for r in src.shuffled_records(0)]
+    e0b = [r[0] for r in src.shuffled_records(0)]
+    e1 = [r[0] for r in src.shuffled_records(1)]
+    assert e0 == e0b                       # deterministic per epoch
+    assert e0 != e1                        # varies across epochs
+    assert sorted(e0) == sorted(e1)        # permutation, no loss
+    src2 = get_source(lp, phase_train=True, seed=8)
+    assert [r[0] for r in src2.shuffled_records(0)] != e0
+    # TEST phase keeps deterministic source (key) order
+    srct = get_source(lp, phase_train=False, seed=7)
+    first = next(srct.batches(loop=False))
+    ordered = [r[1] for r in srct.records()][:5]
+    assert first["label"].tolist() == ordered
+
+
 def test_lmdb_source_rank_sharding(tmp_path):
     _mnist_style_lmdb(str(tmp_path), n=40)
     lp = LayerParameter.from_text(f'''

@@ -85,7 +85,7 @@ def setup(tmp_path):
     net.write_text(NET_TMPL.format(train=tmp_path / "train_lmdb",
                                    test=tmp_path / "test_lmdb"))
     solver = tmp_path / "solver.prototxt"
-    solver.write_text(SOLVER_TMPL.format(net=net, max_iter=100))
+    solver.write_text(SOLVER_TMPL.format(net=net, max_iter=150))
     return tmp_path, solver
 
 
@@ -97,7 +97,7 @@ def test_config_flag_parity(setup):
                    "-connection", "ethernet"])
     assert conf.isTraining and conf.isPersistent
     assert conf.outputFormat == "parquet"
-    assert conf.solverParameter.max_iter == 100
+    assert conf.solverParameter.max_iter == 150
     assert conf.train_data_layer().memory_data_param.batch_size == 16
     assert conf.test_data_layer() is not None
     assert conf.train_data_layer_id != conf.test_data_layer_id
