@@ -181,11 +181,13 @@ def _conv(ctx, lp, params, bottoms):
     (kh, kw), (sh, sw), (ph, pw), (dh, dw) = _conv_geometry(cp)
     x = bottoms[0]
     w = params[0]
+    # no preferred_element_type: the TPU MXU accumulates in f32
+    # internally either way, and forcing an f32 output breaks the
+    # conv transpose (backward) for bf16 nets with a dtype mismatch
     out = lax.conv_general_dilated(
         x, w, window_strides=(sh, sw), padding=[(ph, ph), (pw, pw)],
         rhs_dilation=(dh, dw), feature_group_count=max(1, cp.group),
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.float32)
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
     if cp.bias_term:
         out = out + params[1].reshape(1, -1, 1, 1)
     return [out]
@@ -230,8 +232,7 @@ def _deconv(ctx, lp, params, bottoms):
         padding=[(ekh - 1 - ph, ekh - 1 - ph), (ekw - 1 - pw, ekw - 1 - pw)],
         lhs_dilation=(sh, sw), rhs_dilation=(dh, dw),
         feature_group_count=g,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.float32)
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
     if cp.bias_term:
         out = out + params[1].reshape(1, -1, 1, 1)
     return [out]

@@ -240,9 +240,11 @@ class Solver:
                                                       + sp.delta)
                 else:
                     raise ValueError(f"unknown solver type {t!r}")
-                new_p[ln][bn] = w2
-                new_h[ln][bn] = h_n
-                new_h2[ln][bn] = h2_n
+                # keep each blob's dtype (the f32 lr scalar would
+                # silently upcast bf16 nets to f32 after one update)
+                new_p[ln][bn] = w2.astype(w.dtype)
+                new_h[ln][bn] = h_n.astype(h.dtype)
+                new_h2[ln][bn] = h2_n.astype(h2.dtype)
         return new_p, OptState(iter=state.iter + 1, history=new_h,
                                history2=new_h2)
 

@@ -185,6 +185,29 @@ def test_lenet_convergence_gate():
     assert acc > 0.8, f"convergence gate failed: accuracy {acc}"
 
 
+def test_bf16_training():
+    """Solver(dtype=bfloat16): params stay bf16 across updates (no f32
+    upcast from the lr scalar) and the net trains."""
+    s = Solver(SolverParameter.from_text(
+        "base_lr: 0.05 momentum: 0.9 lr_policy: 'fixed' random_seed: 1"),
+        NetParameter.from_text(SMALL_NET), dtype=jnp.bfloat16)
+    params, st = s.init()
+    assert params["conv1"]["weight"].dtype == jnp.bfloat16
+    step = s.jit_train_step()
+    gen = batches(128, 32, seed=2, scale=1 / 256.0)
+    losses = []
+    for i in range(40):
+        d, l = next(gen)
+        params, st, out = step(
+            params, st,
+            {"data": jnp.asarray(d, jnp.bfloat16), "label": jnp.asarray(l)},
+            s.step_rng(i))
+        losses.append(float(out["loss"]))
+    assert params["conv1"]["weight"].dtype == jnp.bfloat16
+    assert st.history["conv1"]["weight"].dtype == jnp.bfloat16
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
 def test_remat_matches_no_remat():
     """jax.checkpoint rematerialization must not change numerics."""
     npm = NetParameter.from_text(SMALL_NET)
