@@ -66,6 +66,10 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="override solver display interval")
     p.add_argument("-profile", dest="profile", default=None,
                    help="write a jax.profiler trace to this directory")
+    p.add_argument("-dtype", dest="dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="parameter/activation dtype (bfloat16 halves "
+                   "HBM; MXU-native)")
     return p
 
 
@@ -108,8 +112,12 @@ class MiniCluster:
         if args.display_every is not None:
             self.sp.display = args.display_every
 
+        import jax.numpy as jnp
         self.solver = Solver(self.sp, self.net_param,
-                             rank=args.rank or 0)
+                             rank=args.rank or 0,
+                             dtype=jnp.bfloat16
+                             if args.dtype == "bfloat16"
+                             else jnp.float32)
         if args.devices:
             from .processor import _parse_mesh_spec
             mesh = build_mesh(**_parse_mesh_spec(args.devices))
@@ -182,10 +190,19 @@ class MiniCluster:
         tmajor = frozenset(
             n for n, _, kind in solver.train_net.input_specs
             if kind.endswith(":T"))
-        gen = device_prefetch(
-            combine_batches(src.batches(loop=True),
-                            max(1, self.sp.iter_size), tmajor),
-            depth=2, sharding=ps.input_shardings())
+        batches_it = combine_batches(src.batches(loop=True),
+                                     max(1, self.sp.iter_size), tmajor)
+        if solver.train_net.dtype != jnp.float32:
+            import ml_dtypes
+            np_dtype = ml_dtypes.bfloat16
+
+            def _cast(bs):
+                for b in bs:
+                    yield {k: v.astype(np_dtype) for k, v in b.items()}
+
+            batches_it = _cast(batches_it)
+        gen = device_prefetch(batches_it, depth=2,
+                              sharding=ps.input_shardings())
         # each step consumes exactly one source batch (device_prefetch
         # shards it across dp; it does not multiply the record count)
         timer = StepTimer(batch_size=src.batch_size)
