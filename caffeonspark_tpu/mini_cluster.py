@@ -67,9 +67,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("-profile", dest="profile", default=None,
                    help="write a jax.profiler trace to this directory")
     p.add_argument("-dtype", dest="dtype", default="float32",
-                   choices=["float32", "bfloat16"],
-                   help="parameter/activation dtype (bfloat16 halves "
-                   "HBM; MXU-native)")
+                   choices=["float32", "bfloat16", "mixed"],
+                   help="float32 | bfloat16 (params+compute bf16) | "
+                   "mixed (f32 master weights, bf16 compute)")
     return p
 
 
@@ -113,11 +113,12 @@ class MiniCluster:
             self.sp.display = args.display_every
 
         import jax.numpy as jnp
+        dtype = (jnp.bfloat16 if args.dtype == "bfloat16"
+                 else jnp.float32)
+        compute = jnp.bfloat16 if args.dtype == "mixed" else None
         self.solver = Solver(self.sp, self.net_param,
-                             rank=args.rank or 0,
-                             dtype=jnp.bfloat16
-                             if args.dtype == "bfloat16"
-                             else jnp.float32)
+                             rank=args.rank or 0, dtype=dtype,
+                             compute_dtype=compute)
         if args.devices:
             from .processor import _parse_mesh_spec
             mesh = build_mesh(**_parse_mesh_spec(args.devices))

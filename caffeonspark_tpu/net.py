@@ -148,11 +148,17 @@ class Net:
 
     def __init__(self, net_param: NetParameter, state: Optional[NetState] = None,
                  input_shapes: Optional[Dict[str, Sequence[int]]] = None,
-                 dtype=jnp.float32, remat: Optional[bool] = None):
+                 dtype=jnp.float32, remat: Optional[bool] = None,
+                 compute_dtype=None):
         self.net_param = net_param
         self.state = state or NetState(phase=Phase.TRAIN)
         self.name = net_param.name
         self.dtype = dtype
+        # mixed precision: params stay `dtype` (f32 master weights for
+        # optimizer updates) while the forward casts params+inputs to
+        # `compute_dtype` (bf16 on the MXU); grads come back f32 via the
+        # cast's transpose
+        self.compute_dtype = compute_dtype or dtype
         # rematerialization: recompute layer activations in the backward
         # pass instead of storing them — trades MXU FLOPs for HBM
         # (jax.checkpoint per layer); COS_REMAT=1 enables globally
@@ -299,6 +305,7 @@ class Net:
         blobs: Dict[str, Array] = dict(inputs)
         ctx = L.Ctx(train=train, rng=rng,
                     state_in=net_state or {}, state_out={})
+        cast = (self.compute_dtype != self.dtype)
         for lp in self.compute_layers:
             op = L.get_op(lp.type)
             ctx.layer_name = lp.name
@@ -307,9 +314,25 @@ class Net:
                 pd = params[lp.name]
                 lparams = [pd[bname]
                            for bname, _, _ in self.param_layout[lp.name]]
+                if cast and not op.f32_stats:
+                    lparams = [p.astype(self.compute_dtype)
+                               for p in lparams]
             bottoms = [blobs[b] for b in lp.bottom]
+            if cast and not op.f32_stats:
+                # stat layers (BatchNorm) also keep their INPUT at full
+                # precision: E[x²]−E[x]² cancels catastrophically in
+                # bf16 for unnormalized activations
+                bottoms = [b.astype(self.compute_dtype)
+                           if jnp.issubdtype(b.dtype, jnp.floating)
+                           and b.dtype != self.compute_dtype else b
+                           for b in bottoms]
+            elif cast and op.f32_stats:
+                bottoms = [b.astype(self.dtype)
+                           if jnp.issubdtype(b.dtype, jnp.floating)
+                           and b.dtype != self.dtype else b
+                           for b in bottoms]
             if self.remat and train and lparams \
-                    and lp.type != "BatchNorm":
+                    and not op.f32_stats:
                 # only parameterized layers are checkpointed — wrapping
                 # elementwise ops would just block XLA fusion; BatchNorm
                 # is excluded because its running-stat side channel
@@ -331,9 +354,12 @@ class Net:
         """Total weighted loss (for jax.value_and_grad(has_aux=True))."""
         blobs, new_state = self.apply(params, inputs, train=train, rng=rng,
                                       net_state=net_state)
-        total = jnp.zeros((), self.dtype)
+        # the scalar loss ACCUMULATES in f32 regardless of compute dtype
+        # (a bf16 running sum over a large blob drops addends)
+        total = jnp.zeros((), jnp.float32)
         for name, w in self.loss_weights.items():
-            total = total + w * jnp.sum(blobs[name])
+            total = total + w * jnp.sum(blobs[name],
+                                        dtype=jnp.float32)
         return total, (blobs, new_state)
 
     def merge_forward_state(self, params: Params,
@@ -349,9 +375,10 @@ class Net:
         return out
 
     def stat_param_layers(self) -> List[str]:
-        """Layers whose param blobs are running statistics, not weights."""
+        """Layers whose param blobs are running statistics, not weights
+        (op-level f32_stats flag, e.g. BatchNorm)."""
         return [lp.name for lp in self.compute_layers
-                if lp.type == "BatchNorm"]
+                if L.get_op(lp.type).f32_stats]
 
     def num_params(self, params: Optional[Params] = None) -> int:
         if params is not None:

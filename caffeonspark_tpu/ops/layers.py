@@ -69,12 +69,17 @@ class LayerOp:
     param_specs: Callable = lambda lp, shapes: []
     is_loss: bool = False
     is_data: bool = False
+    # layer updates running statistics in the forward pass and must run
+    # in f32 (exempt from compute-dtype casts and rematerialization)
+    f32_stats: bool = False
 
 
-def register(name: str, *, params=None, is_loss=False, is_data=False):
+def register(name: str, *, params=None, is_loss=False, is_data=False,
+             f32_stats=False):
     def deco(fn):
         _REGISTRY[name] = LayerOp(name, fn, params or (lambda lp, s: []),
-                                  is_loss=is_loss, is_data=is_data)
+                                  is_loss=is_loss, is_data=is_data,
+                                  f32_stats=f32_stats)
         return fn
     return deco
 
@@ -507,7 +512,7 @@ def _bn_params(lp, shapes):
             ("count", (1,), zero)]
 
 
-@register("BatchNorm", params=_bn_params)
+@register("BatchNorm", params=_bn_params, f32_stats=True)
 def _batch_norm(ctx, lp, params, bottoms):
     p = lp.batch_norm_param
     x = bottoms[0]

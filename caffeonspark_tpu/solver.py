@@ -86,7 +86,7 @@ class Solver:
 
     def __init__(self, solver_param: SolverParameter,
                  net_param: Optional[NetParameter] = None, *,
-                 rank: int = 0, dtype=jnp.float32):
+                 rank: int = 0, dtype=jnp.float32, compute_dtype=None):
         self.param = solver_param
         self.rank = rank
         if net_param is None:
@@ -98,7 +98,8 @@ class Solver:
         if solver_param.has("train_state"):
             train_state = solver_param.train_state.clone()
             train_state.phase = Phase.TRAIN
-        self.train_net = Net(net_param, train_state, dtype=dtype)
+        self.train_net = Net(net_param, train_state, dtype=dtype,
+                             compute_dtype=compute_dtype)
 
         test_state = NetState(phase=Phase.TEST)
         if solver_param.test_state:
@@ -106,7 +107,8 @@ class Solver:
             test_state.phase = Phase.TEST
         try:
             self.test_net: Optional[Net] = Net(net_param, test_state,
-                                               dtype=dtype)
+                                               dtype=dtype,
+                                               compute_dtype=compute_dtype)
             if not self.test_net.compute_layers:
                 self.test_net = None
         except Exception:
