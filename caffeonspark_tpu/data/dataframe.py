@@ -11,7 +11,7 @@ ENCODED_IMAGE, ENCODED_IMAGE_WITH_DIM}, per-top transform params, and
 from __future__ import annotations
 
 import os
-from typing import Dict, Iterator, List, Sequence
+from typing import Dict, Iterator, Sequence
 
 import numpy as np
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import os
 import struct
-from typing import Iterator, List, Optional, Tuple
+from typing import Iterator, Tuple
 
 SEQ_MAGIC = b"SEQ\x06"
 TEXT_CLASS = "org.apache.hadoop.io.Text"

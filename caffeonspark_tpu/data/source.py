@@ -21,8 +21,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..proto.caffe import (Datum, LayerParameter, NetState, Phase,
-                           TopBlobType)
+from ..proto.caffe import Datum, LayerParameter
 from .lmdb_io import LmdbReader
 from .sequencefile import SequenceFileReader
 from .transformer import Transformer

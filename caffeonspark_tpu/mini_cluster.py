@@ -25,10 +25,6 @@ import argparse
 import os
 import signal
 import sys
-import time
-from typing import Optional
-
-import numpy as np
 
 
 def build_argparser() -> argparse.ArgumentParser:

@@ -30,9 +30,7 @@ for a mesh.
 
 from __future__ import annotations
 
-import math
-from functools import partial
-from typing import Any, Dict, NamedTuple, Optional, Tuple
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -27,7 +27,7 @@ actionable error otherwise."""
 from __future__ import annotations
 
 import socket
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 from .config import Config
 
