@@ -1,0 +1,33 @@
+# Build + test pipeline (reference `Makefile:19-27` analog: build ->
+# native lib -> tests -> python tests; here the "build" is the native
+# decode library plus an editable install).
+
+PY ?= python
+CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: build native install test bench smoke docs clean
+
+build: native install
+
+native:
+	$(MAKE) -C caffeonspark_tpu/native
+
+install:
+	$(PY) -m pip install -e . --no-deps --no-build-isolation
+
+test:
+	$(CPU_ENV) $(PY) -m pytest tests/ -x -q
+
+bench:
+	$(PY) bench.py
+
+smoke:
+	BENCH_SMOKE=1 $(PY) bench.py
+
+docs:
+	$(PY) docs/gen_html.py
+
+clean:
+	rm -rf build *.egg-info docs/_html
+	$(MAKE) -C caffeonspark_tpu/native clean 2>/dev/null || true

@@ -1,38 +1,93 @@
 """Benchmark: CaffeNet-ImageNet training throughput (images/sec/chip).
 
-The reference's headline metric (BASELINE.json).  Runs the full jitted
-train step (forward + backward + SGD momentum update, donated buffers)
-on bvlc_reference_net at batch 64 / 227x227x3 on whatever single chip is
-available.  Prints ONE JSON line.
+The reference's headline metric (BASELINE.json).  Runs the full train
+step (forward + backward + SGD momentum update) on bvlc_reference_net
+at batch 64 / 227x227x3 on whatever single chip is available, and
+reports images/sec plus MFU against the chip's bf16 peak.
+
+MEASUREMENT NOTES (hard-won, round 2):
+  * On the axon tunnel backend `block_until_ready()` returns WITHOUT
+    waiting for device execution (measured: a 50-matmul chain "done"
+    in 1.3 ms => an impossible 5,141 TFLOP/s).  Every timed section
+    here ends with `jax.device_get()` of a value data-dependent on the
+    whole computation — that cannot return early.
+  * Per-call dispatch through the tunnel costs ~10-70 ms, swamping a
+    few-ms step.  The primary metric therefore runs the training loop
+    ON DEVICE via `lax.scan` (one dispatch, one sync), which is also
+    the deployment shape of a TPU training loop.  BENCH_PIPELINE=1
+    keeps the host-fed per-step dispatch path and measures the system
+    end to end (tunnel overhead included, and reported).
 
 Env knobs:
-  BENCH_BATCH      per-step batch (default 64)
-  BENCH_ITERS      timed iterations (default 30)
-  BENCH_PRECISION  jax default_matmul_precision (default 'bfloat16' —
-                   the TPU-native choice: one MXU pass; set 'highest'
-                   for f32-accumulated 6-pass parity runs)
-  BENCH_PIPELINE=1 feed through the REAL data pipeline (JPEG LMDB →
-                   native decode → transform → device prefetch) instead
-                   of resident device arrays — measures the system, not
-                   just the chip.
+  BENCH_BATCH        per-step batch (default 64)
+  BENCH_ITERS        timed iterations (default 50)
+  BENCH_PRECISION    jax default_matmul_precision (default 'bfloat16'
+                     — one MXU pass; 'highest' for f32 parity runs)
+  BENCH_PIPELINE=1   feed through the REAL data pipeline (JPEG LMDB ->
+                     native decode -> transform -> device prefetch),
+                     host-dispatched per step
+  BENCH_SMOKE=1      tiny-shape backend liveness probe only: separates
+                     "tunnel up" from "CaffeNet compiles"
+  BENCH_PEAK_TFLOPS  chip bf16 peak for MFU (default 197 = TPU v5e)
+  BENCH_RETRIES      backend-init attempts (default 4, backoff 5s*2^n)
 
 vs_baseline: the reference repo publishes no throughput numbers
 (BASELINE.md); the ratio anchors to ~150 img/s, the commonly cited
-single-K80 BVLC AlexNet-class training rate of the reference's era.
+single-K80 AlexNet-class training rate of the reference's era.
 """
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
 
 
+def _sync(x):
+    """Force completion: device->host copy of a dependent value.
+    block_until_ready() is a NO-OP on the axon tunnel — never trust it
+    for timing."""
+    import jax
+    return np.asarray(jax.device_get(x))
+
+
+def _init_backend(retries: int, base_delay: float = 5.0):
+    """First device op with bounded retry: the axon tunnel's known
+    failure mode is a wedged init (round-1 BENCH_r01.json rc=1)."""
+    import jax
+    last = None
+    for attempt in range(retries):
+        try:
+            devs = jax.devices()
+            v = _sync(jax.numpy.zeros(()) + 1.0)
+            assert float(v) == 1.0
+            return devs
+        except Exception as e:  # noqa: BLE001 — diagnose any init error
+            last = e
+            if attempt < retries - 1:
+                delay = base_delay * (2 ** attempt)
+                print(f"bench: backend init attempt {attempt + 1}/"
+                      f"{retries} failed ({type(e).__name__}); retrying "
+                      f"in {delay:.0f}s", file=sys.stderr)
+                try:
+                    jax.extend.backend.clear_backends()
+                except Exception:
+                    pass
+                time.sleep(delay)
+    raise RuntimeError(
+        f"TPU backend failed to initialize after {retries} attempts: "
+        f"{type(last).__name__}: {last}\n"
+        "Known failure mode: the axon tunnel wedges at init. "
+        "Remedies: re-run (transient), or JAX_PLATFORMS=cpu for a "
+        "CPU sanity run, or BENCH_SMOKE=1 to isolate backend liveness "
+        "from model compile.")
+
+
 def _pipeline_inputs(batch, dshape, tmpdir):
     """Build a JPEG LMDB once and stream it through the full source
-    pipeline (decode → transform → prefetch)."""
+    pipeline (decode -> transform -> prefetch)."""
     import cv2
-    import jax
     from caffeonspark_tpu.data import LmdbWriter, get_source
     from caffeonspark_tpu.data.queue_runner import device_prefetch
     from caffeonspark_tpu.data.synthetic import make_images
@@ -63,28 +118,44 @@ def _pipeline_inputs(batch, dshape, tmpdir):
 
 
 def main():
-    import jax
-    import jax.numpy as jnp
-    from caffeonspark_tpu.proto import SolverParameter, read_net
-    from caffeonspark_tpu.solver import Solver
-
     batch = int(os.environ.get("BENCH_BATCH", "64"))
-    iters = int(os.environ.get("BENCH_ITERS", "30"))
+    iters = int(os.environ.get("BENCH_ITERS", "50"))
     precision = os.environ.get("BENCH_PRECISION", "bfloat16")
     pipeline = os.environ.get("BENCH_PIPELINE") == "1"
-    warmup = 5
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+    retries = int(os.environ.get("BENCH_RETRIES", "4"))
 
-    # MXU-native matmul/conv precision (bf16 single-pass); Caffe-parity
-    # f32 accumulation available via BENCH_PRECISION=highest
+    import jax
+    import jax.numpy as jnp
+
     jax.config.update("jax_default_matmul_precision", precision)
-    # persistent XLA compile cache: the 20-40s CaffeNet first-compile is
-    # paid once across bench reruns
     cache = os.environ.get("JAX_CACHE_DIR", "/tmp/cos_jax_cache")
     try:
         jax.config.update("jax_compilation_cache_dir", cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
     except Exception:
         pass
+
+    devs = _init_backend(retries)
+    chip = str(devs[0])
+
+    if smoke:
+        # tiny matmul with forced sync: proves the chip executes work
+        x = jnp.ones((256, 256), jnp.bfloat16)
+        t0 = time.perf_counter()
+        v = _sync(jax.jit(lambda a: (a @ a).sum())(x))
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": "backend_smoke_roundtrip_ms",
+            "value": round(dt * 1e3, 2), "unit": "ms",
+            "vs_baseline": 1.0, "chip": chip,
+            "result": float(v)}))
+        return
+
+    from caffeonspark_tpu.proto import SolverParameter, read_net
+    from caffeonspark_tpu.solver import Solver
+    from caffeonspark_tpu.utils.flops import train_step_flops
 
     ref = "/root/reference/data/bvlc_reference_net.prototxt"
     if os.path.exists(ref):
@@ -102,50 +173,79 @@ def main():
         "random_seed: 1")
     solver = Solver(sp, npm)
     params, st = solver.init()
-    step = solver.jit_train_step()
+    flops_step = train_step_flops(solver.train_net)
 
     specs = dict((n, s) for n, s, _ in solver.train_net.input_specs)
     dshape = (batch,) + tuple(specs["data"][1:])
 
-    tmp_ctx = None
+    rng = np.random.RandomState(0)
+    data = jnp.asarray(rng.rand(*dshape).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, 1000, batch).astype(np.float32))
+    fixed = {"data": data, "label": label}
+
     if pipeline:
+        # host-dispatched loop fed by the real decode/transform pipeline
         import tempfile
-        tmp_ctx = tempfile.TemporaryDirectory(prefix="cos_bench_")
-        gen = _pipeline_inputs(batch, dshape, tmp_ctx.name)
-
-        def next_inputs():
-            return next(gen)
+        step = solver.jit_train_step()
+        with tempfile.TemporaryDirectory(prefix="cos_bench_") as td:
+            gen = _pipeline_inputs(batch, dshape, td)
+            for i in range(5):
+                params, st, out = step(params, st, next(gen),
+                                       solver.step_rng(i))
+            _sync(out["loss"])
+            t0 = time.perf_counter()
+            for i in range(iters):
+                params, st, out = step(params, st, next(gen),
+                                       solver.step_rng(5 + i))
+            _sync(out["loss"])
+            dt = time.perf_counter() - t0
+        ips = batch * iters / dt
+        metric = "caffenet_imagenet_train_images_per_sec_per_chip_pipeline"
     else:
-        rng = np.random.RandomState(0)
-        data = jnp.asarray(rng.rand(*dshape).astype(np.float32))
-        label = jnp.asarray(
-            rng.randint(0, 1000, batch).astype(np.float32))
-        fixed = {"data": data, "label": label}
+        # ON-DEVICE loop: lax.scan over the chained train step, one
+        # dispatch + one forced sync — measures the chip, not the tunnel
+        step_fn = solver.train_step_fn()
 
-        def next_inputs():
-            return fixed
+        def run(p, s, inputs, rngs):
+            def body(carry, r):
+                p, s = carry
+                p, s, out = step_fn(p, s, inputs, r)
+                return (p, s), out["loss"]
+            (p, s), losses = jax.lax.scan(body, (p, s), rngs)
+            return p, s, losses
 
-    for i in range(warmup):
-        params, st, out = step(params, st, next_inputs(),
-                               solver.step_rng(i))
-    jax.block_until_ready(out["loss"])
+        runj = jax.jit(run, donate_argnums=(0, 1))
+        rngs = jnp.stack([solver.step_rng(i) for i in range(iters)])
+        # warmup/compile pass
+        params, st, losses = runj(params, st, fixed, rngs)
+        _sync(losses)
+        t0 = time.perf_counter()
+        params, st, losses = runj(params, st, fixed, rngs)
+        final = _sync(losses)
+        dt = time.perf_counter() - t0
+        if not np.all(np.isfinite(final)):
+            print(f"bench: WARNING non-finite losses: {final[-3:]}",
+                  file=sys.stderr)
+        ips = batch * iters / dt
+        metric = "caffenet_imagenet_train_images_per_sec_per_chip"
 
-    t0 = time.perf_counter()
-    for i in range(iters):
-        params, st, out = step(params, st, next_inputs(),
-                               solver.step_rng(warmup + i))
-    jax.block_until_ready(out["loss"])
-    dt = time.perf_counter() - t0
-
-    ips = batch * iters / dt
-    if tmp_ctx is not None:
-        tmp_ctx.cleanup()
+    tflops = flops_step * iters / dt / 1e12
+    mfu = tflops / peak_tflops
+    if mfu > 1.0:
+        print(f"bench: ERROR implied {tflops:.0f} TFLOP/s exceeds chip "
+              f"peak {peak_tflops:.0f} — timing is broken, refusing to "
+              "report", file=sys.stderr)
+        sys.exit(1)
     print(json.dumps({
-        "metric": "caffenet_imagenet_train_images_per_sec_per_chip"
-                  + ("_pipeline" if pipeline else ""),
+        "metric": metric,
         "value": round(ips, 2),
         "unit": "images/sec",
         "vs_baseline": round(ips / 150.0, 3),
+        "mfu": round(mfu, 4),
+        "model_tflops_per_sec": round(tflops, 2),
+        "flops_per_step": flops_step,
+        "batch": batch, "iters": iters,
+        "precision": precision, "chip": chip,
     }))
 
 

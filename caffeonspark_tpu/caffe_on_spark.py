@@ -28,6 +28,7 @@ import numpy as np
 from .config import Config
 from .data.source import DataSource, get_source
 from .processor import CaffeProcessor
+from .utils import fsutils
 
 
 class DataFrame:
@@ -56,15 +57,14 @@ class DataFrame:
                          for c in self.columns})
 
     def write(self, path: str, fmt: str = "json") -> None:
-        os.makedirs(os.path.dirname(os.path.abspath(path)),
-                    exist_ok=True)
         if fmt == "json":
-            with open(path, "w") as f:
+            with fsutils.open_file(path, "w") as f:
                 for r in self.rows:
                     f.write(json.dumps(r) + "\n")
         elif fmt == "parquet":
             import pyarrow.parquet as pq
-            pq.write_table(self.to_arrow(), path)
+            with fsutils.open_file(path, "wb") as f:
+                pq.write_table(self.to_arrow(), f)
         else:
             raise ValueError(f"outputFormat {fmt!r}")
 
@@ -119,8 +119,10 @@ class CaffeOnSpark:
         try:
             train_bs = source_train.batch_size
             val_bs = source_validation.batch_size
-            train_gen = _record_loop(source_train)
-            val_gen = _record_loop(source_validation)
+            persistent = bool(getattr(conf, "isPersistent", False))
+            train_gen = _record_loop(source_train, persistent=persistent)
+            val_gen = _record_loop(source_validation,
+                                   persistent=persistent)
             max_iter = sp.max_iter
             fed = 0
             drops_seen = 0
@@ -176,23 +178,42 @@ class CaffeOnSpark:
     # ------------------------------------------------------------------
     def _feed_until_done(self, proc: CaffeProcessor,
                          source: DataSource) -> None:
-        gen = _record_loop(source)
+        gen = _record_loop(source,
+                           persistent=bool(getattr(proc.conf,
+                                                   "isPersistent", False)))
         while proc._thread is not None and proc._thread.is_alive():
             if not proc.feed_queue(0, next(gen)):
                 break
 
 
-def _record_loop(source: DataSource):
+def _record_loop(source: DataSource, persistent: bool = False):
     """Endless record generator (the repeated RDD re-feed, :204-227);
-    train-phase sources emit a per-epoch shuffled order."""
+    train-phase sources emit a per-epoch shuffled order.  With
+    `persistent` (the -persistent flag, sourceRDD.persist analog,
+    CaffeOnSpark.scala:206) epoch 0 materializes the decoded records in
+    memory and later epochs re-serve them (seeded per-epoch reshuffle)
+    instead of re-reading the backing store."""
     epoch = 0
+    cache: Optional[List] = [] if persistent else None
     while True:
         n = 0
-        records = (source.shuffled_records(epoch) if source.phase_train
-                   else source.records())
-        for rec in records:
-            n += 1
-            yield rec
+        if cache and epoch > 0:
+            if source.phase_train:
+                rng = np.random.RandomState(source.epoch_seed(epoch))
+                order = rng.permutation(len(cache))
+            else:
+                order = range(len(cache))
+            for i in order:
+                n += 1
+                yield cache[i]
+        else:
+            records = (source.shuffled_records(epoch)
+                       if source.phase_train else source.records())
+            for rec in records:
+                n += 1
+                if cache is not None:
+                    cache.append(rec)
+                yield rec
         if n == 0:
             raise ValueError("data source produced no records")
         epoch += 1
@@ -218,7 +239,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # the trained model is handed to a later -test/-features phase
         # through the model file, as the reference does via -model
         if not conf.modelPath:
-            conf.modelPath = os.path.join(conf.outputPath or ".",
+            conf.modelPath = fsutils.join(conf.outputPath or ".",
                                           "model.caffemodel")
         train_layer = conf.train_data_layer()
         src = get_source(train_layer, phase_train=True, rank=conf.rank,
@@ -235,7 +256,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                  resize=conf.resize)
             df = cos.trainWithValidation(src, val_src, conf)
             if conf.outputPath:
-                df.write(os.path.join(conf.outputPath,
+                df.write(fsutils.join(conf.outputPath,
                                       "validation." + conf.outputFormat),
                          conf.outputFormat)
         else:
@@ -246,10 +267,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         # model wins (even over a -weights finetune source); in
         # test/features-only runs, -model supplies the weights
         if conf.isTraining and conf.modelPath \
-                and os.path.exists(conf.modelPath):
+                and fsutils.exists(conf.modelPath):
             conf.snapshotModelFile = conf.modelPath
             conf.snapshotStateFile = ""
-        elif conf.modelPath and os.path.exists(conf.modelPath) \
+        elif conf.modelPath and fsutils.exists(conf.modelPath) \
                 and not conf.snapshotModelFile:
             conf.snapshotModelFile = conf.modelPath
         layer = conf.test_data_layer() or conf.train_data_layer()
@@ -262,14 +283,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             out = json.dumps(result)
             print(out)
             if conf.outputPath:
-                os.makedirs(conf.outputPath, exist_ok=True)
-                with open(os.path.join(conf.outputPath, "test_result"),
-                          "w") as f:
+                with fsutils.open_file(
+                        fsutils.join(conf.outputPath, "test_result"),
+                        "w") as f:
                     f.write(out + "\n")
         else:
             df = cos.features(src, conf)
             if conf.outputPath:
-                df.write(os.path.join(conf.outputPath,
+                df.write(fsutils.join(conf.outputPath,
                                       "features." + conf.outputFormat),
                          conf.outputFormat)
     return 0

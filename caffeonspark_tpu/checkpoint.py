@@ -30,6 +30,7 @@ from .net import Net, Params
 from .proto.caffe import (BlobProto, BlobShape, LayerParameter,
                           NetParameter, SnapshotFormat, SolverState)
 from .solver import OptState
+from .utils import fsutils
 
 Array = jax.Array
 
@@ -69,10 +70,9 @@ def params_to_net_param(net: Net, params: Params) -> NetParameter:
 
 
 def save_caffemodel(path: str, net: Net, params: Params) -> None:
-    d = os.path.dirname(os.path.abspath(path))
-    os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(params_to_net_param(net, params).to_binary())
+    """Local paths or any fsspec scheme (hdfs://, gs://, memory://) —
+    the FSUtils.CopyFileToHDFS role collapses into a remote open."""
+    fsutils.write_bytes(path, params_to_net_param(net, params).to_binary())
 
 
 def load_caffemodel_blobs(path: str) -> Dict[str, list]:
@@ -80,8 +80,7 @@ def load_caffemodel_blobs(path: str) -> Dict[str, list]:
     Reads both the modern `layer` field and the deprecated V1 `layers`
     field, so published legacy models (original bvlc_reference zoo)
     import directly."""
-    with open(path, "rb") as f:
-        npm = NetParameter.from_binary(f.read())
+    npm = NetParameter.from_binary(fsutils.read_bytes(path))
     out = {lp.name: [_from_blobproto(bp) for bp in lp.blobs]
            for lp in npm.layer if lp.blobs}
     for lp in npm.layers:            # V1 legacy
@@ -95,7 +94,13 @@ def copy_layers(net: Net, params: Params, weights_path: str, *,
     """Finetune: overwrite params with same-named, same-shaped blobs from
     a .caffemodel / .caffemodel.h5 (CaffeNet.cpp copyLayers analog)."""
     if weights_path.endswith(".h5"):
-        loaded = _load_h5_blobs(weights_path)
+        if fsutils.is_remote(weights_path):
+            import tempfile
+            with tempfile.TemporaryDirectory() as td:
+                loaded = _load_h5_blobs(fsutils.download(
+                    weights_path, os.path.join(td, "w.h5")))
+        else:
+            loaded = _load_h5_blobs(fsutils.strip_local(weights_path))
     else:
         loaded = load_caffemodel_blobs(weights_path)
     out = {ln: dict(bl) for ln, bl in params.items()}
@@ -170,16 +175,26 @@ def snapshot(net: Net, params: Params, opt_state: OptState, prefix: str,
     """Write model + state; returns (model_path, state_path)."""
     it = int(jax.device_get(opt_state.iter))
     h5 = fmt == SnapshotFormat.HDF5
-    d = os.path.dirname(os.path.abspath(prefix))
-    os.makedirs(d, exist_ok=True)
+    remote = fsutils.is_remote(prefix)
+    if not remote:
+        os.makedirs(fsutils.dirname(prefix), exist_ok=True)
     model_path = snapshot_filename(prefix, it, is_state=False, h5=h5)
     state_path = snapshot_filename(prefix, it, is_state=True, h5=h5)
     if h5:
-        _save_h5_blobs(model_path, net, params)
+        if remote:
+            # h5py needs a real file: write locally, upload
+            # (FSUtils.scala:47-75 CopyFileToHDFS pattern)
+            import tempfile
+            with tempfile.TemporaryDirectory() as td:
+                local = os.path.join(td, fsutils.basename(model_path))
+                _save_h5_blobs(local, net, params)
+                fsutils.upload(local, model_path)
+        else:
+            _save_h5_blobs(fsutils.strip_local(model_path), net, params)
     else:
         save_caffemodel(model_path, net, params)
 
-    st = SolverState(iter=it, learned_net=os.path.basename(model_path))
+    st = SolverState(iter=it, learned_net=fsutils.basename(model_path))
     # reference Caffe doubles the history list only for solvers with a
     # second accumulator (its AdaDelta/Adam do the same) — keeping SGD
     # states at exactly n_params blobs preserves .solverstate interop
@@ -193,15 +208,25 @@ def snapshot(net: Net, params: Params, opt_state: OptState, prefix: str,
                     jax.device_get(hist[lname][bname]))))
     if h5:
         import h5py
-        with h5py.File(state_path, "w") as f:
-            f.attrs["iter"] = it
-            f.attrs["learned_net"] = os.path.basename(model_path)
-            g = f.create_group("history")
-            for i, bp in enumerate(st.history):
-                g.create_dataset(str(i), data=_from_blobproto(bp))
+
+        def _write_state_h5(p):
+            with h5py.File(p, "w") as f:
+                f.attrs["iter"] = it
+                f.attrs["learned_net"] = fsutils.basename(model_path)
+                g = f.create_group("history")
+                for i, bp in enumerate(st.history):
+                    g.create_dataset(str(i), data=_from_blobproto(bp))
+
+        if remote:
+            import tempfile
+            with tempfile.TemporaryDirectory() as td:
+                local = os.path.join(td, fsutils.basename(state_path))
+                _write_state_h5(local)
+                fsutils.upload(local, state_path)
+        else:
+            _write_state_h5(fsutils.strip_local(state_path))
     else:
-        with open(state_path, "wb") as f:
-            f.write(st.to_binary())
+        fsutils.write_bytes(state_path, st.to_binary())
     return model_path, state_path
 
 
@@ -215,22 +240,29 @@ def restore(net: Net, params: Params, opt_state: OptState,
     import jax.numpy as jnp
     if state_path.endswith(".h5"):
         import h5py
-        with h5py.File(state_path, "r") as f:
+        local_state = state_path
+        if fsutils.is_remote(state_path):
+            import tempfile
+            _td = tempfile.TemporaryDirectory()
+            local_state = fsutils.download(
+                state_path, os.path.join(_td.name, "s.h5"))
+        else:
+            local_state = fsutils.strip_local(state_path)
+        with h5py.File(local_state, "r") as f:
             it = int(f.attrs["iter"])
             learned = str(f.attrs.get("learned_net", ""))
             hist = [np.asarray(f["history"][k]) for k in
                     sorted(f["history"], key=lambda s: int(s))]
     else:
-        with open(state_path, "rb") as f:
-            st = SolverState.from_binary(f.read())
+        st = SolverState.from_binary(fsutils.read_bytes(state_path))
         it = int(st.iter)
         learned = st.learned_net
         hist = [_from_blobproto(bp) for bp in st.history]
 
     if weights_path is None and learned:
-        cand = os.path.join(os.path.dirname(os.path.abspath(state_path)),
-                            os.path.basename(learned))
-        if os.path.exists(cand):
+        cand = fsutils.join(fsutils.dirname(state_path),
+                            fsutils.basename(learned))
+        if fsutils.exists(cand):
             weights_path = cand
     if weights_path is None:
         raise ValueError("resume needs the model file (-weights) — state "

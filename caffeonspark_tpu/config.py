@@ -44,7 +44,8 @@ def build_argparser() -> argparse.ArgumentParser:
     a("-devices", dest="devices", type=int, default=0,
       help="devices per executor (0 = all local)")
     a("-persistent", dest="isPersistent", action="store_true",
-      help="persist intermediate DataFrames to disk")
+      help="cache decoded source records in memory after epoch 0 "
+           "(sourceRDD.persist analog)")
     a("-snapshot", dest="snapshotStateFile", default="",
       help="solverstate to resume from")
     a("-weights", dest="snapshotModelFile", default="",
@@ -123,7 +124,8 @@ class Config:
         from .proto import NetState
         state = NetState(phase=phase)
         for i, lyr in enumerate(self.netParam.layer):
-            if lyr.type not in ("MemoryData", "CoSData", "Data"):
+            if lyr.type not in ("MemoryData", "CoSData", "Data",
+                                "HDF5Data"):
                 continue
             # full NetStateRule semantics: include rules OR'd, exclude
             # honored, rule-less layers in every phase

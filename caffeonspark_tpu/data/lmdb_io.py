@@ -195,16 +195,21 @@ class LmdbReader:
         if num_partitions <= 1:
             return [(None, None)]
         ks = list(self.keys())
-        if not ks:
-            return [(None, None)]
-        per = max(1, len(ks) // num_partitions)
+        n = num_partitions
         bounds: List[Tuple[Optional[bytes], Optional[bytes]]] = []
-        for i in range(num_partitions):
-            lo = None if i == 0 else ks[i * per]
-            hi = (None if i == num_partitions - 1
-                  else ks[min((i + 1) * per, len(ks) - 1)])
-            if lo is not None and hi is not None and lo >= hi:
+        # exactly n ranges, each rank a DISTINCT (possibly empty) slice:
+        # an empty range is (k, k) — items() is [start, stop) so it
+        # yields nothing — rather than being dropped, which would alias
+        # ranks onto the same keys via `rank % len(ranges)`
+        for i in range(n):
+            si = i * len(ks) // n
+            ei = (i + 1) * len(ks) // n
+            if si >= ei:
+                k0 = ks[0] if ks else b""
+                bounds.append((k0, k0))
                 continue
+            lo = None if si == 0 else ks[si]
+            hi = None if ei >= len(ks) else ks[ei]
             bounds.append((lo, hi))
         return bounds
 

@@ -64,6 +64,14 @@ class FeedQueue:
 
     def stop(self):
         self._stopped = True
+        try:                     # wake a consumer blocked in take()
+            self._q.put_nowait(STOP_MARK)
+        except queue.Full:
+            pass
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
 
     def __len__(self):
         return self._q.qsize()

@@ -58,6 +58,13 @@ def datum_to_record(key: bytes, raw: bytes) -> ImageRecord:
     """LMDB value (serialized Datum) → 7-tuple record
     (`LmdbRDD.scala:136-151` + CHW ordering :270-281)."""
     d = Datum.from_binary(raw)
+    if not d.encoded and not d.has("data") and d.float_data:
+        # float-payload Datum (e.g. feature LMDBs): raw float32 planes,
+        # not image bytes — pass through as an ndarray payload
+        arr = np.asarray(list(d.float_data), np.float32).reshape(
+            d.channels, d.height, d.width)
+        return (key.decode("latin-1"), float(d.label), d.channels,
+                d.height, d.width, False, arr)
     if d.encoded or not d.has("data"):
         data = d.data if d.has("data") else b""
         return (key.decode("latin-1"), float(d.label), d.channels,
@@ -137,8 +144,11 @@ class DataSource:
                         raise ValueError(
                             f"record {rid}: {rh}x{rw} != layer {h}x{w} "
                             "(set -resize for encoded sources)")
-                    data[i] = np.frombuffer(payload, np.uint8).astype(
-                        np.float32).reshape(rc, rh, rw)
+                    if isinstance(payload, np.ndarray):
+                        data[i] = payload.reshape(rc, rh, rw)
+                    else:
+                        data[i] = np.frombuffer(payload, np.uint8).astype(
+                            np.float32).reshape(rc, rh, rw)
         out_names = list(self.layer.top)
         batch = {out_names[0]: self.transformer(data)}
         if len(out_names) > 1:
@@ -162,6 +172,13 @@ class DataSource:
 
     SHUFFLE_BUFFER = 4096
 
+    def epoch_seed(self, epoch: int) -> int:
+        """Deterministic per-(seed, rank, epoch) shuffle seed — shared
+        by the streaming shuffle and the -persistent cache reshuffle so
+        both modes see the same epoch orders."""
+        return (self.seed + self.rank * 9973
+                + epoch * 131071) & 0x7FFFFFFF
+
     def shuffled_records(self, epoch: int) -> Iterator[ImageRecord]:
         """Streaming shuffle over records(): a bounded reservoir buffer
         (capacity SHUFFLE_BUFFER) emits a random resident element as
@@ -169,8 +186,7 @@ class DataSource:
         but is fully determined by (seed, rank, epoch).  The reference
         gets its shuffling from randomized LMDB keys + Spark partition
         order; a streaming buffer is the TPU-feed equivalent."""
-        rng = np.random.RandomState(
-            (self.seed + self.rank * 9973 + epoch * 131071) & 0x7FFFFFFF)
+        rng = np.random.RandomState(self.epoch_seed(epoch))
         buf: List[ImageRecord] = []
         for rec in self.records():
             if len(buf) < self.SHUFFLE_BUFFER:
@@ -280,6 +296,10 @@ _CLASS_MAP = {
 def get_source(layer: LayerParameter, **kw) -> DataSource:
     """Reflective factory keyed on prototxt `source_class`
     (DataSource.scala:130-167 analog)."""
+    if layer.type == "HDF5Data":
+        # Caffe layer type with no CoS source_class: route directly
+        from .hdf5 import HDF5Source
+        return HDF5Source(layer, **kw)
     cls_name = layer.source_class
     if not cls_name:
         raise ValueError(f"data layer {layer.name!r} has no source_class")

@@ -6,11 +6,13 @@ mean-subtract per batch, SURVEY §2.4).  Runs on the host CPU over numpy
 batches (the TPU analog of the reference's transformer threads feeding
 preallocated blobs), so the jitted step receives ready NCHW tensors.
 
-Order of operations (matches Caffe Transform):
+Order of operations (matches Caffe Transform, data_transformer.cpp):
   1. crop (random at TRAIN, center at TEST)
-  2. mirror (random horizontal flip at TRAIN)
-  3. mean subtraction (mean_file pixel-wise, else mean_value per channel)
-  4. scale multiplication
+  2. mean_file subtraction at the SOURCE pixel — the mean is cropped at
+     the same per-sample (h_off, w_off) as the image, before mirroring
+  3. mirror (random horizontal flip at TRAIN)
+  4. mean_value per-channel subtraction (commutes with the flip)
+  5. scale multiplication
 """
 
 from __future__ import annotations
@@ -64,6 +66,19 @@ class Transformer:
         crop = int(tp.crop_size)
         out = batch
 
+        # Caffe subtracts mean_file at the SOURCE index (data_index uses
+        # h_off/w_off, mirror only remaps the destination) — equivalent
+        # to subtracting the full-size mean BEFORE crop+flip.
+        if self.mean is not None:
+            m = self.mean
+            if m.shape[1] == h and m.shape[2] == w:
+                out = out - m[None]
+                mean_done = True
+            else:
+                mean_done = False  # crop-sized mean: subtract post-crop
+        else:
+            mean_done = True
+
         if crop and (crop != h or crop != w):
             if crop > h or crop > w:
                 raise ValueError(f"crop_size {crop} exceeds input {h}x{w}")
@@ -82,19 +97,22 @@ class Transformer:
         else:
             out = out.copy()
 
-        if tp.mirror and self.train:
-            flip = self.rng.randint(0, 2, size=n).astype(bool)
-            out[flip] = out[flip, :, :, ::-1]
-
-        if self.mean is not None:
+        if not mean_done:
             m = self.mean
-            if crop and (m.shape[1] != out.shape[2]
-                         or m.shape[2] != out.shape[3]):
+            if (m.shape[1] != out.shape[2]
+                    or m.shape[2] != out.shape[3]):
                 hs0 = (m.shape[1] - out.shape[2]) // 2
                 ws0 = (m.shape[2] - out.shape[3]) // 2
                 m = m[:, hs0:hs0 + out.shape[2], ws0:ws0 + out.shape[3]]
             out = out - m[None]
-        elif tp.mean_value:
+
+        if tp.mirror and self.train:
+            flip = self.rng.randint(0, 2, size=n).astype(bool)
+            out[flip] = out[flip, :, :, ::-1]
+
+        # mean_file and mean_value are mutually exclusive (checked in
+        # __init__); mean_file was already subtracted pre-flip above
+        if tp.mean_value:
             mv = np.asarray(list(tp.mean_value), np.float32)
             if len(mv) == 1:
                 out = out - mv[0]

@@ -46,7 +46,12 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("-iterations", dest="iterations", type=int,
                    default=None, help="override max_iter")
     p.add_argument("-devices", dest="devices", default=None,
-                   help="mesh spec dp[,tp[,sp[,ep]]] (default: all devices dp)")
+                   help="device count N (N-way data-parallel, the "
+                   "reference's GPUs-per-node semantics) or mesh spec "
+                   "dp[,tp[,sp[,ep]]] (default: all devices dp)")
+    p.add_argument("-mesh", dest="mesh", default=None,
+                   help="mesh spec dp[,tp[,sp[,ep]]] (same as the "
+                   "driver CLI's -mesh; wins over -devices)")
     p.add_argument("-model", dest="model", default=None,
                    help="final model output path")
     p.add_argument("-output", dest="output", default=".",
@@ -115,9 +120,18 @@ class MiniCluster:
         self.solver = Solver(self.sp, self.net_param,
                              rank=args.rank or 0, dtype=dtype,
                              compute_dtype=compute)
-        if args.devices:
+        spec = getattr(args, "mesh", None) or args.devices
+        if spec:
             from .processor import _parse_mesh_spec
-            mesh = build_mesh(**_parse_mesh_spec(args.devices))
+            spec = str(spec)
+            kw = _parse_mesh_spec(spec)
+            devices = None
+            if "," not in spec:
+                # bare count N: use N local devices data-parallel (the
+                # reference's GPUs-per-node -devices semantics)
+                import jax
+                devices = jax.devices()[:kw["dp"]]
+            mesh = build_mesh(devices=devices, **kw)
         else:
             mesh = build_mesh()
         self.mesh = mesh
@@ -205,8 +219,16 @@ class MiniCluster:
         timer = StepTimer(batch_size=src.batch_size)
         timer.start()
         smoothed = None
+        # fault-injection for failure drills (tests/test_multihost.py):
+        # a per-step delay widens the window in which a rank can be
+        # killed mid-run deterministically
+        fault_delay = float(
+            os.environ.get("COS_FAULT_STEP_DELAY_MS", "0") or 0) / 1e3
         with profile_trace(self.args.profile):
             while it < max_iter and not self._stop:
+                if fault_delay:
+                    import time
+                    time.sleep(fault_delay)
                 batch = next(gen)
                 params, st, out = step(params, st, batch,
                                        solver.step_rng(it))

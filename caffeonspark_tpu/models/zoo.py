@@ -123,13 +123,14 @@ def lenet(batch_size: int = 64) -> NetParameter:
     return npm
 
 
-def vgg16(batch_size: int = 32, num_classes: int = 1000) -> NetParameter:
+def vgg16(batch_size: int = 32, num_classes: int = 1000,
+          image_size: int = 224) -> NetParameter:
     """VGG-16 (Simonyan & Zisserman): 13 conv3x3 + 3 fc."""
     t = f"""
 name: "VGG16"
 layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
   memory_data_param {{ batch_size: {batch_size} channels: 3
-    height: 224 width: 224 }} }}
+    height: {image_size} width: {image_size} }} }}
 """
     cfg = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
     bottom = "data"
@@ -330,14 +331,56 @@ layer {{ name: "{name}/output" type: "Concat"
     return t
 
 
-def googlenet(batch_size: int = 32, num_classes: int = 1000
+def _googlenet_aux_head(idx: int, bottom: str, num_classes: int) -> str:
+    """bvlc_googlenet auxiliary classifier (train_val.prototxt loss1/
+    loss2 towers): AVE pool 5x5/3 -> 1x1 conv 128 -> fc 1024 ->
+    dropout 0.7 -> fc classes, SoftmaxWithLoss weight 0.3, TRAIN only."""
+    p = f"loss{idx}"
+    return f"""
+layer {{ name: "{p}/ave_pool" type: "Pooling" bottom: "{bottom}"
+  top: "{p}/ave_pool" include {{ phase: TRAIN }}
+  pooling_param {{ pool: AVE kernel_size: 5 stride: 3 }} }}
+layer {{ name: "{p}/conv" type: "Convolution" bottom: "{p}/ave_pool"
+  top: "{p}/conv" include {{ phase: TRAIN }}
+  param {{ lr_mult: 1 decay_mult: 1 }} param {{ lr_mult: 2 decay_mult: 0 }}
+  convolution_param {{ num_output: 128 kernel_size: 1
+    weight_filler {{ type: "xavier" }}
+    bias_filler {{ type: "constant" value: 0.2 }} }} }}
+layer {{ name: "{p}/relu_conv" type: "ReLU" bottom: "{p}/conv"
+  top: "{p}/conv" include {{ phase: TRAIN }} }}
+layer {{ name: "{p}/fc" type: "InnerProduct" bottom: "{p}/conv"
+  top: "{p}/fc" include {{ phase: TRAIN }}
+  param {{ lr_mult: 1 decay_mult: 1 }} param {{ lr_mult: 2 decay_mult: 0 }}
+  inner_product_param {{ num_output: 1024
+    weight_filler {{ type: "xavier" }}
+    bias_filler {{ type: "constant" value: 0.2 }} }} }}
+layer {{ name: "{p}/relu_fc" type: "ReLU" bottom: "{p}/fc"
+  top: "{p}/fc" include {{ phase: TRAIN }} }}
+layer {{ name: "{p}/drop_fc" type: "Dropout" bottom: "{p}/fc"
+  top: "{p}/fc" include {{ phase: TRAIN }}
+  dropout_param {{ dropout_ratio: 0.7 }} }}
+layer {{ name: "{p}/classifier" type: "InnerProduct" bottom: "{p}/fc"
+  top: "{p}/classifier" include {{ phase: TRAIN }}
+  param {{ lr_mult: 1 decay_mult: 1 }} param {{ lr_mult: 2 decay_mult: 0 }}
+  inner_product_param {{ num_output: {num_classes}
+    weight_filler {{ type: "xavier" }}
+    bias_filler {{ type: "constant" }} }} }}
+layer {{ name: "{p}/loss" type: "SoftmaxWithLoss"
+  bottom: "{p}/classifier" bottom: "label" top: "{p}/loss"
+  loss_weight: 0.3 include {{ phase: TRAIN }} }}
+"""
+
+
+def googlenet(batch_size: int = 32, num_classes: int = 1000,
+              image_size: int = 224, aux_heads: bool = True
               ) -> NetParameter:
-    """GoogLeNet / Inception-v1 (bvlc_googlenet topology, main head)."""
+    """GoogLeNet / Inception-v1 (bvlc_googlenet topology incl. the two
+    TRAIN-phase auxiliary classifier towers, weight 0.3)."""
     t = f"""
 name: "GoogLeNet"
 layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
   memory_data_param {{ batch_size: {batch_size} channels: 3
-    height: 224 width: 224 }} }}
+    height: {image_size} width: {image_size} }} }}
 """
     t += _CONV.format(name="conv1/7x7_s2", bottom="data", n=64, k=7,
                       extra="pad: 3 stride: 2", std=0.01, bias=0.2)
@@ -366,12 +409,16 @@ layer { name: "pool3_3x3_s2" type: "Pooling"
   pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
 """
     t = _inception(t, "inception_4a", "pool3", 192, 96, 208, 16, 48, 64)
+    if aux_heads:
+        t += _googlenet_aux_head(1, "inception_4a/output", num_classes)
     t = _inception(t, "inception_4b", "inception_4a/output",
                    160, 112, 224, 24, 64, 64)
     t = _inception(t, "inception_4c", "inception_4b/output",
                    128, 128, 256, 24, 64, 64)
     t = _inception(t, "inception_4d", "inception_4c/output",
                    112, 144, 288, 32, 64, 64)
+    if aux_heads:
+        t += _googlenet_aux_head(2, "inception_4d/output", num_classes)
     t = _inception(t, "inception_4e", "inception_4d/output",
                    256, 160, 320, 32, 128, 128)
     t += """

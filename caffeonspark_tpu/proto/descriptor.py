@@ -676,6 +676,14 @@ def _parse_fields(msg: Message, tok: _Tokenizer, *, top_level=False,
             return
         name = tok.next_token()
         f = fields.get(name)
+        if f is None:
+            # protobuf TextFormat (and hence Caffe's ReadProtoFromText*)
+            # fails on unknown fields — a typo'd config must not
+            # silently misconfigure.  (Binary decode still skips
+            # unknown tags for cross-fork caffemodel compat.)
+            raise ValueError(
+                f"line {tok.line}: unknown field {name!r} in "
+                f"{type(msg).__name__}")
         c = tok.peek()
         if c == ":":
             tok.next_token()
@@ -683,9 +691,6 @@ def _parse_fields(msg: Message, tok: _Tokenizer, *, top_level=False,
         if c in ("{", "<"):
             opener = tok.next_token()
             closer = "}" if opener == "{" else ">"
-            if f is None:
-                _skip_block(tok, closer)
-                continue
             if f.ftype != MESSAGE:
                 raise ValueError(f"field {name} is scalar but got a block")
             sub = f.msg_cls()()
@@ -696,21 +701,10 @@ def _parse_fields(msg: Message, tok: _Tokenizer, *, top_level=False,
             tok.next_token()
             while tok.peek() != "]":
                 v = tok.next_token()
-                if f is not None:
-                    msg._append(f, _parse_scalar(f, v))
+                msg._append(f, _parse_scalar(f, v))
             tok.next_token()
         else:
             v = tok.next_token()
-            if f is not None:
-                msg._append(f, _parse_scalar(f, v))
-            # unknown scalar fields silently skipped
+            msg._append(f, _parse_scalar(f, v))
 
 
-def _skip_block(tok: _Tokenizer, closer: str) -> None:
-    depth = 1
-    while depth:
-        t = tok.next_token()
-        if t in ("{", "<"):
-            depth += 1
-        elif t in ("}", ">"):
-            depth -= 1

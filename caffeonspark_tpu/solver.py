@@ -178,12 +178,15 @@ class Solver:
         it1 = (state.iter + 1).astype(jnp.float32)
 
         # Caffe order (SGDSolver::ApplyUpdate): ClipGradients on the raw
-        # diffs FIRST, then Regularize per param
+        # accumulated diffs FIRST, then Normalize (1/iter_size), then
+        # Regularize.  Our grads arrive already normalized (sum/iter_size),
+        # and ||sum|| = iter_size*||mean||, so clipping the mean against
+        # threshold/iter_size is exactly Caffe's clip-the-sum
         if sp.clip_gradients > 0:
+            thresh = sp.clip_gradients / max(1, int(sp.iter_size))
             leaves = jax.tree_util.tree_leaves(grads)
             gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
-            scale = jnp.where(gnorm > sp.clip_gradients,
-                              sp.clip_gradients / gnorm, 1.0)
+            scale = jnp.where(gnorm > thresh, thresh / gnorm, 1.0)
             grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
 
         def reg(g, w, dm):

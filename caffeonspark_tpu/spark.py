@@ -57,6 +57,13 @@ def coordinator_port(app_id: str = "", base: int = 47770) -> int:
     return base + (zlib.crc32(app_id.encode()) % 199)
 
 
+def _get_barrier_context():
+    """Indirection point: tests substitute a barrier-context double
+    (pyspark doesn't exist in this image)."""
+    from pyspark import BarrierTaskContext
+    return BarrierTaskContext.get()
+
+
 class SparkEngine:
     """Driver-side engine dispatching CaffeProcessor work to executors.
 
@@ -70,32 +77,48 @@ class SparkEngine:
     the coordinator binds inside rank 0's own `distributed_init`, so the
     advertised host:port is by construction on the right machine."""
 
-    def __init__(self, sc, conf: Config):
-        require_spark()
+    def __init__(self, sc, conf: Config, *, require: bool = True):
+        if require:
+            require_spark()
         self.sc = sc
         self.conf = conf
         self.cluster_size = max(1, conf.clusterSize)
 
+    @property
+    def app_id(self) -> str:
+        return getattr(self.sc, "applicationId", "") or ""
+
     def setup(self) -> List[Dict[str, Any]]:
-        """Start processors on every executor, multi-host mesh up."""
+        """Start processors on every executor, multi-host mesh up.
+
+        Each executor also starts a FeedDaemon (spark_daemon.py): Spark
+        feed tasks run in separate Python worker processes that cannot
+        see the processor singleton, so records are handed off over a
+        host-local socket — the Python-process analog of the
+        reference's task-thread→feedQueue sharing
+        (CaffeProcessor.scala:192-198)."""
         conf_bytes = _pickle_conf(self.conf)
         n = self.cluster_size
-        port = coordinator_port(self.sc.applicationId)
+        port = coordinator_port(self.app_id)
+        app_id = self.app_id
 
         def start(it):
-            from pyspark import BarrierTaskContext
-            ctx = BarrierTaskContext.get()
+            ctx = _get_barrier_context()
             rank = ctx.partitionId()
             infos = ctx.getTaskInfos()
             coord_host = infos[0].address.split(":")[0]
             ctx.barrier()          # everyone resolved the coordinator
             from .parallel import distributed_init
             from .processor import CaffeProcessor
+            from .spark_daemon import FeedDaemon
             conf = _unpickle_conf(conf_bytes)
-            distributed_init(f"{coord_host}:{port}", n, rank)
+            if n > 1:
+                distributed_init(f"{coord_host}:{port}", n, rank)
             proc = CaffeProcessor.instance(conf, rank=rank)
             proc.start()
-            yield {"rank": rank, "host": socket.gethostname()}
+            proc._feed_daemon = FeedDaemon(proc, app_id, rank=rank)
+            yield {"rank": rank, "host": socket.gethostname(),
+                   "feed_port": proc._feed_daemon.port}
 
         plan = (self.sc.parallelize(range(n), n).barrier()
                 .mapPartitions(start).collect())
@@ -103,11 +126,33 @@ class SparkEngine:
         return sorted(plan, key=lambda p: p["rank"])
 
     def feed_partitions(self, rdd, queue_idx: int = 0) -> int:
-        """Stream records of each partition into the local processor's
-        feed queue (the mapPartitions feed loop, :204-227)."""
-        def feed(it):
+        """Stream records of each partition into the executor-resident
+        processor (the mapPartitions feed loop, :204-227).  The task
+        discovers the host-local daemon via its port file; the
+        same-process singleton is only a fallback for local[*] mode
+        with worker reuse."""
+        app_id = self.app_id
+        n = self.cluster_size
+
+        def feed(idx, it):
+            from .spark_daemon import FeedClient
+            client = FeedClient.discover(app_id, rank=idx % n)
+            if client is not None:
+                try:
+                    fed = client.feed(queue_idx, it)
+                    client.epoch_end(queue_idx)
+                finally:
+                    client.close()
+                yield fed
+                return
+            # fallback: task shares the executor process
             from .processor import CaffeProcessor
-            proc = CaffeProcessor.instance()
+            try:
+                proc = CaffeProcessor.instance()
+            except Exception as e:
+                raise RuntimeError(
+                    "no feed daemon port file and no in-process "
+                    "CaffeProcessor — was setup() run?") from e
             fed = 0
             for rec in it:
                 if not proc.feed_queue(queue_idx, rec):
@@ -116,16 +161,29 @@ class SparkEngine:
             proc.mark_epoch_end(queue_idx)
             yield fed
 
-        return sum(rdd.mapPartitions(feed).collect())
+        return sum(rdd.mapPartitionsWithIndex(feed).collect())
 
     def shutdown(self):
+        """Stop every executor's processor + daemon.  Goes through the
+        daemon STOP op (works from any worker process); the singleton
+        path is only the same-process fallback."""
+        app_id = self.app_id
+
         def stop(rank):
+            from .spark_daemon import FeedClient
+            stopped = FeedClient.stop_all(app_id)
+            if stopped:
+                return stopped
             from .processor import CaffeProcessor
             try:
-                CaffeProcessor.instance().stop()
+                proc = CaffeProcessor.instance()
+                daemon = getattr(proc, "_feed_daemon", None)
+                if daemon is not None:
+                    daemon.stop()
+                proc.stop()
+                return 1
             except AssertionError:
-                pass
-            return rank
+                return 0
 
         n = self.cluster_size
         self.sc.parallelize(range(n), n).map(stop).collect()

@@ -1,0 +1,242 @@
+"""Executor-resident feed daemon: the bridge between short-lived Spark
+task processes and the long-lived CaffeProcessor.
+
+Why it exists: in the reference, Spark tasks run as THREADS inside the
+executor JVM, so `CaffeProcessor.instance()` is naturally shared
+(`CaffeProcessor.scala:192-198` feedQueue from task threads).  PySpark
+tasks run in separate Python *worker processes* — a task cannot see the
+processor singleton started by the barrier stage.  The daemon closes
+that gap: `proc.start()` on an executor also starts a localhost TCP
+server owned by the processor's process; feed tasks (any worker
+process on the same host) discover it via a port file and stream
+records over the socket.  Backpressure is the synchronous per-chunk
+ack: the daemon blocks in `feed_queue` (bounded queues) before acking,
+so a slow solver throttles the Spark task exactly like the reference's
+blocking `offer`.
+
+Wire protocol (all little-endian):
+    request:  u8 op | u32 len | pickle payload
+    response: u8 status (1 = accepted, 0 = processor stopped/rejected)
+    ops: 1 FEED (payload = (queue_idx, [records...]))
+         2 EPOCH_END (payload = queue_idx)
+         3 PING (payload = None)
+         4 STOP (payload = None) — stop processor + daemon (the
+           shutdown path must also cross the worker-process boundary)
+
+Port files are per (app, rank): `cos_feed_<app>_r<rank>.port`, so
+multiple executors on one host register independently; clients prefer
+the daemon whose rank matches their partition, falling back to any
+local daemon (Spark does not pin partition→executor placement — the
+reference used UnionRDDWLocsSpecified for that; here any local
+processor accepts the records, lockstep step counts keep ranks even).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+import socket
+import struct
+import threading
+from typing import Iterable, List, Optional
+
+OP_FEED = 1
+OP_EPOCH_END = 2
+OP_PING = 3
+OP_STOP = 4
+
+_HDR = struct.Struct("<BI")
+CHUNK = 64  # records per FEED message (amortizes the ack round-trip)
+
+
+def _feed_dir(tmpdir: Optional[str] = None) -> str:
+    return tmpdir or os.environ.get("COS_FEED_DIR", "/tmp")
+
+
+def _port_file(app_id: str, rank: int,
+               tmpdir: Optional[str] = None) -> str:
+    return os.path.join(_feed_dir(tmpdir),
+                        f"cos_feed_{app_id or 'local'}_r{rank}.port")
+
+
+def _port_files(app_id: str, tmpdir: Optional[str] = None) -> List[str]:
+    pat = os.path.join(_feed_dir(tmpdir),
+                       f"cos_feed_{app_id or 'local'}_r*.port")
+    return sorted(glob.glob(pat))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError("feed daemon peer closed")
+        buf += part
+    return buf
+
+
+class FeedDaemon:
+    """Runs next to a CaffeProcessor; owns a listening socket and a
+    port file other processes on this host use to find it."""
+
+    def __init__(self, processor, app_id: str = "", rank: int = 0,
+                 tmpdir: Optional[str] = None):
+        self.processor = processor
+        self.app_id = app_id
+        self.rank = rank
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._stopped = False
+        self.path = _port_file(app_id, rank, tmpdir)
+        with open(self.path, "w") as f:
+            f.write(str(self.port))
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="cos-feed-daemon",
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stopped:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while True:
+                op, ln = _HDR.unpack(_recv_exact(conn, _HDR.size))
+                payload = pickle.loads(_recv_exact(conn, ln)) if ln \
+                    else None
+                ok = True
+                if op == OP_FEED:
+                    queue_idx, records = payload
+                    for rec in records:
+                        if not self.processor.feed_queue(queue_idx, rec):
+                            ok = False
+                            break
+                elif op == OP_EPOCH_END:
+                    self.processor.mark_epoch_end(payload)
+                elif op == OP_STOP:
+                    # ack first, then tear down asynchronously (stop()
+                    # joins the solver thread — can take a while)
+                    conn.sendall(b"\x01")
+                    threading.Thread(target=self._stop_all,
+                                     daemon=True).start()
+                    break
+                elif op != OP_PING:
+                    ok = False
+                conn.sendall(b"\x01" if ok else b"\x00")
+                if not ok:
+                    break
+        except (ConnectionError, OSError, EOFError, pickle.PickleError):
+            pass
+        finally:
+            conn.close()
+
+    def _stop_all(self):
+        self.stop()
+        try:
+            self.processor.stop()
+        except Exception:
+            pass
+
+    def stop(self):
+        self._stopped = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class FeedClient:
+    """Task-side connection to the host-local daemon."""
+
+    def __init__(self, port: int):
+        self._sock = socket.create_connection(("127.0.0.1", port),
+                                              timeout=120)
+
+    @classmethod
+    def discover(cls, app_id: str = "", rank: Optional[int] = None,
+                 tmpdir: Optional[str] = None) -> Optional["FeedClient"]:
+        """Connect to a host-local daemon: the one registered for
+        `rank` if present, else any responsive one."""
+        paths = _port_files(app_id, tmpdir)
+        if rank is not None:
+            pref = _port_file(app_id, rank, tmpdir)
+            if pref in paths:
+                paths.remove(pref)
+                paths.insert(0, pref)
+        for path in paths:
+            try:
+                port = int(open(path).read().strip())
+                c = cls(port)
+                if c._request(OP_PING, None):
+                    return c
+                c.close()
+            except (OSError, ValueError, ConnectionError):
+                continue
+        return None
+
+    @classmethod
+    def stop_all(cls, app_id: str = "",
+                 tmpdir: Optional[str] = None) -> int:
+        """Send STOP to every local daemon of this app; returns the
+        number stopped (the executor-shutdown path, usable from any
+        worker process)."""
+        stopped = 0
+        for path in _port_files(app_id, tmpdir):
+            try:
+                c = cls(int(open(path).read().strip()))
+            except (OSError, ValueError, ConnectionError):
+                continue
+            try:
+                if c._request(OP_STOP, None):
+                    stopped += 1
+            except (OSError, ConnectionError):
+                pass
+            finally:
+                c.close()
+        return stopped
+
+    def _request(self, op: int, payload) -> bool:
+        blob = pickle.dumps(payload) if payload is not None else b""
+        self._sock.sendall(_HDR.pack(op, len(blob)) + blob)
+        return _recv_exact(self._sock, 1) == b"\x01"
+
+    def feed(self, queue_idx: int, records: Iterable) -> int:
+        """Stream records in chunks; returns count accepted before the
+        processor stopped (reference loop: CaffeOnSpark.scala:204-227)."""
+        fed = 0
+        chunk = []
+        for rec in records:
+            chunk.append(rec)
+            if len(chunk) == CHUNK:
+                if not self._request(OP_FEED, (queue_idx, chunk)):
+                    return fed
+                fed += len(chunk)
+                chunk = []
+        if chunk:
+            if not self._request(OP_FEED, (queue_idx, chunk)):
+                return fed
+            fed += len(chunk)
+        return fed
+
+    def epoch_end(self, queue_idx: int) -> bool:
+        return self._request(OP_EPOCH_END, queue_idx)
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,155 @@
+"""Dataset builders: raw downloads -> LMDB in Caffe Datum format.
+
+The reference delegates this to caffe-public's shell pipeline
+(`scripts/setup-mnist.sh` runs get_mnist.sh + create_mnist.sh;
+`scripts/setup-cifar10.sh` likewise) — external C++ tools producing
+LMDBs.  Here the LMDB writer is in-repo (`data/lmdb_io.py`), so the
+converters are self-contained:
+
+  python -m caffeonspark_tpu.tools.datasets mnist   -src <idx-dir> -out data/
+  python -m caffeonspark_tpu.tools.datasets cifar10 -src <bin-dir> -out data/
+  python -m caffeonspark_tpu.tools.datasets digits  -out data/
+
+`digits` needs NO network or source files: it packs scikit-learn's
+bundled real handwritten-digit scans (UCI optical digits, 1797
+samples, 8x8) upsampled to MNIST's 1x28x28 geometry into
+mnist_{train,test}_lmdb, so the LeNet configs run on real data in
+airgapped environments (the convergence-gate tests use this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import os
+import struct
+import sys
+from typing import List, Tuple
+
+import numpy as np
+
+from ..data.lmdb_io import LmdbWriter
+from ..proto.caffe import Datum
+
+
+def _write_lmdb(path: str, images: np.ndarray, labels: np.ndarray) -> int:
+    """images: (N, C, H, W) uint8 -> LMDB of raw-byte Datums, keys
+    zero-padded decimal like convert_mnist_data.cpp ("%08d")."""
+    n, c, h, w = images.shape
+    recs: List[Tuple[bytes, bytes]] = []
+    for i in range(n):
+        d = Datum(channels=c, height=h, width=w, label=int(labels[i]),
+                  data=images[i].tobytes())
+        recs.append((b"%08d" % i, d.to_binary()))
+    LmdbWriter(path).write(recs)
+    return n
+
+
+def _open_maybe_gz(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def _read_idx(path: str) -> np.ndarray:
+    """IDX (yann.lecun MNIST distribution) parser."""
+    with _open_maybe_gz(path) as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        data = np.frombuffer(f.read(), np.uint8)
+    return data.reshape(dims)
+
+
+def _find_idx(src: str, stem: str) -> str:
+    for suffix in ("", ".gz"):
+        p = os.path.join(src, stem + suffix)
+        if os.path.exists(p):
+            return p
+    raise FileNotFoundError(
+        f"{stem}[.gz] not found under {src} — run scripts/setup-mnist.sh "
+        "(downloads the 4 IDX files) first")
+
+
+def build_mnist(src: str, out: str) -> None:
+    for split, img_stem, lbl_stem in (
+            ("train", "train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+            ("test", "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")):
+        imgs = _read_idx(_find_idx(src, img_stem))[:, None, :, :]
+        lbls = _read_idx(_find_idx(src, lbl_stem))
+        n = _write_lmdb(os.path.join(out, f"mnist_{split}_lmdb"),
+                        imgs, lbls)
+        print(f"mnist_{split}_lmdb: {n} records")
+
+
+def build_cifar10(src: str, out: str) -> None:
+    """cifar-10-binary batches (3073 bytes/record: label + 3x32x32)."""
+    def load(names):
+        bufs = []
+        for nm in names:
+            p = os.path.join(src, nm)
+            if not os.path.exists(p):
+                raise FileNotFoundError(
+                    f"{p} missing — run scripts/setup-cifar10.sh first")
+            bufs.append(np.frombuffer(open(p, "rb").read(), np.uint8))
+        raw = np.concatenate(bufs).reshape(-1, 3073)
+        return raw[:, 1:].reshape(-1, 3, 32, 32), raw[:, 0]
+
+    tr_i, tr_l = load([f"data_batch_{i}.bin" for i in range(1, 6)])
+    te_i, te_l = load(["test_batch.bin"])
+    print(f"cifar10_train_lmdb: "
+          f"{_write_lmdb(os.path.join(out, 'cifar10_train_lmdb'), tr_i, tr_l)}"
+          " records")
+    print(f"cifar10_test_lmdb: "
+          f"{_write_lmdb(os.path.join(out, 'cifar10_test_lmdb'), te_i, te_l)}"
+          " records")
+    # mean.binaryproto like create_cifar10.sh's compute_image_mean
+    from ..proto.caffe import BlobProto
+    mean = tr_i.astype(np.float64).mean(axis=0)
+    bp = BlobProto(channels=3, height=32, width=32, num=1,
+                   data=[float(v) for v in mean.ravel()])
+    with open(os.path.join(out, "mean.binaryproto"), "wb") as f:
+        f.write(bp.to_binary())
+    print("mean.binaryproto written")
+
+
+def build_digits(out: str, train_frac: float = 0.85,
+                 seed: int = 0) -> None:
+    from sklearn.datasets import load_digits
+    X, y = load_digits(return_X_y=True)  # (1797, 64) values 0..16
+    imgs8 = (X.reshape(-1, 8, 8) * (255.0 / 16.0)).astype(np.uint8)
+    # 8x8 -> 28x28: x3.5 nearest-ish upsample via index mapping (keeps
+    # uint8, no cv2 dependency)
+    idx = np.minimum((np.arange(28) * 8) // 28, 7)
+    imgs = imgs8[:, idx][:, :, idx][:, None, :, :]
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(len(imgs))
+    cut = int(len(imgs) * train_frac)
+    tr, te = order[:cut], order[cut:]
+    n1 = _write_lmdb(os.path.join(out, "mnist_train_lmdb"),
+                     imgs[tr], y[tr])
+    n2 = _write_lmdb(os.path.join(out, "mnist_test_lmdb"),
+                     imgs[te], y[te])
+    print(f"mnist_train_lmdb: {n1} records (real digits, 28x28)")
+    print(f"mnist_test_lmdb: {n2} records")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="cos_datasets", description=__doc__)
+    ap.add_argument("dataset", choices=["mnist", "cifar10", "digits"])
+    ap.add_argument("-src", default=".",
+                    help="directory with the downloaded raw files")
+    ap.add_argument("-out", default="data", help="output directory")
+    a = ap.parse_args(argv)
+    os.makedirs(a.out, exist_ok=True)
+    if a.dataset == "mnist":
+        build_mnist(a.src, a.out)
+    elif a.dataset == "cifar10":
+        build_cifar10(a.src, a.out)
+    else:
+        build_digits(a.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

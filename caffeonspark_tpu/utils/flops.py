@@ -1,0 +1,60 @@
+"""Analytic FLOP estimates for a constructed Net.
+
+Counts the multiply-accumulate work of the parametrised layers
+(Convolution / Deconvolution / InnerProduct / LSTM-style weights) from
+the weight blob shapes and inferred top shapes — the >99% of CaffeNet's
+arithmetic that lands on the MXU.  Elementwise layers (ReLU, LRN,
+Pooling, Softmax) are ignored; they are HBM-bound, not FLOP-bound.
+
+Used by bench.py for MFU: images/sec alone can't be sanity-checked
+against chip peak without a FLOP count (reference analog: the
+throughput harnesses in `caffe-distri/.../PerfTest.java:69-118` report
+rates only — no roofline; this is the TPU-native upgrade).
+"""
+
+from __future__ import annotations
+
+from math import prod
+
+
+def forward_flops(net) -> int:
+    """Estimated forward-pass FLOPs for one batch through `net`.
+
+    2 * (output elements) * (MACs per output element), where MACs per
+    output element = prod(weight.shape[1:]) for every weighted layer:
+      Convolution  weight (K, C/g, kh, kw), top (N, K, Ho, Wo)
+      InnerProduct weight (K, I),           top (N, K)
+      LSTM/RNN     weight (4H, I) etc.      top (T, N, H)
+    Deconvolution scatters from the bottom instead: weight
+    (C, K/g, kh, kw) applied per bottom element.
+    """
+    total = 0
+    for lp in net.compute_layers:
+        specs = net.param_layout.get(lp.name)
+        if not specs:
+            continue
+        tops = net._top_shapes[lp.name]
+        if not tops:
+            continue
+        first_top = next(iter(tops.values()))
+        for (pname, pshape, _) in specs:
+            if len(pshape) < 2 or "bias" in pname:
+                continue
+            if lp.type == "Deconvolution":
+                # one MAC per bottom element per kernel tap
+                n, c = first_top[0], pshape[0]
+                # bottom spatial size = prod(top)/N/K * ... — recover
+                # from blob_shapes via the bottom name when available
+                bshape = net.blob_shapes.get(lp.bottom[0])
+                ref = prod(bshape) if bshape else prod(first_top)
+            else:
+                ref = prod(first_top)
+            total += 2 * ref * prod(pshape[1:])
+    return total
+
+
+def train_step_flops(net) -> int:
+    """Forward + backward + update ≈ 3x forward (dL/dW and dL/dx are
+    each another pass of the same matmuls; the elementwise optimizer
+    update is negligible)."""
+    return 3 * forward_flops(net)

@@ -1,0 +1,106 @@
+"""Remote-filesystem plumbing: the FSUtils.scala analog.
+
+The reference writes snapshots/outputs locally and copies them to HDFS
+when the configured path isn't local (`FSUtils.scala:21-89`
+CopyFileToHDFS / GenModelOutputPath).  Here any fsspec-supported scheme
+works the same way — `hdfs://`, `gs://`, `s3://`, `memory://` (tests)
+— while plain paths and `file:` URIs stay on the fast local-open path
+with zero fsspec involvement.
+"""
+
+from __future__ import annotations
+
+import os
+import posixpath
+
+LOCAL_PREFIXES = ("file://", "file:")
+
+
+def strip_local(path: str) -> str:
+    for p in LOCAL_PREFIXES:
+        if path.startswith(p):
+            return path[len(p):] or "/"
+    return path
+
+
+def is_remote(path: str) -> bool:
+    if "://" not in path:
+        return False
+    return not path.startswith("file://")
+
+
+def _fs(path: str):
+    import fsspec
+    fs, p = fsspec.core.url_to_fs(path)
+    return fs, p
+
+
+def join(base: str, *parts: str) -> str:
+    if is_remote(base):
+        return posixpath.join(base, *parts)
+    return os.path.join(strip_local(base), *parts)
+
+
+def dirname(path: str) -> str:
+    if is_remote(path):
+        return posixpath.dirname(path)
+    return os.path.dirname(os.path.abspath(strip_local(path)))
+
+
+def basename(path: str) -> str:
+    return posixpath.basename(path) if is_remote(path) \
+        else os.path.basename(path)
+
+
+def exists(path: str) -> bool:
+    if is_remote(path):
+        fs, p = _fs(path)
+        return fs.exists(p)
+    return os.path.exists(strip_local(path))
+
+
+def makedirs(path: str) -> None:
+    if is_remote(path):
+        fs, p = _fs(path)
+        fs.makedirs(p, exist_ok=True)
+    elif path:
+        os.makedirs(strip_local(path), exist_ok=True)
+
+
+def open_file(path: str, mode: str = "rb"):
+    if is_remote(path):
+        import fsspec
+        return fsspec.open(path, mode).open()
+    p = strip_local(path)
+    if any(m in mode for m in "wa"):
+        d = os.path.dirname(os.path.abspath(p))
+        os.makedirs(d, exist_ok=True)
+    return open(p, mode)
+
+
+def write_bytes(path: str, data: bytes) -> None:
+    with open_file(path, "wb") as f:
+        f.write(data)
+
+
+def read_bytes(path: str) -> bytes:
+    with open_file(path, "rb") as f:
+        return f.read()
+
+
+def upload(local_path: str, dest: str) -> None:
+    """CopyFileToHDFS analog: local file -> remote path (overwrite)."""
+    fs, p = _fs(dest)
+    parent = posixpath.dirname(p)
+    if parent:
+        fs.makedirs(parent, exist_ok=True)
+    fs.put_file(local_path, p)
+
+
+def download(src: str, local_path: str) -> str:
+    """Remote file -> local path; returns local_path."""
+    os.makedirs(os.path.dirname(os.path.abspath(local_path)),
+                exist_ok=True)
+    fs, p = _fs(src)
+    fs.get_file(p, local_path)
+    return local_path

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Fetch CIFAR-10 and build LMDBs + mean.binaryproto in ./data
+# (reference scripts/setup-cifar10.sh analog, self-contained).
+set -euo pipefail
+OUT=${1:-data}
+TMP=$(mktemp -d)
+trap 'rm -rf "$TMP"' EXIT
+wget -q https://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz -O "$TMP/c.tgz"
+tar -xzf "$TMP/c.tgz" -C "$TMP"
+python -m caffeonspark_tpu.tools.datasets cifar10 \
+  -src "$TMP/cifar-10-batches-bin" -out "$OUT"

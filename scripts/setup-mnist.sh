@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Fetch MNIST and build LMDBs in ./data (reference scripts/setup-mnist.sh
+# analog; no caffe-public C++ tools needed — the LMDB writer is in-repo).
+# In airgapped environments use the offline real-digit fallback:
+#   python -m caffeonspark_tpu.tools.datasets digits -out data
+set -euo pipefail
+OUT=${1:-data}
+TMP=$(mktemp -d)
+trap 'rm -rf "$TMP"' EXIT
+BASE=https://ossci-datasets.s3.amazonaws.com/mnist
+for f in train-images-idx3-ubyte train-labels-idx1-ubyte \
+         t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
+  wget -q "$BASE/$f.gz" -O "$TMP/$f.gz"
+done
+python -m caffeonspark_tpu.tools.datasets mnist -src "$TMP" -out "$OUT"

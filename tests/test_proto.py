@@ -129,15 +129,25 @@ def test_train_state_stages():
     assert s.snapshot_format == 0  # HDF5
 
 
-def test_unknown_fields_skipped():
-    n = NetParameter.from_text("""
-        name: "x"
-        some_unknown_scalar: 3
-        some_unknown_block { foo: 1 bar { baz: "s" } }
-        layer { name: "l" type: "ReLU" }
-    """)
-    assert n.name == "x"
-    assert n.layer[0].type == "ReLU"
+def test_unknown_text_fields_rejected():
+    """protobuf TextFormat parity: a typo'd config field is an ERROR
+    (Caffe's ReadProtoFromTextFile CHECK-fails), never silently
+    ignored.  Binary decode still skips unknown tags (see
+    test_binary_unknown_tags_skipped)."""
+    import pytest
+    with pytest.raises(ValueError, match="unknown field"):
+        NetParameter.from_text("""
+            name: "x"
+            some_unknown_scalar: 3
+            layer { name: "l" type: "ReLU" }
+        """)
+
+
+def test_binary_unknown_tags_skipped():
+    # append an unknown varint field (tag 3000) — cross-fork compat
+    import struct
+    blob = NetParameter(name="x").to_binary() + bytes([0xC0, 0xBB, 0x01, 5])
+    assert NetParameter.from_binary(blob).name == "x"
 
 
 def test_datum_binary_round_trip():
