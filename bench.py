@@ -23,6 +23,9 @@ Env knobs:
   BENCH_ITERS        timed iterations (default 50)
   BENCH_PRECISION    jax default_matmul_precision (default 'bfloat16'
                      — one MXU pass; 'highest' for f32 parity runs)
+  BENCH_DTYPE        'float32' (default) | 'mixed' (f32 master weights,
+                     bf16 activations/compute — halves activation HBM
+                     traffic) | 'bfloat16' (params too)
   BENCH_PIPELINE=1   feed through the REAL data pipeline (JPEG LMDB ->
                      native decode -> transform -> device prefetch),
                      host-dispatched per step
@@ -171,7 +174,13 @@ def main():
         "base_lr: 0.01 momentum: 0.9 weight_decay: 0.0005 "
         "lr_policy: 'step' gamma: 0.1 stepsize: 100000 max_iter: 450000 "
         "random_seed: 1")
-    solver = Solver(sp, npm)
+    dt = os.environ.get("BENCH_DTYPE", "float32")
+    dtype_kw = {}
+    if dt == "mixed":
+        dtype_kw = dict(dtype=jnp.float32, compute_dtype=jnp.bfloat16)
+    elif dt == "bfloat16":
+        dtype_kw = dict(dtype=jnp.bfloat16)
+    solver = Solver(sp, npm, **dtype_kw)
     params, st = solver.init()
     flops_step = train_step_flops(solver.train_net)
 

@@ -1,0 +1,72 @@
+"""SourceTest.scala analog on the reference's OWN fake dataset: the 4
+real dog/cat JPEGs (`/root/reference/data/images/` + labels.txt) are
+packed into a SequenceFile by the Binary2Sequence analog, streamed
+through the SeqImageDataSource pipeline (decode → 227 crop → mirror →
+transform), and train real CaffeNet steps from the reference's test
+configs (`caffe-distri/src/test/resources/caffenet_{solver,
+train_net}.prototxt`, SourceTest.scala:58-120) — snapshot in the
+solver's HDF5 format at the end, forward sanity below the reference's
+own bound (SourceTest.scala:175-178: outputs < 50.0).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+IMAGES = "/root/reference/data/images"
+RES = "/root/reference/caffe-distri/src/test/resources"
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.isdir(IMAGES)
+         and os.path.exists(os.path.join(RES, "caffenet_solver.prototxt"))),
+    reason="reference fake dataset not present")
+
+
+def test_caffenet_trains_on_reference_images(tmp_path):
+    from caffeonspark_tpu.checkpoint import snapshot
+    from caffeonspark_tpu.data import get_source
+    from caffeonspark_tpu.proto import read_net, read_solver
+    from caffeonspark_tpu.proto.caffe import SnapshotFormat
+    from caffeonspark_tpu.solver import Solver
+    from caffeonspark_tpu.tools.converters import binary2sequence
+
+    seq = str(tmp_path / "seq_image_files")
+    n = binary2sequence(IMAGES, seq,
+                        os.path.join(IMAGES, "labels.txt"))
+    assert n == 4
+
+    sp = read_solver(os.path.join(RES, "caffenet_solver.prototxt"))
+    npm = read_net(os.path.join(RES, "caffenet_train_net.prototxt"))
+    for lp in npm.layer:
+        if lp.type == "MemoryData":
+            lp.memory_data_param.source = seq
+    assert sp.snapshot_format == SnapshotFormat.HDF5
+
+    solver = Solver(sp, npm)
+    params, st = solver.init()
+    step = solver.jit_train_step()
+    src = get_source(npm.layer[0], phase_train=True, seed=1, resize=True)
+    gen = src.batches(loop=True)
+    losses = []
+    for i in range(3):
+        params, st, out = step(params, st, next(gen), solver.step_rng(i))
+        losses.append(float(out["loss"]))
+    assert np.isfinite(losses).all(), losses
+
+    # forward sanity: reference bound, outputs < 50.0
+    net = solver.test_net or solver.train_net
+    val_src = get_source(npm.layer[1], phase_train=False, seed=1,
+                         resize=True)
+    batch = next(val_src.batches(loop=True))
+    blobs, _ = solver.train_net.apply(params, batch, train=False)
+    loss_val = float(np.asarray(blobs["loss"]))
+    assert 0.0 < loss_val < 50.0, loss_val
+
+    # snapshot in the solver's configured HDF5 format
+    m, s = snapshot(solver.train_net, params, st,
+                    str(tmp_path / "caffenet"),
+                    fmt=sp.snapshot_format,
+                    solver_type=solver.solver_type)
+    assert m.endswith(".caffemodel.h5") and os.path.exists(m)
+    assert s.endswith(".solverstate.h5") and os.path.exists(s)
