@@ -28,7 +28,10 @@ master weights — optimizer numerics preserved); BENCH_BATCH=64
 BENCH_DTYPE=float32 reproduces the reference workload shape exactly.
 
 Env knobs:
-  BENCH_BATCH        per-step batch (default 256)
+  BENCH_MODEL        'caffenet' (default, the reference's headline
+                     workload) | 'resnet50' | 'vgg16' | 'googlenet'
+  BENCH_BATCH        per-step batch (default 256; resnet50/vgg16
+                     default 64, googlenet 128)
   BENCH_ITERS        timed iterations (default 50)
   BENCH_PRECISION    jax default_matmul_precision (default 'bfloat16'
                      — one MXU pass; 'highest' for f32 parity runs)
@@ -130,7 +133,10 @@ def _pipeline_inputs(batch, dshape, tmpdir):
 
 
 def main():
-    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    model = os.environ.get("BENCH_MODEL", "caffenet")
+    default_batch = {"caffenet": 256, "resnet50": 64, "vgg16": 64,
+                     "googlenet": 128}.get(model, 64)
+    batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
     iters = int(os.environ.get("BENCH_ITERS", "50"))
     precision = os.environ.get("BENCH_PRECISION", "bfloat16")
     pipeline = os.environ.get("BENCH_PIPELINE") == "1"
@@ -170,14 +176,14 @@ def main():
     from caffeonspark_tpu.utils.flops import train_step_flops
 
     ref = "/root/reference/data/bvlc_reference_net.prototxt"
-    if os.path.exists(ref):
+    if model == "caffenet" and os.path.exists(ref):
         npm = read_net(ref)
         for lyr in npm.layer:
             if lyr.type == "MemoryData":
                 lyr.memory_data_param.batch_size = batch
     else:
-        from caffeonspark_tpu.models.zoo import caffenet
-        npm = caffenet(batch_size=batch)
+        from caffeonspark_tpu.models import zoo
+        npm = getattr(zoo, model)(batch_size=batch)
 
     sp = SolverParameter.from_text(
         "base_lr: 0.01 momentum: 0.9 weight_decay: 0.0005 "
@@ -218,7 +224,7 @@ def main():
             _sync(out["loss"])
             dt = time.perf_counter() - t0
         ips = batch * iters / dt
-        metric = "caffenet_imagenet_train_images_per_sec_per_chip_pipeline"
+        metric = f"{model}_imagenet_train_images_per_sec_per_chip_pipeline"
     else:
         # ON-DEVICE loop: lax.scan over the chained train step, one
         # dispatch + one forced sync — measures the chip, not the tunnel
@@ -245,7 +251,7 @@ def main():
             print(f"bench: WARNING non-finite losses: {final[-3:]}",
                   file=sys.stderr)
         ips = batch * iters / dt
-        metric = "caffenet_imagenet_train_images_per_sec_per_chip"
+        metric = f"{model}_imagenet_train_images_per_sec_per_chip"
 
     tflops = flops_step * iters / dt / 1e12
     mfu = tflops / peak_tflops

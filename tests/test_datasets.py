@@ -1,0 +1,101 @@
+"""Dataset builder tests: IDX (MNIST) and CIFAR-10 binary parsers →
+LMDB, and the offline real-digits builder (tools/datasets.py — the
+scripts/setup-{mnist,cifar10}.sh pipeline, self-contained)."""
+
+import gzip
+import struct
+
+import numpy as np
+
+from caffeonspark_tpu.data.lmdb_io import LmdbReader
+from caffeonspark_tpu.proto.caffe import BlobProto, Datum
+from caffeonspark_tpu.tools import datasets
+
+
+def _write_idx(path, arr: np.ndarray, gz=False):
+    ndim = arr.ndim
+    magic = (0x08 << 8 | ndim) if False else (0x0800 | ndim)
+    hdr = struct.pack(">I", magic) + b"".join(
+        struct.pack(">I", d) for d in arr.shape)
+    data = hdr + arr.astype(np.uint8).tobytes()
+    if gz:
+        with gzip.open(path, "wb") as f:
+            f.write(data)
+    else:
+        with open(path, "wb") as f:
+            f.write(data)
+
+
+def _read_lmdb_datums(path):
+    out = []
+    with LmdbReader(str(path)) as r:
+        for k, v in r.items():
+            out.append((k, Datum.from_binary(v)))
+    return out
+
+
+def test_mnist_idx_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    tr_i = rng.randint(0, 256, (12, 28, 28)).astype(np.uint8)
+    tr_l = (np.arange(12) % 10).astype(np.uint8)
+    te_i = rng.randint(0, 256, (5, 28, 28)).astype(np.uint8)
+    te_l = (np.arange(5) % 10).astype(np.uint8)
+    # mixed plain/gz like real downloads
+    _write_idx(tmp_path / "train-images-idx3-ubyte.gz", tr_i, gz=True)
+    _write_idx(tmp_path / "train-labels-idx1-ubyte.gz", tr_l, gz=True)
+    _write_idx(tmp_path / "t10k-images-idx3-ubyte", te_i)
+    _write_idx(tmp_path / "t10k-labels-idx1-ubyte", te_l)
+
+    out = tmp_path / "data"
+    datasets.build_mnist(str(tmp_path), str(out))
+    recs = _read_lmdb_datums(out / "mnist_train_lmdb")
+    assert len(recs) == 12
+    k0, d0 = recs[0]
+    assert k0 == b"00000000"
+    assert (d0.channels, d0.height, d0.width) == (1, 28, 28)
+    np.testing.assert_array_equal(
+        np.frombuffer(d0.data, np.uint8).reshape(28, 28), tr_i[0])
+    assert d0.label == 0
+    assert len(_read_lmdb_datums(out / "mnist_test_lmdb")) == 5
+
+
+def test_cifar10_roundtrip(tmp_path):
+    rng = np.random.RandomState(1)
+    for b in range(1, 6):
+        raw = np.zeros((4, 3073), np.uint8)
+        raw[:, 0] = (np.arange(4) + b) % 10
+        raw[:, 1:] = rng.randint(0, 256, (4, 3072))
+        (tmp_path / f"data_batch_{b}.bin").write_bytes(raw.tobytes())
+    test_raw = np.zeros((3, 3073), np.uint8)
+    test_raw[:, 0] = [1, 2, 3]
+    test_raw[:, 1:] = rng.randint(0, 256, (3, 3072))
+    (tmp_path / "test_batch.bin").write_bytes(test_raw.tobytes())
+
+    out = tmp_path / "data"
+    datasets.build_cifar10(str(tmp_path), str(out))
+    tr = _read_lmdb_datums(out / "cifar10_train_lmdb")
+    assert len(tr) == 20
+    _, d0 = tr[0]
+    assert (d0.channels, d0.height, d0.width) == (3, 32, 32)
+    te = _read_lmdb_datums(out / "cifar10_test_lmdb")
+    assert [d.label for _, d in te] == [1, 2, 3]
+    # mean.binaryproto = pixel mean of the train images
+    bp = BlobProto.from_binary(
+        (out / "mean.binaryproto").read_bytes())
+    mean = np.asarray(bp.data, np.float32).reshape(3, 32, 32)
+    want = np.stack([
+        np.frombuffer(d.data, np.uint8).reshape(3, 32, 32)
+        for _, d in tr]).astype(np.float64).mean(axis=0)
+    np.testing.assert_allclose(mean, want, rtol=1e-5)
+
+
+def test_digits_builder_trains_shapes(tmp_path):
+    datasets.build_digits(str(tmp_path))
+    tr = _read_lmdb_datums(tmp_path / "mnist_train_lmdb")
+    te = _read_lmdb_datums(tmp_path / "mnist_test_lmdb")
+    assert len(tr) + len(te) == 1797          # full sklearn digits
+    _, d = tr[0]
+    assert (d.channels, d.height, d.width) == (1, 28, 28)
+    assert 0 <= d.label <= 9
+    img = np.frombuffer(d.data, np.uint8)
+    assert img.size == 784 and img.max() > 50  # real ink, 0..255 scale
