@@ -112,6 +112,7 @@ class CaffeProcessor:
         # set by trainWithValidation: only then does anyone feed queue 1
         self.interleave_validation = False
         self.dropped_batches = 0      # driver reads this to re-sync feeds
+        self.dropped_val_batches = 0  # informational (round shrinks)
         self._consecutive_drops = 0
         self.params = None
         self.opt_state = None
@@ -211,20 +212,26 @@ class CaffeProcessor:
 
     MAX_CONSECUTIVE_DROPS = 20
 
-    def _pack_or_drop(self, src, buf):
+    def _pack_or_drop(self, src, buf, *, val: bool = False):
         """Pack a batch; a bad record (corrupt JPEG, shape mismatch)
         drops the batch with a warning and training continues — the
         reference's per-iteration failure tolerance
         (CaffeProcessor.scala:449-451).  A run of consecutive failures
         means a systematic config error and aborts instead of spinning
-        forever."""
+        forever.  Train and validation drops are counted separately:
+        only TRAIN drops make the driver top up the train feed (a
+        dropped validation batch already advanced the round counter,
+        so topping up train records for it would skew the cadence)."""
         try:
             batch = src.next_batch(buf)
             self._consecutive_drops = 0
             return batch
         except Exception as e:
             self._consecutive_drops += 1
-            self.dropped_batches += 1
+            if val:
+                self.dropped_val_batches += 1
+            else:
+                self.dropped_batches += 1
             _LOG.warning("dropping batch after record error: %s", e)
             if self._consecutive_drops >= self.MAX_CONSECUTIVE_DROPS:
                 raise RuntimeError(
@@ -305,7 +312,7 @@ class CaffeProcessor:
                 continue
             buf.append(item)
             if len(buf) == src.batch_size:
-                batch = self._pack_or_drop(src, buf)
+                batch = self._pack_or_drop(src, buf, val=True)
                 if batch is not None:
                     out = eval_step(params, batch)
                     self.validation.add_batch(out)
