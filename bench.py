@@ -41,6 +41,8 @@ Env knobs:
   BENCH_PIPELINE=1   feed through the REAL data pipeline (JPEG LMDB ->
                      native decode -> transform -> device prefetch),
                      host-dispatched per step
+  BENCH_FORWARD=1    forward-only throughput (the features/test
+                     extraction path) instead of the train step
   BENCH_SMOKE=1      tiny-shape backend liveness probe only: separates
                      "tunnel up" from "CaffeNet compiles"
   BENCH_PEAK_TFLOPS  chip bf16 peak for MFU (default 197 = TPU v5e)
@@ -140,6 +142,7 @@ def main():
     iters = int(os.environ.get("BENCH_ITERS", "50"))
     precision = os.environ.get("BENCH_PRECISION", "bfloat16")
     pipeline = os.environ.get("BENCH_PIPELINE") == "1"
+    forward_only = os.environ.get("BENCH_FORWARD") == "1"
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
     retries = int(os.environ.get("BENCH_RETRIES", "4"))
@@ -207,7 +210,36 @@ def main():
     label = jnp.asarray(rng.randint(0, 1000, batch).astype(np.float32))
     fixed = {"data": data, "label": label}
 
-    if pipeline:
+    if forward_only:
+        # the features()/test() path: jitted forward, batches chained
+        # on device via scan (inputs reused; outputs data-dependent)
+        net = solver.train_net
+
+        def run_fwd(params, inputs, n):
+            def body(carry, _):
+                # tie each step's input to the previous loss: a scalar
+                # broadcast-add that makes the body loop-VARIANT, so
+                # XLA cannot hoist the forward out of the scan
+                inp = dict(inputs)
+                inp["data"] = inp["data"] + carry * 1e-9
+                blobs, _st = net.apply(params, inp, train=False)
+                loss = blobs["loss"].astype(jnp.float32)
+                return loss, loss
+            return jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                None, length=n)
+
+        import functools
+        runf = jax.jit(functools.partial(run_fwd, n=iters))
+        tot, losses = runf(params, fixed)
+        _sync(tot)
+        t0 = time.perf_counter()
+        tot, losses = runf(params, fixed)
+        _sync(tot)
+        dt = time.perf_counter() - t0
+        ips = batch * iters / dt
+        flops_step = flops_step // 3     # fwd-only
+        metric = f"{model}_imagenet_forward_images_per_sec_per_chip"
+    elif pipeline:
         # host-dispatched loop fed by the real decode/transform pipeline
         import tempfile
         step = solver.jit_train_step()
