@@ -219,16 +219,30 @@ class MiniCluster:
         timer = StepTimer(batch_size=src.batch_size)
         timer.start()
         smoothed = None
-        # fault-injection for failure drills (tests/test_multihost.py):
-        # a per-step delay widens the window in which a rank can be
-        # killed mid-run deterministically
+        # fault-injection for failure drills (tests/test_multihost*.py):
+        # COS_FAULT_STEP_DELAY_MS widens the window in which a rank can
+        # be killed mid-run; COS_FAULT_DIE_ONCE="rank:iter:marker_path"
+        # makes that rank exit(3) at that iter ONCE (the marker file
+        # suppresses the fault after a supervisor relaunch)
         fault_delay = float(
             os.environ.get("COS_FAULT_STEP_DELAY_MS", "0") or 0) / 1e3
+        die_once = os.environ.get("COS_FAULT_DIE_ONCE", "")
+        die_rank = die_iter = -1
+        die_marker = ""
+        if die_once:
+            r_, i_, die_marker = die_once.split(":", 2)
+            die_rank, die_iter = int(r_), int(i_)
         with profile_trace(self.args.profile):
             while it < max_iter and not self._stop:
                 if fault_delay:
                     import time
                     time.sleep(fault_delay)
+                if (it == die_iter and (self.args.rank or 0) == die_rank
+                        and not os.path.exists(die_marker)):
+                    open(die_marker, "w").close()
+                    print(f"FAULT INJECTION: rank {die_rank} dying at "
+                          f"iter {it}", flush=True)
+                    os._exit(3)
                 batch = next(gen)
                 params, st, out = step(params, st, batch,
                                        solver.step_rng(it))

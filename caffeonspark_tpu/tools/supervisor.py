@@ -1,0 +1,141 @@
+"""Cluster supervisor: launch + watch + relaunch-from-snapshot.
+
+The reference documents failure recovery as a manual procedure — on an
+executor failure the job dies and the operator resubmits with
+`-snapshot`/`-weights` pointing at the last good state
+(`Config.scala:461-467`).  This tool automates that loop for the
+standalone cluster (`mini_cluster`): it spawns one process per rank,
+monitors them, and when any rank dies mid-run it tears the cluster
+down (a dead peer leaves survivors blocked in the gradient all-reduce
+— the same hang a dead NCCL/MPI peer causes) and relaunches everyone
+from the newest snapshot pair found in the output directory.
+
+    python -m caffeonspark_tpu.tools.supervisor \
+        -solver solver.prototxt -train /path/lmdb -output out/ \
+        -cluster 4 [-max_restarts 3] [-port 47788] \
+        [-- extra mini_cluster flags...]
+
+Exit code 0 iff a run completes (every rank exits 0).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional, Tuple
+
+
+def find_latest_snapshot(outdir: str, prefix: str
+                         ) -> Optional[Tuple[str, str]]:
+    """Newest (state, model) pair `<prefix>_iter_<N>.*` in outdir."""
+    if not os.path.isdir(outdir):
+        return None
+    pat = re.compile(re.escape(prefix) + r"_iter_(\d+)\.solverstate(\.h5)?$")
+    best, best_it = None, -1
+    for name in os.listdir(outdir):
+        m = pat.match(name)
+        if not m:
+            continue
+        it = int(m.group(1))
+        model = name.replace(".solverstate", ".caffemodel")
+        if it > best_it and os.path.exists(os.path.join(outdir, model)):
+            best, best_it = (os.path.join(outdir, name),
+                             os.path.join(outdir, model)), it
+    return best
+
+
+class Supervisor:
+    def __init__(self, args, passthrough: List[str]):
+        self.args = args
+        self.passthrough = passthrough
+        self.procs: List[subprocess.Popen] = []
+
+    def _launch(self, rank: int, snapshot: Optional[Tuple[str, str]]
+                ) -> subprocess.Popen:
+        a = self.args
+        port = getattr(self, "attempt_port", a.port)
+        cmd = [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
+               "-solver", a.solver, "-output", a.output,
+               "-server", f"127.0.0.1:{port}",
+               "-cluster", str(a.cluster), "-rank", str(rank)]
+        if a.train:
+            cmd += ["-train", a.train]
+        if snapshot:
+            cmd += ["-snapshot", snapshot[0], "-weights", snapshot[1]]
+        cmd += self.passthrough
+        return subprocess.Popen(cmd)
+
+    def _teardown(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in self.procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+        self.procs = []
+
+    def run(self) -> int:
+        a = self.args
+        from ..proto import read_solver
+        prefix = read_solver(a.solver).snapshot_prefix or "model"
+        attempt = 0
+        while True:
+            snap = find_latest_snapshot(a.output, prefix)
+            print(f"supervisor: attempt {attempt + 1} from "
+                  f"{snap[0] if snap else 'scratch'}", flush=True)
+            # fresh coordinator port per attempt (the previous one can
+            # linger in TIME_WAIT after a teardown)
+            self.attempt_port = a.port + attempt
+            self.procs = [self._launch(r, snap)
+                          for r in range(a.cluster)]
+            failed = False
+            while True:
+                time.sleep(a.poll_interval)
+                codes = [p.poll() for p in self.procs]
+                if all(c == 0 for c in codes):
+                    print("supervisor: run complete", flush=True)
+                    return 0
+                if any(c is not None and c != 0 for c in codes):
+                    dead = [i for i, c in enumerate(codes)
+                            if c is not None and c != 0]
+                    print(f"supervisor: rank(s) {dead} died "
+                          f"(codes {[codes[i] for i in dead]}) — "
+                          "tearing down for relaunch", flush=True)
+                    failed = True
+                    break
+                # some finished cleanly, others still running: fine
+            self._teardown()
+            if not failed:
+                return 0
+            attempt += 1
+            if attempt > a.max_restarts:
+                print("supervisor: max_restarts exceeded", flush=True)
+                return 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="cos_supervisor",
+                                 description=__doc__)
+    ap.add_argument("-solver", required=True)
+    ap.add_argument("-train", default=None,
+                    help="training source (mini_cluster -train)")
+    ap.add_argument("-output", required=True)
+    ap.add_argument("-cluster", type=int, default=1)
+    ap.add_argument("-port", type=int, default=47788)
+    ap.add_argument("-max_restarts", type=int, default=3)
+    ap.add_argument("-poll_interval", type=float, default=1.0)
+    args, passthrough = ap.parse_known_args(argv)
+    if passthrough and passthrough[0] == "--":
+        passthrough = passthrough[1:]
+    return Supervisor(args, passthrough).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
