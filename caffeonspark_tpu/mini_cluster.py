@@ -67,6 +67,9 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="override solver display interval")
     p.add_argument("-profile", dest="profile", default=None,
                    help="write a jax.profiler trace to this directory")
+    p.add_argument("-metrics", dest="metrics", default=None,
+                   help="append per-display-step JSONL records "
+                   "(iter, loss, lr, steps/s, records/s) to this file")
     p.add_argument("-dtype", dest="dtype", default="float32",
                    choices=["float32", "bfloat16", "mixed"],
                    help="float32 | bfloat16 (params+compute bf16) | "
@@ -250,14 +253,28 @@ class MiniCluster:
                 timer.tick()
                 if display and it % display == 0:
                     loss = float(jax.device_get(out["loss"]))
+                    lr_now = float(jax.device_get(out["lr"]))
                     smoothed = loss if smoothed is None else (
                         0.9 * smoothed + 0.1 * loss)
                     print(
                         f"iter {it}/{max_iter} loss={loss:.4f} "
                         f"(smoothed {smoothed:.4f}) "
-                        f"lr={float(jax.device_get(out['lr'])):.6f} "
+                        f"lr={lr_now:.6f} "
                         f"[{timer.steps_per_sec:.1f} it/s, "
                         f"{timer.records_per_sec:.0f} img/s]")
+                    if self.args.metrics and self._is_rank0:
+                        import json
+                        import time as _time
+                        with open(self.args.metrics, "a") as mf:
+                            mf.write(json.dumps(
+                                {"iter": it, "loss": round(loss, 6),
+                                 "smoothed": round(smoothed, 6),
+                                 "lr": lr_now,
+                                 "steps_per_sec": round(
+                                     timer.steps_per_sec, 2),
+                                 "records_per_sec": round(
+                                     timer.records_per_sec, 1),
+                                 "ts": _time.time()}) + "\n")
                 if ((snap_every and it % snap_every == 0)
                         or self._want_snapshot) and self._is_rank0:
                     self._want_snapshot = False
