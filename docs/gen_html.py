@@ -17,6 +17,7 @@ PAGES = {
     "survey.html": "../SURVEY.md",
     "architecture.html": "architecture.md",
     "benchmarks.html": "benchmarks.md",
+    "migration.html": "migration.md",
 }
 
 
