@@ -230,6 +230,76 @@ def snapshot(net: Net, params: Params, opt_state: OptState, prefix: str,
     return model_path, state_path
 
 
+class AsyncSnapshotter:
+    """Write-behind snapshots (orbax-style async checkpointing).
+
+    `submit()` materializes a consistent host copy of params/opt_state
+    (one `device_get` — cheap next to serialization + file/remote I/O)
+    and hands the write to a worker thread, so the train loop resumes
+    immediately instead of stalling for the full snapshot latency.  A
+    second submit first waits for the previous write to land (so at most
+    one write is in flight and at most one extra host param copy is
+    alive).  Errors surface on the next `submit()`/`wait()`.
+    """
+
+    def __init__(self):
+        import queue as _q
+        import threading
+        self._q: "_q.Queue" = _q.Queue(maxsize=1)
+        self._thread: Optional[threading.Thread] = None
+        self._last_done: Optional[threading.Event] = None
+        self._err: Optional[BaseException] = None
+
+    def _ensure_thread(self):
+        import threading
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="cos-snapshotter")
+            self._thread.start()
+
+    def _run(self):
+        while True:
+            fn, done = self._q.get()
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — surfaced later
+                self._err = e
+            finally:
+                done.set()
+
+    def check(self):
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise RuntimeError("async snapshot failed") from err
+
+    def submit(self, net: Net, params: Params, opt_state: OptState,
+               prefix: str, *, fmt: int = SnapshotFormat.BINARYPROTO,
+               solver_type: str = "SGD"):
+        import threading
+        self.check()
+        if self._last_done is not None:
+            self._last_done.wait()   # one write in flight, one host copy
+            self.check()
+        # whole-pytree device_get: one batched transfer, np leaves
+        host_params = jax.device_get(params)
+        host_state = jax.device_get(opt_state)
+        done = threading.Event()
+        self._ensure_thread()
+        self._q.put((lambda: snapshot(net, host_params, host_state,
+                                      prefix, fmt=fmt,
+                                      solver_type=solver_type), done))
+        self._last_done = done
+        return done
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until the last submitted snapshot lands.  The worker
+        thread stays up (daemon) — no shutdown handshake to race."""
+        if self._last_done is not None:
+            if not self._last_done.wait(timeout):
+                raise TimeoutError("snapshot still in flight")
+        self.check()
+
+
 def restore(net: Net, params: Params, opt_state: OptState,
             state_path: str, *, weights_path: Optional[str] = None
             ) -> Tuple[Params, OptState]:

@@ -46,6 +46,10 @@ def build_argparser() -> argparse.ArgumentParser:
     a("-persistent", dest="isPersistent", action="store_true",
       help="cache decoded source records in memory after epoch 0 "
            "(sourceRDD.persist analog)")
+    a("-async_snapshot", dest="asyncSnapshot", action="store_true",
+      help="write snapshots on a background thread (write-behind): the "
+           "train loop stalls only for the device_get, not the file/"
+           "remote I/O")
     a("-snapshot", dest="snapshotStateFile", default="",
       help="solverstate to resume from")
     a("-weights", dest="snapshotModelFile", default="",

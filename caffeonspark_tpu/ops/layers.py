@@ -180,6 +180,56 @@ def _conv_params(lp, shapes):
     return specs
 
 
+def _s2d_eligible(x, cp, kh, kw, sh, sw, dh, dw) -> bool:
+    """Stem convs (C_in<=4, stride>=2) hit the MXU badly: the 8-lane
+    channel padding and the strided 11x11/7x7 window waste most of the
+    systolic array.  Space-to-depth by the stride factor rewrites them
+    as dense stride-1 convs over C_in*s^2 channels — the standard TPU
+    stem transform (MLPerf ResNet).  Same multiply-adds in a different
+    summation order, so results match the direct conv to float-rounding
+    tolerance, not bitwise (like any XLA layout change).  On by default
+    on TPU; COS_CONV_S2D=0 forces the direct conv everywhere."""
+    import os
+    env = os.environ.get("COS_CONV_S2D")
+    if env is not None:
+        enabled = env == "1"
+    else:
+        from .pallas_kernels import pallas_enabled
+        enabled = pallas_enabled()
+    return (enabled and x.shape[1] <= 4 and sh == sw and sh >= 2
+            and dh == dw == 1 and max(1, cp.group) == 1)
+
+
+def _s2d_conv(x, w, s, kh, kw, ph, pw):
+    """stride-s conv as a stride-1 conv over s x s space-to-depth blocks.
+
+    x: (N, C, H, W) already conceptually padded by (ph, pw) — padding is
+    applied here together with the tail pad/crop to the block grid.
+    w: (O, C, kh, kw).  Output identical to
+    conv(x, w, stride=s, pad=(ph, pw))."""
+    n, c, h, wd = x.shape
+    o_h = (h + 2 * ph - kh) // s + 1
+    o_w = (wd + 2 * pw - kw) // s + 1
+    kb_h = (kh - 1) // s + 1
+    kb_w = (kw - 1) // s + 1
+    gh, gw = o_h + kb_h - 1, o_w + kb_w - 1
+    # pad left with conv padding, right up/down to the block grid
+    xt = jnp.pad(x, ((0, 0), (0, 0),
+                     (ph, max(0, gh * s - h - ph)),
+                     (pw, max(0, gw * s - wd - pw))))
+    xt = xt[:, :, :gh * s, :gw * s]
+    xt = xt.reshape(n, c, gh, s, gw, s).transpose(0, 1, 3, 5, 2, 4)
+    xt = xt.reshape(n, c * s * s, gh, gw)
+    oc = w.shape[0]
+    wp = jnp.pad(w, ((0, 0), (0, 0),
+                     (0, kb_h * s - kh), (0, kb_w * s - kw)))
+    wp = wp.reshape(oc, c, kb_h, s, kb_w, s).transpose(0, 1, 3, 5, 2, 4)
+    wp = wp.reshape(oc, c * s * s, kb_h, kb_w)
+    return lax.conv_general_dilated(
+        xt, wp, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
 @register("Convolution", params=_conv_params)
 def _conv(ctx, lp, params, bottoms):
     cp = lp.convolution_param
@@ -189,10 +239,13 @@ def _conv(ctx, lp, params, bottoms):
     # no preferred_element_type: the TPU MXU accumulates in f32
     # internally either way, and forcing an f32 output breaks the
     # conv transpose (backward) for bf16 nets with a dtype mismatch
-    out = lax.conv_general_dilated(
-        x, w, window_strides=(sh, sw), padding=[(ph, ph), (pw, pw)],
-        rhs_dilation=(dh, dw), feature_group_count=max(1, cp.group),
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    if _s2d_eligible(x, cp, kh, kw, sh, sw, dh, dw):
+        out = _s2d_conv(x, w, sh, kh, kw, ph, pw)
+    else:
+        out = lax.conv_general_dilated(
+            x, w, window_strides=(sh, sw), padding=[(ph, ph), (pw, pw)],
+            rhs_dilation=(dh, dw), feature_group_count=max(1, cp.group),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
     if cp.bias_term:
         out = out + params[1].reshape(1, -1, 1, 1)
     return [out]
@@ -354,8 +407,46 @@ def _pooling(ctx, lp, params, bottoms):
         div_w = lax.reduce_window(ones_w, 0.0, lax.add, (1, 1, 1, kw),
                                   (1, 1, 1, sw), "VALID")
         out = s / (div_h * div_w)
+    elif pp.pool == PoolMethod.STOCHASTIC:
+        # Caffe pooling_layer.cu PoolForward{Train,Test}: activations are
+        # assumed non-negative (post-ReLU).  TRAIN samples one element per
+        # window with probability value/sum(window); TEST outputs the
+        # activation-weighted mean sum(a^2)/sum(a) (0 when the window sums
+        # to 0).  Caffe forbids padding for STOCHASTIC (pooling_layer.cpp
+        # SetUp check); zero padding is harmless here (zeros are never
+        # sampled unless the whole window is zero).
+        if ctx.train:
+            patches = lax.conv_general_dilated_patches(
+                x, (kh, kw), (sh, sw), [(ph, eh), (pw, ew)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            p = patches.reshape(n, c, kh * kw, oh, ow)
+            # selection math in f32: in bf16 `u` can be exactly 0
+            # (~2^-8) or cumsum can round below u*total, degenerating
+            # argmax to index 0 and biasing sampling toward the
+            # window's top-left element
+            cum = jnp.cumsum(p.astype(jnp.float32), axis=2)
+            total = cum[:, :, -1]        # Caffe accumulates, not re-sums
+            u = jax.random.uniform(ctx.take_rng(), total.shape,
+                                   dtype=jnp.float32, minval=1e-7,
+                                   maxval=1.0)
+            # first window index whose running sum crosses u * total
+            idx = jnp.argmax(cum >= (u * total)[:, :, None], axis=2)
+            out = jnp.take_along_axis(p, idx[:, :, None], axis=2)[:, :, 0]
+        else:
+            # weighted mean sum(a^2)/sum(a) via two reduce_windows — no
+            # kh*kw patch materialization on the eval path
+            xf = x.astype(jnp.float32)
+            xp = jnp.pad(xf, ((0, 0), (0, 0), (ph, eh), (pw, ew)))
+            total = lax.reduce_window(xp, 0.0, lax.add,
+                                      (1, 1, kh, kw), (1, 1, sh, sw),
+                                      "VALID")
+            sq = lax.reduce_window(xp * xp, 0.0, lax.add,
+                                   (1, 1, kh, kw), (1, 1, sh, sw),
+                                   "VALID")
+            out = jnp.where(total > 0, sq / jnp.where(total > 0, total, 1),
+                            0.0).astype(x.dtype)
     else:
-        raise NotImplementedError("STOCHASTIC pooling")
+        raise NotImplementedError(f"pooling method {pp.pool}")
     return [out]
 
 

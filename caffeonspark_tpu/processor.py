@@ -114,6 +114,7 @@ class CaffeProcessor:
         self.dropped_batches = 0      # driver reads this to re-sync feeds
         self.dropped_val_batches = 0  # informational (round shrinks)
         self._consecutive_drops = 0
+        self._snapshotter = None      # lazy AsyncSnapshotter (-async_snapshot)
         self.params = None
         self.opt_state = None
 
@@ -176,9 +177,17 @@ class CaffeProcessor:
         if self._thread is not None:
             self._thread.join(timeout=600)
             self._thread = None
+        snap_err = None
+        if self._snapshotter is not None:   # pending write-behind lands
+            try:
+                self._snapshotter.wait(timeout=600)
+            except BaseException as e:      # noqa: BLE001
+                snap_err = e                # must not mask train error
         CaffeProcessor._instance = None
         if self._error is not None:
             raise self._error
+        if snap_err is not None:
+            raise snap_err
 
     def join(self):
         if self._thread is not None:
@@ -326,10 +335,19 @@ class CaffeProcessor:
         prefix = fsutils.join(conf.outputPath or ".",
                               conf.solverParameter.snapshot_prefix
                               or "model")
-        m, s = checkpoint.snapshot(
-            self.solver.train_net, self.params, self.opt_state, prefix,
-            fmt=conf.solverParameter.snapshot_format,
-            solver_type=self.solver.solver_type)
+        fmt = conf.solverParameter.snapshot_format
+        if getattr(conf, "asyncSnapshot", False):
+            if self._snapshotter is None:
+                self._snapshotter = checkpoint.AsyncSnapshotter()
+            self._snapshotter.submit(
+                self.solver.train_net, self.params, self.opt_state,
+                prefix, fmt=fmt, solver_type=self.solver.solver_type)
+            if final:
+                self._snapshotter.wait()
+        else:
+            checkpoint.snapshot(
+                self.solver.train_net, self.params, self.opt_state,
+                prefix, fmt=fmt, solver_type=self.solver.solver_type)
         if final and conf.modelPath:
             checkpoint.save_caffemodel(conf.modelPath,
                                        self.solver.train_net,

@@ -564,10 +564,12 @@ class _Tokenizer:
             raise ValueError("unexpected end of prototxt")
         t = self.text
         c = t[self.pos]
+        self.was_quoted = False
         if c in "{}:<>[];":
             self.pos += 1
             return c
         if c in "\"'":
+            self.was_quoted = True
             return self._string(c)
         start = self.pos
         while (self.pos < self.n
@@ -701,10 +703,27 @@ def _parse_fields(msg: Message, tok: _Tokenizer, *, top_level=False,
             tok.next_token()
             while tok.peek() != "]":
                 v = tok.next_token()
+                _check_quoting(f, tok)
                 msg._append(f, _parse_scalar(f, v))
             tok.next_token()
         else:
             v = tok.next_token()
+            _check_quoting(f, tok)
             msg._append(f, _parse_scalar(f, v))
+
+
+def _check_quoting(f: Field, tok: _Tokenizer) -> None:
+    """TextFormat parity: string/bytes values must be quoted; numeric,
+    bool, and enum values must not be."""
+    quoted = getattr(tok, "was_quoted", False)
+    if f.ftype in (STRING, BYTES):
+        if not quoted:
+            raise ValueError(
+                f"line {tok.line}: string field {f.name!r} needs a "
+                "quoted value")
+    elif quoted:
+        raise ValueError(
+            f"line {tok.line}: field {f.name!r} ({f.ftype}) cannot take "
+            "a quoted string value")
 
 

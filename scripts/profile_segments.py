@@ -1,0 +1,141 @@
+"""Per-segment on-chip timing for the CaffeNet train step.
+
+Times fwd+bwd of each stage of bvlc_reference_net in isolation (scan
+loop on device, forced sync) to locate the HBM-bound stages worth a
+fused Pallas kernel.  Not a test — a planning tool.
+
+Usage: python scripts/profile_segments.py [batch]
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+ITERS = 30
+
+
+def _sync(x):
+    return np.asarray(jax.device_get(x))
+
+
+def timeit(name, fn, *args):
+    def run(args):
+        def body(c, _):
+            out = fn(*[a + (c * 1e-9).astype(a.dtype) if i == 0 else a
+                       for i, a in enumerate(args)])
+            s = sum(jnp.sum(o.astype(jnp.float32)) for o in jax.tree.leaves(out))
+            return s * 1e-20, s
+        return jax.lax.scan(body, jnp.zeros(()), None, length=ITERS)
+
+    runj = jax.jit(run)
+    tc = time.perf_counter()
+    tot, _ = runj(args)
+    _sync(tot)
+    compile_s = time.perf_counter() - tc
+    t0 = time.perf_counter()
+    tot, _ = runj(args)
+    _sync(tot)
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{name:28s} {dt*1e3:8.3f} ms/iter  (compile {compile_s:.0f}s)",
+          flush=True)
+    return dt
+
+
+def fwd_bwd(f):
+    """value+grad wrt first arg, summed output as loss proxy."""
+    def g(*args):
+        loss, grads = jax.value_and_grad(
+            lambda *a: jnp.sum(f(*a).astype(jnp.float32)))(*args)
+        return loss, grads
+    return g
+
+
+def main():
+    jax.config.update("jax_default_matmul_precision", "bfloat16")
+    print("backend:", jax.default_backend(), jax.devices()[0])
+    from caffeonspark_tpu.ops.pallas_kernels import lrn_across_channels
+    bf = jnp.bfloat16
+    rng = np.random.RandomState(0)
+
+    def t(shape):
+        return jnp.asarray(rng.rand(*shape).astype(np.float32), dtype=bf)
+
+    def conv(x, w, stride=1, pad=0, groups=1):
+        return jax.lax.conv_general_dilated(
+            x, w, (stride, stride), [(pad, pad), (pad, pad)],
+            feature_group_count=groups,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    def maxpool(x, k=3, s=2):
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 1, k, k), (1, 1, s, s), "VALID")
+
+    def lrn(x):
+        return lrn_across_channels(x.astype(jnp.float32), 5, 1e-4, 0.75,
+                                   1.0).astype(x.dtype)
+
+    N = BATCH
+    results = {}
+    # stage 1: data 227 -> conv1 11x11s4 -> 55x55x96 -> relu,lrn,pool -> 27
+    x0 = t((N, 3, 227, 227))
+    w1 = t((96, 3, 11, 11))
+    results["conv1(11x11s4,3->96)"] = timeit(
+        "conv1(11x11s4,3->96)", fwd_bwd(lambda x, w: conv(x, w, 4)), x0, w1)
+    a1 = t((N, 96, 55, 55))
+    results["relu+lrn+pool@55x96"] = timeit(
+        "relu+lrn+pool@55x96",
+        fwd_bwd(lambda x: maxpool(lrn(jax.nn.relu(x)))), a1)
+    # stage 2: 27x27x96 -> conv2 5x5 pad2 g2 -> 256 -> relu,lrn,pool -> 13
+    a2 = t((N, 96, 27, 27))
+    w2 = t((256, 48, 5, 5))
+    results["conv2(5x5p2g2,96->256)"] = timeit(
+        "conv2(5x5p2g2,96->256)",
+        fwd_bwd(lambda x, w: conv(x, w, 1, 2, 2)), a2, w2)
+    a3 = t((N, 256, 27, 27))
+    results["relu+lrn+pool@27x256"] = timeit(
+        "relu+lrn+pool@27x256",
+        fwd_bwd(lambda x: maxpool(lrn(jax.nn.relu(x)))), a3)
+    # stage 3-5 convs at 13x13
+    a4 = t((N, 256, 13, 13))
+    w3 = t((384, 256, 3, 3))
+    results["conv3(3x3p1,256->384)"] = timeit(
+        "conv3(3x3p1,256->384)",
+        fwd_bwd(lambda x, w: jax.nn.relu(conv(x, w, 1, 1))), a4, w3)
+    a5 = t((N, 384, 13, 13))
+    w4 = t((384, 192, 3, 3))
+    results["conv4(3x3p1g2,384->384)"] = timeit(
+        "conv4(3x3p1g2,384->384)",
+        fwd_bwd(lambda x, w: jax.nn.relu(conv(x, w, 1, 1, 2))), a5, w4)
+    w5 = t((256, 192, 3, 3))
+    results["conv5+pool(384->256)"] = timeit(
+        "conv5+pool(384->256)",
+        fwd_bwd(lambda x, w: maxpool(jax.nn.relu(conv(x, w, 1, 1, 2)))),
+        a5, w5)
+    # fc stack
+    f0 = t((N, 9216))
+    wf6 = t((9216, 4096))
+    results["fc6(9216->4096)+relu"] = timeit(
+        "fc6(9216->4096)+relu",
+        fwd_bwd(lambda x, w: jax.nn.relu(x @ w)), f0, wf6)
+    f1 = t((N, 4096))
+    wf7 = t((4096, 4096))
+    results["fc7(4096->4096)+relu"] = timeit(
+        "fc7(4096->4096)+relu",
+        fwd_bwd(lambda x, w: jax.nn.relu(x @ w)), f1, wf7)
+    wf8 = t((4096, 1000))
+    results["fc8+logsoftmax"] = timeit(
+        "fc8+logsoftmax",
+        fwd_bwd(lambda x, w: jax.nn.log_softmax(x @ w)), f1, wf8)
+
+    total = sum(results.values())
+    print(f"{'SUM of segments':28s} {total*1e3:8.3f} ms/iter")
+    print(f"(whole-step bench at batch {BATCH}: see bench.py)")
+
+
+if __name__ == "__main__":
+    main()

@@ -88,6 +88,74 @@ def test_snapshot_restore_round_trip(tmp_path, fmt):
     assert float(o1["loss"]) == pytest.approx(float(o2["loss"]), rel=1e-6)
 
 
+def test_async_snapshotter(tmp_path):
+    """Write-behind snapshot: submit returns before the write, wait()
+    lands it, the on-disk state equals a synchronous snapshot, and a
+    failing write surfaces on wait()."""
+    s, params, st = _trained()
+    snapper = checkpoint.AsyncSnapshotter()
+    done = snapper.submit(s.train_net, params, st,
+                          str(tmp_path / "async_snap"))
+    snapper.wait()
+    assert done.is_set()
+    state_path = str(tmp_path / "async_snap_iter_5.solverstate")
+    assert os.path.exists(state_path)
+    s2 = Solver(SolverParameter.from_text(SOLVER),
+                NetParameter.from_text(NET))
+    p2, st2 = s2.init()
+    p2, st2 = checkpoint.restore(s2.train_net, p2, st2, state_path)
+    for ln in params:
+        for bn in params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(params[ln][bn])),
+                np.asarray(p2[ln][bn]), rtol=1e-6)
+    # the submitted copy is decoupled from later in-place training
+    done2 = snapper.submit(s.train_net, params, st,
+                           str(tmp_path / "snap2"))
+    snapper.wait()
+    assert done2.is_set()
+    # error path: unwritable destination surfaces on wait, not silently
+    snapper.submit(s.train_net, params, st,
+                   "/proc/definitely/not/writable/snap")
+    with pytest.raises(RuntimeError, match="async snapshot failed"):
+        snapper.wait()
+
+
+def test_async_snapshot_cli_flag(tmp_path):
+    """-async_snapshot through the driver trains, snapshots land, and
+    resume from the async-written state works."""
+    from caffeonspark_tpu.caffe_on_spark import CaffeOnSpark
+    from caffeonspark_tpu.config import Config
+    from caffeonspark_tpu.data import LmdbWriter
+    imgs, labels = make_images(64, height=12, width=12, seed=3)
+    recs = [(b"%08d" % i,
+             Datum(channels=1, height=12, width=12,
+                   data=(imgs[i, 0] * 255).astype(np.uint8).tobytes(),
+                   label=int(labels[i])).to_binary()) for i in range(64)]
+    LmdbWriter(str(tmp_path / "lmdb")).write(recs)
+    net = NET.replace(
+        'memory_data_param { batch_size: 8',
+        f'source_class: "LMDB" memory_data_param {{ '
+        f'source: "{tmp_path / "lmdb"}" batch_size: 8')
+    (tmp_path / "net.prototxt").write_text(net)
+    (tmp_path / "solver.prototxt").write_text(
+        SOLVER + f'net: "{tmp_path / "net.prototxt"}"\n'
+        'snapshot: 20\nsnapshot_prefix: "m"\nmax_iter: 40\n')
+    conf = Config(["-conf", str(tmp_path / "solver.prototxt"), "-train",
+                   "-async_snapshot", "-output", str(tmp_path)])
+    assert conf.asyncSnapshot
+    from caffeonspark_tpu.data import get_source
+    src = get_source(conf.train_data_layer(), phase_train=True, seed=5)
+    CaffeOnSpark().train(src, conf)
+    state = tmp_path / "m_iter_40.solverstate"
+    assert state.exists() and (tmp_path / "m_iter_20.solverstate").exists()
+    s2 = Solver(SolverParameter.from_text(SOLVER),
+                NetParameter.from_text(NET))
+    p2, st2 = s2.init()
+    _, st2 = checkpoint.restore(s2.train_net, p2, st2, str(state))
+    assert int(jax.device_get(st2.iter)) == 40
+
+
 def test_finetune_copy_layers(tmp_path):
     s, params, st = _trained()
     mp = str(tmp_path / "weights.caffemodel")

@@ -1,0 +1,96 @@
+"""Negative / exception-path tests — the CaffeNetTest.java analogs
+(reference `CaffeNetTest.java:87-126` bogus init/connect/deviceID,
+:197-265 trainnull/predictnull): bad inputs must fail loudly with a
+diagnosable error, not train garbage."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from caffeonspark_tpu.data.source import get_source
+from caffeonspark_tpu.net import Net, NetState
+from caffeonspark_tpu.proto.caffe import (LayerParameter, NetParameter,
+                                          Phase, SolverParameter)
+from caffeonspark_tpu.solver import Solver
+
+NET = """
+name: "tiny"
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 4 channels: 1 height: 8 width: 8 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 3
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+  top: "loss" }
+"""
+
+
+def _solver():
+    sp = SolverParameter.from_text(
+        "base_lr: 0.1 lr_policy: 'fixed' max_iter: 10 random_seed: 3")
+    return Solver(sp, NetParameter.from_text(NET))
+
+
+def test_unknown_layer_type_raises():
+    npm = NetParameter.from_text(NET.replace('type: "InnerProduct"',
+                                             'type: "FancyNewLayer"'))
+    with pytest.raises(NotImplementedError, match="FancyNewLayer"):
+        Net(npm, NetState(phase=Phase.TRAIN))
+
+
+def test_unknown_blob_in_forward_raises():
+    """predictnull analog: asking for a blob the net never produces."""
+    s = _solver()
+    params, _ = s.init()
+    net = s.train_net
+    inputs = {"data": jnp.zeros((4, 1, 8, 8)),
+              "label": jnp.zeros((4,))}
+    blobs, _st = net.apply(params, inputs, train=False)
+    assert "loss" in blobs
+    with pytest.raises(KeyError):
+        _ = blobs["no_such_blob"]
+
+
+def test_missing_input_raises():
+    """trainnull analog: a train step without the data top."""
+    s = _solver()
+    params, st = s.init()
+    step = s.train_step_fn()
+    with pytest.raises((KeyError, TypeError)):
+        step(params, st, {"label": jnp.zeros((4,))}, s.step_rng(0))
+
+
+def test_wrong_shape_input_raises():
+    s = _solver()
+    params, st = s.init()
+    step = s.train_step_fn()
+    with pytest.raises(Exception):
+        # 7x7 images into an 8x8 net: the ip reshape cannot line up
+        step(params, st, {"data": jnp.zeros((4, 1, 7, 7)),
+                          "label": jnp.zeros((4,))}, s.step_rng(0))
+
+
+def test_bogus_source_class_raises():
+    lp = LayerParameter.from_text(
+        'name: "data" type: "MemoryData" top: "data" top: "label" '
+        'source_class: "com.yahoo.ml.caffe.NoSuchSource" '
+        'memory_data_param { source: "/nonexistent" batch_size: 4 '
+        'channels: 1 height: 8 width: 8 }')
+    with pytest.raises((ValueError, ImportError, KeyError)):
+        get_source(lp, phase_train=True, seed=0)
+
+
+def test_restore_from_missing_snapshot_raises(tmp_path):
+    from caffeonspark_tpu import checkpoint
+    s = _solver()
+    params, st = s.init()
+    with pytest.raises((FileNotFoundError, OSError)):
+        checkpoint.restore(s.train_net, params, st,
+                           str(tmp_path / "nope.solverstate"))
+
+
+def test_negative_rank_mesh_raises():
+    from caffeonspark_tpu.parallel.mesh import build_mesh
+    with pytest.raises(Exception):
+        build_mesh(tp=-2)

@@ -300,6 +300,105 @@ def test_ave_pooling_divisor():
     assert y[0, 0, 1, 1] == pytest.approx(1.0)
 
 
+def test_space_to_depth_stem_conv():
+    """_s2d_conv must equal the direct strided conv exactly (same
+    arithmetic reordered): AlexNet conv1 (11x11s4 no pad) and ResNet
+    stem (7x7s2 pad 3) geometries, fwd and grads."""
+    from caffeonspark_tpu.ops.layers import _s2d_conv
+    rs = np.random.RandomState(3)
+    for (cin, cout, k, s, p, hw) in [(3, 96, 11, 4, 0, 227),
+                                     (3, 64, 7, 2, 3, 56),
+                                     (4, 32, 5, 3, 1, 30)]:
+        x = jnp.asarray(rs.randn(2, cin, hw, hw).astype(np.float32))
+        w = jnp.asarray(rs.randn(cout, cin, k, k).astype(np.float32) * 0.1)
+        ref = jax.lax.conv_general_dilated(
+            x, w, (s, s), [(p, p), (p, p)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        got = _s2d_conv(x, w, s, k, k, p, p)
+        assert got.shape == ref.shape, (got.shape, ref.shape)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-4)
+        # gradients agree too (the transform is linear in both args)
+        g_ref = jax.grad(lambda a, b: jnp.sum(jax.lax.conv_general_dilated(
+            a, b, (s, s), [(p, p), (p, p)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW")) ** 2),
+            argnums=(0, 1))(x, w)
+        g_got = jax.grad(
+            lambda a, b: jnp.sum(_s2d_conv(a, b, s, k, k, p, p) ** 2),
+            argnums=(0, 1))(x, w)
+        for a, b in zip(g_ref, g_got):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=2e-4, atol=2e-2)
+
+
+def test_s2d_conv_layer_path(monkeypatch):
+    """The Convolution layer takes the s2d path when forced on and
+    matches the direct path on the real conv1 layer parameters."""
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx
+    lp = LayerParameter.from_text(
+        'name: "conv1" type: "Convolution" bottom: "data" top: "conv1" '
+        'convolution_param { num_output: 16 kernel_size: 11 stride: 4 }')
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(2, 3, 67, 67).astype(np.float32))
+    w = jnp.asarray(rs.randn(16, 3, 11, 11).astype(np.float32) * 0.05)
+    b = jnp.asarray(rs.randn(16).astype(np.float32))
+    monkeypatch.setenv("COS_CONV_S2D", "0")
+    y0 = get_op("Convolution").apply(Ctx(), lp, [w, b], [x])[0]
+    monkeypatch.setenv("COS_CONV_S2D", "1")
+    y1 = get_op("Convolution").apply(Ctx(), lp, [w, b], [x])[0]
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=2e-5, atol=2e-4)
+
+
+def test_stochastic_pooling():
+    """Caffe PoolForward{Test,Train}: test = sum(a^2)/sum(a); train samples
+    one in-window activation with probability proportional to its value."""
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx
+    lp = LayerParameter.from_text(
+        'name: "p" type: "Pooling" bottom: "x" top: "y" '
+        'pooling_param { pool: STOCHASTIC kernel_size: 2 stride: 2 }')
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 3, 4, 4).astype(
+        np.float32))
+    # TEST phase: weighted mean, checked against a direct loop
+    y = np.asarray(get_op("Pooling").apply(Ctx(train=False), lp, [], [x])[0])
+    xn = np.asarray(x)
+    for n in range(2):
+        for c in range(3):
+            for i in range(2):
+                for j in range(2):
+                    w = xn[n, c, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                    assert y[n, c, i, j] == pytest.approx(
+                        (w * w).sum() / w.sum(), rel=1e-5)
+    # all-zero window must produce 0, not NaN
+    z = get_op("Pooling").apply(Ctx(train=False), lp, [],
+                                [jnp.zeros((1, 1, 2, 2))])[0]
+    assert float(z[0, 0, 0, 0]) == 0.0
+    # TRAIN phase: every output is an element of its window, and the
+    # empirical sampling frequency tracks value/sum(window)
+    key = jax.random.PRNGKey(7)
+    lp2 = LayerParameter.from_text(
+        'name: "p" type: "Pooling" bottom: "x" top: "y" '
+        'pooling_param { pool: STOCHASTIC kernel_size: 2 stride: 2 }')
+    win = jnp.asarray([[1.0, 3.0], [2.0, 4.0]]).reshape(1, 1, 2, 2)
+    picks = []
+    for s in range(400):
+        ctx = Ctx(train=True, rng=jax.random.fold_in(key, s),
+                  layer_name="p")
+        out = get_op("Pooling").apply(ctx, lp2, [], [win])[0]
+        v = float(out[0, 0, 0, 0])
+        assert v in (1.0, 2.0, 3.0, 4.0)
+        picks.append(v)
+    freq4 = picks.count(4.0) / len(picks)
+    assert 0.3 < freq4 < 0.5  # p=0.4
+    # gradient routes to the sampled element only (one-hot)
+    g = jax.grad(lambda t: get_op("Pooling").apply(
+        Ctx(train=True, rng=key, layer_name="p"), lp2, [], [t])[0].sum())(win)
+    gn = np.asarray(g).ravel()
+    assert sorted(gn) == [0.0, 0.0, 0.0, 1.0]
+
+
 def test_lrn_across_channels():
     from caffeonspark_tpu.proto.caffe import LayerParameter
     from caffeonspark_tpu.ops.layers import get_op, Ctx

@@ -197,6 +197,25 @@ def test_write_through_chain_vivifies():
     assert "net_param" in s2.to_text()
 
 
+def test_string_fields_require_quotes():
+    """TextFormat parity: `type: ReLU` (unquoted) is a parse error, and
+    quoted values on numeric/enum fields are too."""
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    with pytest.raises(ValueError, match="quoted"):
+        LayerParameter.from_text('name: "x" type: ReLU')
+    with pytest.raises(ValueError, match="quoted"):
+        LayerParameter.from_text(
+            'name: "p" type: "Pooling" pooling_param { kernel_size: "3" }')
+    with pytest.raises(ValueError, match="quoted"):
+        LayerParameter.from_text(
+            'name: "p" type: "Pooling" pooling_param { pool: "MAX" }')
+    # and the canonical forms still parse
+    lp = LayerParameter.from_text(
+        'name: "p" type: "Pooling" pooling_param { pool: MAX '
+        'kernel_size: 3 }')
+    assert lp.type == "Pooling"
+
+
 def test_octal_and_hex_int_literals():
     assert SolverParameter.from_text("device_id: 010").device_id == 8
     assert SolverParameter.from_text("device_id: 0x1F").device_id == 31
