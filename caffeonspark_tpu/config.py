@@ -129,7 +129,7 @@ class Config:
         state = NetState(phase=phase)
         for i, lyr in enumerate(self.netParam.layer):
             if lyr.type not in ("MemoryData", "CoSData", "Data",
-                                "HDF5Data"):
+                                "HDF5Data", "ImageData"):
                 continue
             # full NetStateRule semantics: include rules OR'd, exclude
             # honored, rule-less layers in every phase

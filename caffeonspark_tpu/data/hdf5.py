@@ -89,3 +89,32 @@ class HDF5Source(DataSource):
         tops = list(self.layer.top)
         return {t: np.stack([r[1][t] for r in records]).astype(
             np.float32) for t in tops}
+
+
+# ---------------------------------------------------------------------------
+# HDF5Output sink (hdf5_output_layer.cpp analog)
+# ---------------------------------------------------------------------------
+
+def collect_hdf5_outputs(forward_state: Dict) -> Dict[str, List]:
+    """Pull the 'hdf5_output:<layer>' side-channel entries out of
+    Net.apply's forward-state return: {layer_name: [bottom arrays]}."""
+    prefix = "hdf5_output:"
+    return {k[len(prefix):]: v for k, v in forward_state.items()
+            if k.startswith(prefix)}
+
+
+def write_hdf5_outputs(file_name: str, batches: Sequence[Sequence],
+                       names: Sequence[str] = ("data", "label")) -> None:
+    """Write accumulated HDF5Output batches to `file_name` with Caffe's
+    dataset naming (hdf5_output_layer.cpp SaveBlobs: bottoms map to
+    'data' and 'label'); batches are concatenated along axis 0."""
+    import h5py
+    if not batches:
+        raise ValueError("no HDF5Output batches to write")
+    n_bottoms = len(batches[0])
+    with h5py.File(file_name, "w") as f:
+        for i in range(n_bottoms):
+            name = names[i] if i < len(names) else f"blob{i}"
+            arr = np.concatenate(
+                [np.asarray(b[i], np.float32) for b in batches], axis=0)
+            f.create_dataset(name, data=arr)

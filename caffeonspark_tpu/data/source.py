@@ -283,6 +283,65 @@ class ImageDataFrame(DataSource):
                    bool(col("encoded", encoded_default)), data)
 
 
+class ImageListSource(DataSource):
+    """Caffe's ImageData layer (image_data_layer.cpp): a text list of
+    `<path> <label>` lines, images loaded from disk (optionally under
+    root_folder), resized to new_height x new_width.  rand_skip and
+    shuffle follow the Caffe fields; rank striping shards the list."""
+
+    def __init__(self, layer: LayerParameter, **kw):
+        # Caffe's ImageData always resizes to new_height/new_width
+        kw["resize"] = True
+        super().__init__(layer, **kw)
+
+    def _batch_size(self) -> int:
+        return int(self.layer.image_data_param.batch_size)
+
+    def source_uri(self) -> str:
+        return _strip_scheme(self.layer.image_data_param.source)
+
+    def image_dims(self) -> Tuple[int, int, int]:
+        p = self.layer.image_data_param
+        c = 3 if p.is_color else 1
+        h, w = int(p.new_height), int(p.new_width)
+        if not h or not w:
+            cs = int(self.layer.transform_param.crop_size or 0)
+            h = h or cs
+            w = w or cs
+        return c, h, w
+
+    def _entries(self) -> List[Tuple[str, float]]:
+        p = self.layer.image_data_param
+        root = p.root_folder or ""
+        out = []
+        with open(self.source_uri()) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                path, _, lbl = ln.rpartition(" ")
+                if not path:      # no label column
+                    path, lbl = lbl, "0"
+                out.append((os.path.join(root, path), float(lbl)))
+        p_skip = int(p.rand_skip)
+        if p_skip:
+            skip = np.random.RandomState(self.seed).randint(0, p_skip)
+            out = out[skip:] + out[:skip]
+        return out
+
+    def records(self) -> Iterator[ImageRecord]:
+        c, h, w = self.image_dims()
+        entries = self._entries()
+        if self.layer.image_data_param.shuffle:
+            np.random.RandomState(self.seed).shuffle(entries)
+        for i, (path, lbl) in enumerate(entries):
+            if i % self.num_ranks != self.rank:
+                continue
+            with open(path, "rb") as f:
+                yield (os.path.basename(path), lbl, c, h, w, True,
+                       f.read())
+
+
 _CLASS_MAP = {
     "com.yahoo.ml.caffe.LMDB": LMDB,
     "com.yahoo.ml.caffe.SeqImageDataSource": SeqImageDataSource,
@@ -300,6 +359,8 @@ def get_source(layer: LayerParameter, **kw) -> DataSource:
         # Caffe layer type with no CoS source_class: route directly
         from .hdf5 import HDF5Source
         return HDF5Source(layer, **kw)
+    if layer.type == "ImageData":
+        return ImageListSource(layer, **kw)
     cls_name = layer.source_class
     if not cls_name:
         raise ValueError(f"data layer {layer.name!r} has no source_class")

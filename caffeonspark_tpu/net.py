@@ -139,6 +139,23 @@ def data_layer_input_specs(lp: LayerParameter) -> List[Tuple[str, Tuple[int, ...
         if len(lp.top) > 1:
             specs.append((lp.top[1], (b,), "label"))
         return specs
+    if t == "ImageData":
+        # image_data_layer.cpp: (path label) list file; static TPU
+        # shapes need new_height/new_width (or a crop) declared
+        p = lp.image_data_param
+        b = int(p.batch_size)
+        c = 3 if p.is_color else 1
+        cs = int(lp.transform_param.crop_size or 0)
+        h = cs or int(p.new_height)
+        w = cs or int(p.new_width)
+        if not h or not w:
+            raise ValueError(
+                f"ImageData layer {lp.name!r}: set new_height/new_width "
+                "(or transform_param.crop_size) — static shapes required")
+        specs = [(lp.top[0], (b, c, h, w), "data")]
+        if len(lp.top) > 1:
+            specs.append((lp.top[1], (b,), "label"))
+        return specs
     if t == "DummyData":
         p = lp.dummy_data_param
         out = []
@@ -382,6 +399,8 @@ class Net:
             return params
         out = {ln: dict(bl) for ln, bl in params.items()}
         for lname, blobs in forward_state.items():
+            if lname not in self.param_layout:
+                continue   # side-channel keys (LSTM hidden, HDF5Output)
             for (bname, _, _), arr in zip(self.param_layout[lname], blobs):
                 out[lname][bname] = arr
         return out

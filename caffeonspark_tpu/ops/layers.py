@@ -134,6 +134,21 @@ def _dummy_data(ctx, lp, params, bottoms):
     raise RuntimeError("data layers are net inputs; never applied")
 
 
+@register("ImageData", is_data=True)
+def _image_data(ctx, lp, params, bottoms):
+    raise RuntimeError("data layers are net inputs; never applied")
+
+
+@register("HDF5Output")
+def _hdf5_output(ctx, lp, params, bottoms):
+    """hdf5_output_layer.cpp: an output sink — file I/O cannot live
+    inside a jitted forward, so the bottoms are recorded in the forward
+    state under 'hdf5_output:<name>' and the runtime writes them with
+    `data.hdf5.write_hdf5_outputs` (see Net.apply's second return)."""
+    ctx.state_out["hdf5_output:" + ctx.layer_name] = list(bottoms)
+    return []
+
+
 # ---------------------------------------------------------------------------
 # Convolution / Deconvolution / InnerProduct / Embed
 # ---------------------------------------------------------------------------
@@ -700,6 +715,62 @@ def _bias(ctx, lp, params, bottoms):
     return [x + b.reshape(shape)]
 
 
+def _parameter_params(lp, shapes):
+    shape = tuple(int(d) for d in lp.parameter_param.shape.dim)
+    return [("param", shape, FillerParameter(type="constant"))]
+
+
+@register("Parameter", params=_parameter_params)
+def _parameter(ctx, lp, params, bottoms):
+    """parameter_layer.hpp: the top IS a learnable blob of the given
+    shape (lets arbitrary tensors be optimized, e.g. input embeddings)."""
+    return [params[0]]
+
+
+@register("BatchReindex")
+def _batch_reindex(ctx, lp, params, bottoms):
+    """batch_reindex_layer.cpp: top = bottom[0][bottom[1]] along axis 0
+    (gather; gradients scatter-add back through the first bottom)."""
+    x, idx = bottoms[0], bottoms[1]
+    return [jnp.take(x, idx.astype(jnp.int32).reshape(-1), axis=0)]
+
+
+@register("SPP")
+def _spp(ctx, lp, params, bottoms):
+    """Spatial pyramid pooling (spp_layer.cpp): for level i in
+    [0, pyramid_height), pool into 2^i x 2^i bins (kernel =
+    ceil(dim/bins), stride = kernel, end-pad to cover), flatten each
+    level and concat channel-wise → fixed-size vector regardless of
+    input H, W."""
+    p = lp.spp_param
+    x = bottoms[0]
+    n, c, h, w = x.shape
+    if not p.has("pyramid_height") or p.pyramid_height < 1:
+        raise ValueError("spp_param.pyramid_height must be >= 1")
+    outs = []
+    for i in range(int(p.pyramid_height)):
+        bins = 2 ** i
+        kh = -(-h // bins)
+        kw = -(-w // bins)
+        eh = kh * bins - h
+        ew = kw * bins - w
+        if p.pool == PoolMethod.MAX:
+            xp = jnp.pad(x, ((0, 0), (0, 0), (0, eh), (0, ew)),
+                         constant_values=-jnp.inf)
+            pooled = lax.reduce_window(xp, -jnp.inf, lax.max,
+                                       (1, 1, kh, kw), (1, 1, kh, kw),
+                                       "VALID")
+        elif p.pool == PoolMethod.AVE:
+            xp = jnp.pad(x, ((0, 0), (0, 0), (0, eh), (0, ew)))
+            s = lax.reduce_window(xp, 0.0, lax.add, (1, 1, kh, kw),
+                                  (1, 1, kh, kw), "VALID")
+            pooled = s / (kh * kw)
+        else:
+            raise NotImplementedError("SPP: MAX and AVE pooling only")
+        outs.append(pooled.reshape(n, -1))
+    return [jnp.concatenate(outs, axis=1)]
+
+
 # ---------------------------------------------------------------------------
 # shape ops
 # ---------------------------------------------------------------------------
@@ -925,6 +996,28 @@ def _sce_loss(ctx, lp, params, bottoms):
     # stable: max(x,0) - x*t + log(1+exp(-|x|))
     loss = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
     return [jnp.sum(loss) / x.shape[0]]
+
+
+@register("ContrastiveLoss", is_loss=True)
+def _contrastive_loss(ctx, lp, params, bottoms):
+    """Siamese-net loss (contrastive_loss_layer.cpp): bottoms are two
+    feature batches a, b (N, C) and a pair label y (1 = similar).
+    loss = 1/(2N) Σ [ y·d² + (1−y)·max(margin − d, 0)² ], d = ‖a−b‖;
+    legacy_version uses max(margin − d², 0) instead."""
+    p = lp.contrastive_loss_param
+    a, b, y = bottoms[0], bottoms[1], bottoms[2]
+    n = a.shape[0]
+    y = y.reshape(n).astype(a.dtype)
+    diff = (a - b).reshape(n, -1)
+    dist_sq = jnp.sum(diff * diff, axis=1)
+    if p.legacy_version:
+        mismatch = jnp.maximum(p.margin - dist_sq, 0.0)
+    else:
+        # sqrt guard: d=0 has zero gradient through maximum anyway
+        d = jnp.sqrt(jnp.maximum(dist_sq, 1e-12))
+        m = jnp.maximum(p.margin - d, 0.0)
+        mismatch = m * m
+    return [jnp.sum(y * dist_sq + (1.0 - y) * mismatch) / (2.0 * n)]
 
 
 @register("HingeLoss", is_loss=True)

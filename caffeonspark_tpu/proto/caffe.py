@@ -358,6 +358,14 @@ class MemoryDataParameter(Message):
     ]
 
 
+class ContrastiveLossParameter(Message):
+    FIELDS = [
+        Field(1, "margin", FLOAT, default=1.0),
+        # legacy: penalize (margin - d^2) instead of (margin - d)^2
+        Field(2, "legacy_version", BOOL, default=False),
+    ]
+
+
 class MVNParameter(Message):
     FIELDS = [
         Field(1, "normalize_variance", BOOL, default=True),
@@ -393,6 +401,13 @@ class PowerParameter(Message):
         Field(1, "power", FLOAT, default=1.0),
         Field(2, "scale", FLOAT, default=1.0),
         Field(3, "shift", FLOAT, default=0.0),
+    ]
+
+
+class SPPParameter(Message):
+    FIELDS = [
+        Field(1, "pyramid_height", UINT32),
+        Field(2, "pool", ENUM, enum=PoolMethod, default=PoolMethod.MAX),
     ]
 
 
@@ -585,6 +600,9 @@ class LayerParameter(Message):
         Field(139, "batch_norm_param", MESSAGE, message=BatchNormParameter),
         Field(141, "bias_param", MESSAGE, message=BiasParameter),
         Field(104, "concat_param", MESSAGE, message=ConcatParameter),
+        Field(105, "contrastive_loss_param", MESSAGE,
+              message=ContrastiveLossParameter),
+        Field(132, "spp_param", MESSAGE, message=SPPParameter),
         Field(106, "convolution_param", MESSAGE,
               message=ConvolutionParameter),
         Field(144, "crop_param", MESSAGE, message=CropParameter),

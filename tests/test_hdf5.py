@@ -72,3 +72,45 @@ def test_rank_sharding_disjoint(h5setup):
     assert ids[0] and ids[1]
     assert not (ids[0] & ids[1])         # no duplicated rows
     assert len(ids[0] | ids[1]) == 48    # full coverage
+
+
+def test_hdf5_output_layer(tmp_path):
+    """HDF5Output sink: bottoms flow out through the forward state and
+    write_hdf5_outputs produces the Caffe data/label datasets."""
+    import h5py
+    import jax.numpy as jnp
+    from caffeonspark_tpu.data.hdf5 import (collect_hdf5_outputs,
+                                            write_hdf5_outputs)
+    net_txt = """
+    name: "sink"
+    layer { name: "data" type: "Input" top: "data" top: "label"
+      input_param { shape { dim: 4 dim: 3 } shape { dim: 4 } } }
+    layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+      inner_product_param { num_output: 2
+        weight_filler { type: "xavier" } } }
+    layer { name: "out" type: "HDF5Output" bottom: "ip" bottom: "label"
+      hdf5_output_param { file_name: "ignored-by-jit" } }
+    layer { name: "loss" type: "EuclideanLoss" bottom: "ip"
+      bottom: "label_pair" top: "loss" }
+    layer { name: "lp" type: "Input" top: "label_pair"
+      input_param { shape { dim: 4 dim: 2 } } }
+    """
+    npm = NetParameter.from_text(net_txt)
+    net = Net(npm)
+    import jax
+    params = net.init(jax.random.PRNGKey(0))
+    batches = []
+    for i in range(3):
+        inputs = {"data": jnp.full((4, 3), float(i)),
+                  "label": jnp.arange(4.0) + i,
+                  "label_pair": jnp.zeros((4, 2))}
+        blobs, fwd_state = net.apply(params, inputs, train=False)
+        outs = collect_hdf5_outputs(fwd_state)
+        assert list(outs) == ["out"]
+        batches.append(outs["out"])
+    path = str(tmp_path / "sink.h5")
+    write_hdf5_outputs(path, batches)
+    with h5py.File(path, "r") as f:
+        assert f["data"].shape == (12, 2)
+        assert f["label"].shape == (12,)
+        np.testing.assert_allclose(f["label"][:4], np.arange(4.0))

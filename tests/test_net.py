@@ -300,6 +300,66 @@ def test_ave_pooling_divisor():
     assert y[0, 0, 1, 1] == pytest.approx(1.0)
 
 
+def test_contrastive_loss():
+    """Caffe contrastive_loss_layer semantics, modern + legacy."""
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx
+    rs = np.random.RandomState(1)
+    a = rs.randn(6, 4).astype(np.float32)
+    b = rs.randn(6, 4).astype(np.float32)
+    y = np.array([1, 0, 1, 0, 1, 0], np.float32)
+    lp = LayerParameter.from_text(
+        'name: "cl" type: "ContrastiveLoss" bottom: "a" bottom: "b" '
+        'bottom: "y" top: "l" contrastive_loss_param { margin: 2.0 }')
+    got = float(get_op("ContrastiveLoss").apply(
+        Ctx(), lp, [], [jnp.asarray(a), jnp.asarray(b),
+                        jnp.asarray(y)])[0])
+    d = np.linalg.norm(a - b, axis=1)
+    want = np.mean(y * d ** 2
+                   + (1 - y) * np.maximum(2.0 - d, 0) ** 2) / 2.0
+    assert got == pytest.approx(want, rel=1e-5)
+    lp2 = LayerParameter.from_text(
+        'name: "cl" type: "ContrastiveLoss" bottom: "a" bottom: "b" '
+        'bottom: "y" top: "l" contrastive_loss_param { margin: 2.0 '
+        'legacy_version: true }')
+    got2 = float(get_op("ContrastiveLoss").apply(
+        Ctx(), lp2, [], [jnp.asarray(a), jnp.asarray(b),
+                         jnp.asarray(y)])[0])
+    want2 = np.mean(y * d ** 2
+                    + (1 - y) * np.maximum(2.0 - d ** 2, 0)) / 2.0
+    assert got2 == pytest.approx(want2, rel=1e-5)
+
+
+def test_parameter_and_batch_reindex_and_spp():
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx
+    # Parameter: top is the learnable blob itself
+    lp = LayerParameter.from_text(
+        'name: "w" type: "Parameter" top: "w" '
+        'parameter_param { shape { dim: 3 dim: 2 } } ')
+    specs = get_op("Parameter").param_specs(lp, [])
+    assert specs[0][1] == (3, 2)
+    w = jnp.arange(6.0).reshape(3, 2)
+    assert get_op("Parameter").apply(Ctx(), lp, [w], [])[0] is w
+    # BatchReindex: gather along batch
+    lp = LayerParameter.from_text(
+        'name: "r" type: "BatchReindex" bottom: "x" bottom: "i" top: "y"')
+    x = jnp.arange(12.0).reshape(4, 3)
+    idx = jnp.asarray([2.0, 0.0, 2.0])
+    y = np.asarray(get_op("BatchReindex").apply(Ctx(), lp, [], [x, idx])[0])
+    np.testing.assert_allclose(y, np.asarray(x)[[2, 0, 2]])
+    # SPP: pyramid_height 3 → 1+4+16 bins per channel; level 0 = global
+    lp = LayerParameter.from_text(
+        'name: "s" type: "SPP" bottom: "x" top: "y" '
+        'spp_param { pyramid_height: 3 }')
+    rs = np.random.RandomState(0)
+    xi = jnp.asarray(rs.rand(2, 5, 9, 7).astype(np.float32))
+    out = np.asarray(get_op("SPP").apply(Ctx(), lp, [], [xi])[0])
+    assert out.shape == (2, 5 * (1 + 4 + 16))
+    np.testing.assert_allclose(out[:, :5],
+                               np.asarray(xi).max(axis=(2, 3)), rtol=1e-6)
+
+
 def test_space_to_depth_stem_conv():
     """_s2d_conv must equal the direct strided conv exactly (same
     arithmetic reordered): AlexNet conv1 (11x11s4 no pad) and ResNet
