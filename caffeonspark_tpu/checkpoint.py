@@ -190,7 +190,9 @@ def snapshot(net: Net, params: Params, opt_state: OptState, prefix: str,
                 _save_h5_blobs(local, net, params)
                 fsutils.upload(local, model_path)
         else:
-            _save_h5_blobs(fsutils.strip_local(model_path), net, params)
+            fsutils.atomic_write_local(
+                fsutils.strip_local(model_path),
+                lambda tmp: _save_h5_blobs(tmp, net, params))
     else:
         save_caffemodel(model_path, net, params)
 
@@ -224,7 +226,8 @@ def snapshot(net: Net, params: Params, opt_state: OptState, prefix: str,
                 _write_state_h5(local)
                 fsutils.upload(local, state_path)
         else:
-            _write_state_h5(fsutils.strip_local(state_path))
+            fsutils.atomic_write_local(fsutils.strip_local(state_path),
+                                       _write_state_h5)
     else:
         fsutils.write_bytes(state_path, st.to_binary())
     return model_path, state_path
@@ -243,12 +246,21 @@ class AsyncSnapshotter:
     """
 
     def __init__(self):
+        import atexit
         import queue as _q
         import threading
         self._q: "_q.Queue" = _q.Queue(maxsize=1)
         self._thread: Optional[threading.Thread] = None
         self._last_done: Optional[threading.Event] = None
         self._err: Optional[BaseException] = None
+        # interpreter exit must not abandon an in-flight write (the
+        # worker is a daemon thread); files themselves are additionally
+        # crash-safe via temp+rename in fsutils
+        atexit.register(self._drain)
+
+    def _drain(self):
+        if self._last_done is not None:
+            self._last_done.wait(timeout=120)
 
     def _ensure_thread(self):
         import threading

@@ -78,13 +78,14 @@ class DataSource:
 
     def __init__(self, layer: LayerParameter, *, phase_train: bool,
                  rank: int = 0, num_ranks: int = 1, seed: int = 0,
-                 resize: bool = False):
+                 resize: bool = False, num_threads: int = 0):
         self.layer = layer
         self.phase_train = phase_train
         self.rank = rank
         self.num_ranks = num_ranks
         self.seed = seed
         self.resize = resize
+        self.num_threads = num_threads  # 0 = native decoder's default
         self.batch_size = self._batch_size()
         self.transformer = Transformer(
             layer.transform_param if layer.has("transform_param") else None,
@@ -161,7 +162,7 @@ class DataSource:
             try:
                 return native.decode_batch(
                     [r[6] for r in records], channels=c, out_h=h,
-                    out_w=w)
+                    out_w=w, num_threads=self.num_threads)
             except ValueError:
                 pass  # corrupt image somewhere: per-image path reports it
         n = len(records)
@@ -293,6 +294,7 @@ class ImageListSource(DataSource):
         # Caffe's ImageData always resizes to new_height/new_width
         kw["resize"] = True
         super().__init__(layer, **kw)
+        self._epoch = 0
 
     def _batch_size(self) -> int:
         return int(self.layer.image_data_param.batch_size)
@@ -323,17 +325,26 @@ class ImageListSource(DataSource):
                 if not path:      # no label column
                     path, lbl = lbl, "0"
                 out.append((os.path.join(root, path), float(lbl)))
-        p_skip = int(p.rand_skip)
-        if p_skip:
-            skip = np.random.RandomState(self.seed).randint(0, p_skip)
-            out = out[skip:] + out[:skip]
         return out
 
     def records(self) -> Iterator[ImageRecord]:
+        """Caffe image_data_layer.cpp order: shuffle first (fresh
+        permutation every epoch — ShuffleImages() on each wrap), then
+        rand_skip once at startup only."""
         c, h, w = self.image_dims()
+        p = self.layer.image_data_param
         entries = self._entries()
-        if self.layer.image_data_param.shuffle:
-            np.random.RandomState(self.seed).shuffle(entries)
+        epoch, self._epoch = self._epoch, self._epoch + 1
+        if p.shuffle:
+            # rank-INdependent seed: every rank must apply the same
+            # permutation so the i % num_ranks striping below still
+            # partitions the list disjointly
+            seed = (self.seed + epoch * 131071) & 0x7FFFFFFF
+            np.random.RandomState(seed).shuffle(entries)
+        if int(p.rand_skip) and epoch == 0:
+            skip = np.random.RandomState(self.seed).randint(
+                0, int(p.rand_skip))
+            entries = entries[skip:] + entries[:skip]
         for i, (path, lbl) in enumerate(entries):
             if i % self.num_ranks != self.rank:
                 continue

@@ -738,35 +738,35 @@ def _batch_reindex(ctx, lp, params, bottoms):
 @register("SPP")
 def _spp(ctx, lp, params, bottoms):
     """Spatial pyramid pooling (spp_layer.cpp): for level i in
-    [0, pyramid_height), pool into 2^i x 2^i bins (kernel =
-    ceil(dim/bins), stride = kernel, end-pad to cover), flatten each
-    level and concat channel-wise → fixed-size vector regardless of
-    input H, W."""
+    [0, pyramid_height), pool into 2^i x 2^i bins, flatten each level
+    and concat channel-wise → fixed-size vector regardless of input
+    H, W.  Caffe's GetPoolingParam builds a per-level pooling layer
+    with kernel = ceil(dim/bins), stride = kernel, and SYMMETRIC pad
+    (remainder+1)/2 on both sides — delegated here to the Pooling
+    layer so bin windows and the pooled-dim clip match bit-for-bit
+    (weights ported from Caffe SPP nets reproduce)."""
     p = lp.spp_param
     x = bottoms[0]
     n, c, h, w = x.shape
     if not p.has("pyramid_height") or p.pyramid_height < 1:
         raise ValueError("spp_param.pyramid_height must be >= 1")
+    if p.pool not in (PoolMethod.MAX, PoolMethod.AVE):
+        raise NotImplementedError("SPP: MAX and AVE pooling only")
     outs = []
     for i in range(int(p.pyramid_height)):
         bins = 2 ** i
         kh = -(-h // bins)
         kw = -(-w // bins)
-        eh = kh * bins - h
-        ew = kw * bins - w
-        if p.pool == PoolMethod.MAX:
-            xp = jnp.pad(x, ((0, 0), (0, 0), (0, eh), (0, ew)),
-                         constant_values=-jnp.inf)
-            pooled = lax.reduce_window(xp, -jnp.inf, lax.max,
-                                       (1, 1, kh, kw), (1, 1, kh, kw),
-                                       "VALID")
-        elif p.pool == PoolMethod.AVE:
-            xp = jnp.pad(x, ((0, 0), (0, 0), (0, eh), (0, ew)))
-            s = lax.reduce_window(xp, 0.0, lax.add, (1, 1, kh, kw),
-                                  (1, 1, kh, kw), "VALID")
-            pooled = s / (kh * kw)
-        else:
-            raise NotImplementedError("SPP: MAX and AVE pooling only")
+        pool_lp = LayerParameter(name=f"{lp.name}_level{i}",
+                                 type="Pooling")
+        pool_lp.pooling_param.pool = p.pool
+        pool_lp.pooling_param.kernel_h = kh
+        pool_lp.pooling_param.kernel_w = kw
+        pool_lp.pooling_param.stride_h = kh
+        pool_lp.pooling_param.stride_w = kw
+        pool_lp.pooling_param.pad_h = (kh * bins - h + 1) // 2
+        pool_lp.pooling_param.pad_w = (kw * bins - w + 1) // 2
+        pooled = _pooling(ctx, pool_lp, [], [x])[0]
         outs.append(pooled.reshape(n, -1))
     return [jnp.concatenate(outs, axis=1)]
 

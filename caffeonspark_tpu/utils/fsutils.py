@@ -79,8 +79,30 @@ def open_file(path: str, mode: str = "rb"):
 
 
 def write_bytes(path: str, data: bytes) -> None:
-    with open_file(path, "wb") as f:
-        f.write(data)
+    if is_remote(path):
+        with open_file(path, "wb") as f:
+            f.write(data)
+        return
+    # local: temp + rename so a crash mid-write can never leave a
+    # truncated file where a resumable snapshot is expected
+    def _write(tmp):
+        with open(tmp, "wb") as f:
+            f.write(data)
+    atomic_write_local(strip_local(path), _write)
+
+
+def atomic_write_local(path: str, write_fn) -> None:
+    """Run write_fn(tmp_path) then os.replace into place — readers (and
+    the elastic-recovery supervisor) only ever see complete files."""
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        write_fn(tmp)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def read_bytes(path: str) -> bytes:

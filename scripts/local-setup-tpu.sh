@@ -11,7 +11,9 @@
 #   ndev   optional: set up an ndev-device *virtual CPU* mesh instead
 #          of real TPU devices (for laptops/CI; e.g. `source ... 8`)
 
-set -e 2>/dev/null || true
+# No `set -e`: this script is sourced, and errexit would persist into
+# (and can abort) the user's interactive shell.  Failures are handled
+# per-command below instead.
 
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 

@@ -358,6 +358,19 @@ def test_parameter_and_batch_reindex_and_spp():
     assert out.shape == (2, 5 * (1 + 4 + 16))
     np.testing.assert_allclose(out[:, :5],
                                np.asarray(xi).max(axis=(2, 3)), rtol=1e-6)
+    # level 1 (2x2 bins) on 9x7: kernel (5,4), SYMMETRIC pad
+    # (rem+1)/2 = (1,1) both sides like Caffe spp_layer.cpp
+    # GetPoolingParam — windows start at -pad, not 0
+    xa = np.asarray(xi)
+    want = np.empty((2, 5, 2, 2), np.float32)
+    for ph in range(2):
+        for pw in range(2):
+            hs, ws = ph * 5 - 1, pw * 4 - 1
+            want[:, :, ph, pw] = xa[:, :, max(hs, 0):min(hs + 5, 9),
+                                    max(ws, 0):min(ws + 4, 7)
+                                    ].max(axis=(2, 3))
+    np.testing.assert_allclose(out[:, 5:25].reshape(2, 5, 2, 2), want,
+                               rtol=1e-6)
 
 
 def test_space_to_depth_stem_conv():
