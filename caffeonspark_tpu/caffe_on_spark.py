@@ -226,6 +226,22 @@ def source_conf(source: DataSource) -> Config:
     return conf
 
 
+def validation_source(conf: Config) -> Optional[DataSource]:
+    """Interleaved-validation source, or None if the config doesn't
+    interleave.  Every rank feeds the SAME validation data in lockstep
+    — the reference replicates the one validation partition to every
+    executor (CaffeOnSpark.scala:293-302 via UnionRDDWLocsSpecified
+    + Util.executorLocations); rank-sharding it would validate each
+    rank on different data, so rank/num_ranks are pinned to 0/1."""
+    test_layer = conf.test_data_layer()
+    sp = conf.solverParameter
+    if test_layer is None or not sp.test_interval \
+            or not (sp.test_iter and sp.test_iter[0]):
+        return None
+    return get_source(test_layer, phase_train=False, rank=0,
+                      num_ranks=1, resize=conf.resize)
+
+
 # ---------------------------------------------------------------------------
 # CLI (CaffeOnSpark.main, :27-84)
 # ---------------------------------------------------------------------------
@@ -246,14 +262,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                          num_ranks=max(1, conf.clusterSize),
                          resize=conf.resize)
         src._conf = conf
-        test_layer = conf.test_data_layer()
-        sp = conf.solverParameter
-        if test_layer is not None and sp.test_interval \
-                and sp.test_iter and sp.test_iter[0]:
-            val_src = get_source(test_layer, phase_train=False,
-                                 rank=conf.rank,
-                                 num_ranks=max(1, conf.clusterSize),
-                                 resize=conf.resize)
+        val_src = validation_source(conf)
+        if val_src is not None:
             df = cos.trainWithValidation(src, val_src, conf)
             if conf.outputPath:
                 df.write(fsutils.join(conf.outputPath,

@@ -135,7 +135,7 @@ class SparkEngine:
         n = self.cluster_size
 
         def feed(idx, it):
-            from .spark_daemon import FeedClient
+            from .spark_daemon import FeedClient, strict_rank_enabled
             client = FeedClient.discover(app_id, rank=idx % n)
             if client is not None:
                 try:
@@ -145,6 +145,16 @@ class SparkEngine:
                     client.close()
                 yield fed
                 return
+            if strict_rank_enabled():
+                raise RuntimeError(
+                    f"strict rank pinning: no responsive feed daemon "
+                    f"for rank {idx % n} on this host "
+                    "(UnionRDDWLocsSpecified contract). Either Spark "
+                    f"placed partition {idx} on the wrong executor "
+                    "(relaunch with locality-pinned scheduling) or "
+                    "that rank's daemon/processor died (check executor "
+                    "logs); unset COS_FEED_STRICT_RANK to allow "
+                    "any-local fallback")
             # fallback: task shares the executor process
             from .processor import CaffeProcessor
             try:

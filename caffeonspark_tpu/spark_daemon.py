@@ -29,6 +29,13 @@ the daemon whose rank matches their partition, falling back to any
 local daemon (Spark does not pin partition→executor placement — the
 reference used UnionRDDWLocsSpecified for that; here any local
 processor accepts the records, lockstep step counts keep ranks even).
+
+COS_FEED_STRICT_RANK=1 disables the fallback: a client only connects
+to the daemon registered for its own rank and reports failure when it
+is absent.  This is the UnionRDDWLocsSpecified.scala:11-14 pinning
+contract made explicit — under real Spark placement the fallback would
+silently reshuffle partitions across ranks; strict mode turns that
+into an actionable error instead.
 """
 
 from __future__ import annotations
@@ -48,6 +55,12 @@ OP_STOP = 4
 
 _HDR = struct.Struct("<BI")
 CHUNK = 64  # records per FEED message (amortizes the ack round-trip)
+
+
+def strict_rank_enabled() -> bool:
+    """COS_FEED_STRICT_RANK=1: partition→rank pinning enforced (see
+    module doc).  Single source of truth for every caller."""
+    return os.environ.get("COS_FEED_STRICT_RANK") == "1"
 
 
 def _feed_dir(tmpdir: Optional[str] = None) -> str:
@@ -170,11 +183,16 @@ class FeedClient:
     def discover(cls, app_id: str = "", rank: Optional[int] = None,
                  tmpdir: Optional[str] = None) -> Optional["FeedClient"]:
         """Connect to a host-local daemon: the one registered for
-        `rank` if present, else any responsive one."""
+        `rank` if present, else any responsive one.  With
+        COS_FEED_STRICT_RANK=1 and a rank given, ONLY the matching
+        daemon qualifies (partition→rank pinning, see module doc)."""
+        strict = strict_rank_enabled()
         paths = _port_files(app_id, tmpdir)
         if rank is not None:
             pref = _port_file(app_id, rank, tmpdir)
-            if pref in paths:
+            if strict:
+                paths = [pref] if pref in paths else []
+            elif pref in paths:
                 paths.remove(pref)
                 paths.insert(0, pref)
         for path in paths:

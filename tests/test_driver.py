@@ -130,6 +130,36 @@ def test_train_with_validation_interleave(setup):
     assert final["loss"] < 0.5, df.rows
 
 
+def test_validation_source_identical_across_ranks(setup):
+    """The reference feeds every rank the SAME validation data in
+    lockstep (CaffeOnSpark.scala:293-302: the one validation partition
+    is replicated to every executor via UnionRDDWLocsSpecified).
+    validation_source() must therefore yield bit-identical batches for
+    every rank of a multi-rank config, while the TRAIN source shards."""
+    from caffeonspark_tpu.caffe_on_spark import validation_source
+    tmp, solver = setup
+    batches = {}
+    train_first = {}
+    for rank in (0, 1):
+        conf = Config(["-conf", str(solver), "-train",
+                       "-clusterSize", "2", "-rank", str(rank)])
+        vsrc = validation_source(conf)
+        assert vsrc is not None
+        gen = vsrc.batches(loop=False, shuffle=False)
+        batches[rank] = [next(gen) for _ in range(4)]   # test_iter
+        tsrc = get_source(conf.train_data_layer(), phase_train=True,
+                          rank=rank, num_ranks=2, seed=1)
+        train_first[rank] = next(tsrc.batches(loop=False,
+                                              shuffle=False))
+    for b0, b1 in zip(batches[0], batches[1]):
+        assert set(b0) == set(b1)
+        for k in b0:
+            np.testing.assert_array_equal(b0[k], b1[k])
+    # train shards ARE rank-disjoint (different data per rank)
+    assert not np.array_equal(train_first[0]["data"],
+                              train_first[1]["data"])
+
+
 def test_features_and_test(setup):
     """PythonApiTest analog: features → SampleID + blob columns;
     test() → accuracy mean > 0.9 after training."""

@@ -110,32 +110,37 @@ class _FakeBarrierContext:
 
 
 class _FakeRDD:
-    def __init__(self, partitions, barrier_mode=False):
+    def __init__(self, partitions, barrier_mode=False,
+                 ctx_cls=_FakeBarrierContext):
         self.partitions = partitions
         self.barrier_mode = barrier_mode
+        self.ctx_cls = ctx_cls
 
     def barrier(self):
-        return _FakeRDD(self.partitions, barrier_mode=True)
+        return _FakeRDD(self.partitions, barrier_mode=True,
+                        ctx_cls=self.ctx_cls)
 
     def mapPartitions(self, f):
         return _Stage(self.partitions, f, self.barrier_mode,
-                      per_element=False)
+                      per_element=False, ctx_cls=self.ctx_cls)
 
     def mapPartitionsWithIndex(self, f):
         return _Stage(self.partitions, f, self.barrier_mode,
-                      per_element=False, with_index=True)
+                      per_element=False, with_index=True,
+                      ctx_cls=self.ctx_cls)
 
     def map(self, f):
         return _Stage(self.partitions, f, self.barrier_mode,
-                      per_element=True)
+                      per_element=True, ctx_cls=self.ctx_cls)
 
 
 class _Stage:
     def __init__(self, partitions, f, barrier_mode, per_element,
-                 with_index=False):
+                 with_index=False, ctx_cls=_FakeBarrierContext):
         self.partitions, self.f = partitions, f
         self.barrier_mode, self.per_element = barrier_mode, per_element
         self.with_index = with_index
+        self.ctx_cls = ctx_cls
 
     def collect(self):
         n = len(self.partitions)
@@ -147,12 +152,16 @@ class _Stage:
             bar = threading.Barrier(n)
 
             def run(i):
-                ctx = _FakeBarrierContext(i, n, bar)
+                ctx = self.ctx_cls(i, n, bar)
                 _FakeBarrierContext._local.ctx = ctx
                 try:
                     out[i] = list(self.f(iter(self.partitions[i])))
                 except BaseException as e:  # surfaced after join
                     errors.append(e)
+                    # Spark semantics: ANY barrier-task failure fails
+                    # the whole stage — peers blocked in barrier() get
+                    # BrokenBarrierError instead of hanging
+                    bar.abort()
 
             threads = [threading.Thread(target=run, args=(i,))
                        for i in range(n)]
@@ -176,12 +185,15 @@ class _Stage:
 class _FakeSparkContext:
     applicationId = "fake-app"
 
+    def __init__(self, ctx_cls=_FakeBarrierContext):
+        self.ctx_cls = ctx_cls
+
     def parallelize(self, data, num_partitions):
         data = list(data)
         k, m = divmod(len(data), num_partitions)
         parts = [data[i * k + min(i, m):(i + 1) * k + min(i + 1, m)]
                  for i in range(num_partitions)]
-        return _FakeRDD(parts)
+        return _FakeRDD(parts, ctx_cls=self.ctx_cls)
 
 
 # ---------------------------------------------------------------------------
@@ -262,6 +274,111 @@ def test_feed_daemon_cross_process(conf, tmp_path):
             proc.stop()
         except Exception:
             pass
+
+
+def test_barrier_task_failure_fails_stage(conf, monkeypatch):
+    """A lost/failed barrier task must fail setup() fast (Spark fails
+    the whole barrier stage — the executor-count sanity of
+    CaffeOnSpark.scala:127-133), not hang the healthy ranks in
+    barrier()."""
+    monkeypatch.setattr(
+        spark_mod, "_get_barrier_context",
+        lambda: _FakeBarrierContext._local.ctx)
+
+    class _DyingCtx(_FakeBarrierContext):
+        def getTaskInfos(self):
+            if self._rank == 1:
+                raise RuntimeError("executor 1 lost")
+            return super().getTaskInfos()
+
+    conf.clusterSize = 2
+    engine = SparkEngine(_FakeSparkContext(ctx_cls=_DyingCtx), conf,
+                         require=False)
+    t0 = time.time()
+    with pytest.raises(Exception) as ei:
+        engine.setup()
+    assert time.time() - t0 < 60, "stage failure must not hang"
+    assert "executor 1 lost" in str(ei.value) \
+        or "Broken" in type(ei.value).__name__
+
+
+def test_coordinator_from_task_infos(conf, monkeypatch, tmp_path):
+    """setup() derives the jax.distributed coordinator from
+    getTaskInfos()[0].address (the all-gather replacing the reference's
+    collect round, CaffeOnSpark.scala:113-142) and passes every rank its
+    own id."""
+    import caffeonspark_tpu.parallel as parallel_mod
+    import caffeonspark_tpu.processor as proc_mod
+    import caffeonspark_tpu.spark_daemon as daemon_mod
+
+    monkeypatch.setattr(
+        spark_mod, "_get_barrier_context",
+        lambda: _FakeBarrierContext._local.ctx)
+    calls = []
+    monkeypatch.setattr(parallel_mod, "distributed_init",
+                        lambda coord, n, rank:
+                        calls.append((coord, n, rank)))
+
+    class _StubProc:
+        def start(self):
+            pass
+
+    monkeypatch.setattr(proc_mod.CaffeProcessor, "instance",
+                        classmethod(lambda cls, *a, **k: _StubProc()))
+
+    class _StubDaemon:
+        def __init__(self, proc, app_id, rank=0):
+            self.port = 40000 + rank
+
+    monkeypatch.setattr(daemon_mod, "FeedDaemon", _StubDaemon)
+
+    conf.clusterSize = 2
+    engine = SparkEngine(_FakeSparkContext(), conf, require=False)
+    plan = engine.setup()
+    assert [p["rank"] for p in plan] == [0, 1]
+    assert sorted(c[2] for c in calls) == [0, 1]
+    expect_port = spark_mod.coordinator_port("fake-app")
+    assert all(c[0] == f"127.0.0.1:{expect_port}" for c in calls)
+    assert all(c[1] == 2 for c in calls)
+
+
+def test_strict_rank_pinning(conf, tmp_path, monkeypatch):
+    """COS_FEED_STRICT_RANK=1: a client never falls back to a
+    different rank's daemon (the UnionRDDWLocsSpecified.scala:11-14
+    pinning contract), and the engine surfaces an actionable error for
+    an unpinned partition instead of silently reshuffling data."""
+    proc = CaffeProcessor.instance(conf)
+    proc.start()
+    daemon = FeedDaemon(proc, "strictapp", rank=0, tmpdir=str(tmp_path))
+    try:
+        monkeypatch.setenv("COS_FEED_STRICT_RANK", "1")
+        # rank 0 pinned daemon: found; rank 1: NO fallback
+        c0 = FeedClient.discover("strictapp", rank=0,
+                                 tmpdir=str(tmp_path))
+        assert c0 is not None
+        c0.close()
+        assert FeedClient.discover("strictapp", rank=1,
+                                   tmpdir=str(tmp_path)) is None
+        # default (non-strict) keeps the documented any-local fallback
+        monkeypatch.delenv("COS_FEED_STRICT_RANK")
+        c1 = FeedClient.discover("strictapp", rank=1,
+                                 tmpdir=str(tmp_path))
+        assert c1 is not None
+        c1.close()
+    finally:
+        daemon.stop()
+        try:
+            proc.stop()
+        except Exception:
+            pass
+
+
+def test_strict_rank_engine_error(conf, tmp_path, monkeypatch):
+    monkeypatch.setenv("COS_FEED_DIR", str(tmp_path))
+    monkeypatch.setenv("COS_FEED_STRICT_RANK", "1")
+    engine = SparkEngine(_FakeSparkContext(), conf, require=False)
+    with pytest.raises(RuntimeError, match="strict rank pinning"):
+        engine.feed_partitions(_FakeRDD([_records(8)]), 0)
 
 
 def test_feed_client_rejects_after_stop(conf, tmp_path):
