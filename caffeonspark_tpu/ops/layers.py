@@ -1156,28 +1156,67 @@ def _moe_params(lp, shapes):
 
 @register("MixtureOfExperts", params=_moe_params)
 def _moe(ctx, lp, params, bottoms):
-    """Top-1 routed expert FFN on (..., D) input — extension beyond the
-    reference.  Dispatch is a dense one-hot einsum, so under GSPMD the
-    expert-major W1/W2 tensors shard over the `ep` mesh axis
-    (`parallel.dp.tp_param_specs`) and each device computes only its
-    experts' tokens; the router uses a straight-through softmax weight
-    so routing stays differentiable."""
+    """Top-k routed expert FFN on (..., D) input — extension beyond the
+    reference, built the way TPU MoE stacks are (Switch/GShard-style
+    fixed expert capacity):
+
+    * each token's top-k experts get it IF the expert still has room;
+      capacity C = ceil(k·N/E · capacity_factor) is a static shape, so
+      the dispatch is a scatter into a dense (E, C, D) buffer (mode
+      'drop' discards overflow) and the expert FFN is two expert-major
+      batched matmuls that shard over the `ep` mesh axis under GSPMD
+      (`parallel.dp.tp_param_specs`) — memory O(E·C·D), not O(E·N·D);
+    * gates come from the softmax router (normalized over the chosen k
+      for k>1), so routing stays differentiable through the combine;
+    * if the layer declares a second top it emits the load-balancing
+      auxiliary loss  E · Σ_e f_e·P_e  (f = realized assignment
+      fraction, P = mean router probability) — weight it with the
+      layer's second `loss_weight`.
+    """
+    mp = lp.moe_param
     router, w1, w2 = params
     x = bottoms[0]
     lead = x.shape[:-1]
     d = x.shape[-1]
+    e = int(mp.num_experts)
+    k = max(1, int(mp.top_k))
     xf = x.reshape(-1, d)                       # (N, D) tokens
+    n = xf.shape[0]
+    cap = max(1, int(math.ceil(k * n / e * float(mp.capacity_factor))))
+
     logits = xf @ router                        # (N, E)
-    probs = jax.nn.softmax(logits, axis=-1)
-    top = jnp.argmax(probs, axis=-1)            # (N,)
-    onehot = jax.nn.one_hot(top, router.shape[1], dtype=x.dtype)
-    gate = jnp.sum(probs * onehot, axis=-1, keepdims=True)
-    # dense dispatch: (E, N, D) masked tokens → per-expert FFN → combine
-    dispatched = jnp.einsum("ne,nd->end", onehot, xf)
-    hidden = jax.nn.relu(jnp.einsum("end,edh->enh", dispatched, w1))
-    out = jnp.einsum("enh,ehd->end", hidden, w2)
-    combined = jnp.einsum("end,ne->nd", out, onehot)
-    return [(combined * gate).reshape(lead + (d,))]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = lax.top_k(probs, k)            # (N, k)
+    gates = topv / topv.sum(-1, keepdims=True) if k > 1 else topv
+
+    # slot-major flattening: every token's 1st choice claims capacity
+    # before any token's 2nd choice (GShard dispatch order)
+    flat_e = topi.T.reshape(-1)                 # (k·N,)
+    onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.float32)
+    # position of each assignment within its expert's buffer
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0)
+    pos = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # (k·N,)
+    keep = pos < cap
+
+    tokens = jnp.tile(xf, (k, 1))               # (k·N, D) slot-major
+    disp = jnp.zeros((e, cap, d), x.dtype).at[flat_e, pos].set(
+        tokens, mode="drop")                    # overflow dropped
+    hidden = jax.nn.relu(jnp.einsum("ecd,edh->ech", disp, w1))
+    out = jnp.einsum("ech,ehd->ecd", hidden, w2)
+
+    gathered = out[flat_e, jnp.minimum(pos, cap - 1)]       # (k·N, D)
+    gathered = jnp.where(keep[:, None], gathered, 0.0)
+    gf = (gates.T.reshape(-1)[:, None].astype(x.dtype) * gathered)
+    combined = gf.reshape(k, n, d).sum(axis=0)
+    tops = [combined.reshape(lead + (d,))]
+
+    if len(lp.top) > 1:
+        # Switch-Transformer balance loss: realized assignment
+        # fraction × mean router prob, scaled by E (=1 at uniform)
+        frac = onehot.mean(axis=0)              # (E,)
+        mean_p = probs.mean(axis=0)
+        tops.append((e * jnp.sum(frac * mean_p)).astype(jnp.float32))
+    return tops
 
 
 # ---------------------------------------------------------------------------

@@ -545,13 +545,17 @@ class CoSDataParameter(Message):
 
 
 class MoEParameter(Message):
-    """Extension (no reference equivalent): top-1 routed
-    mixture-of-experts FFN; the expert dimension shards over the ep
-    mesh axis."""
+    """Extension (no reference equivalent): top-k routed
+    mixture-of-experts FFN with fixed expert capacity; the expert
+    dimension shards over the ep mesh axis.  A second top, when
+    declared, emits the load-balancing auxiliary loss (weight it via
+    the layer's second loss_weight)."""
     FIELDS = [
         Field(1, "num_experts", UINT32, default=4),
         Field(2, "hidden_dim", UINT32, default=256),
         Field(3, "weight_filler", MESSAGE, message=FillerParameter),
+        Field(4, "top_k", UINT32, default=1),
+        Field(5, "capacity_factor", FLOAT, default=1.25),
     ]
 
 

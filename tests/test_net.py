@@ -373,6 +373,73 @@ def test_parameter_and_batch_reindex_and_spp():
                                rtol=1e-6)
 
 
+def test_moe_capacity_drop_and_aux_loss():
+    """Capacity-factor dispatch (Switch-style): with every token routed
+    to one expert and capacity_factor 1.0, only C = k*N/E tokens fit;
+    overflow tokens produce ZERO output (dropped, not densely
+    computed), and the balance aux loss reads ~E for total skew vs ~1
+    for uniform routing."""
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx
+    lp = LayerParameter.from_text(
+        'name: "m" type: "MixtureOfExperts" bottom: "x" top: "y" '
+        'top: "aux" loss_weight: 0 loss_weight: 0.01 '
+        'moe_param { num_experts: 4 hidden_dim: 8 capacity_factor: 1.0 }')
+    rs = np.random.RandomState(0)
+    n, d, e = 32, 6, 4
+    x = jnp.asarray(rs.rand(n, d).astype(np.float32) + 0.1)
+    # router forces every token to expert 1
+    router = np.zeros((d, e), np.float32)
+    router[:, 1] = 5.0
+    w1 = jnp.asarray(rs.randn(e, d, 8).astype(np.float32) * 0.3)
+    w2 = jnp.asarray(rs.randn(e, 8, d).astype(np.float32) * 0.3)
+    out, aux = get_op("MixtureOfExperts").apply(
+        Ctx(), lp, [jnp.asarray(router), w1, w2], [x])
+    out = np.asarray(out)
+    cap = 8                                  # ceil(1*32/4*1.0)
+    assert np.abs(out[:cap]).sum() > 0
+    np.testing.assert_array_equal(out[cap:], 0.0)
+    assert float(aux) > 2.0                  # ~E at total skew
+    # uniform-ish routing: aux near 1
+    router2 = rs.randn(d, e).astype(np.float32) * 0.01
+    _, aux2 = get_op("MixtureOfExperts").apply(
+        Ctx(), lp, [jnp.asarray(router2), w1, w2], [x])
+    assert 0.8 < float(aux2) < 1.5
+
+
+def test_moe_top2_matches_dense_reference():
+    """top_k=2 with ample capacity == the dense per-token computation:
+    normalized top-2 gates over each chosen expert's FFN."""
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx
+    lp = LayerParameter.from_text(
+        'name: "m" type: "MixtureOfExperts" bottom: "x" top: "y" '
+        'moe_param { num_experts: 4 hidden_dim: 8 top_k: 2 '
+        'capacity_factor: 4.0 }')
+    rs = np.random.RandomState(1)
+    n, d, e = 16, 5, 4
+    x = rs.rand(n, d).astype(np.float32)
+    router = rs.randn(d, e).astype(np.float32)
+    w1 = rs.randn(e, d, 8).astype(np.float32) * 0.3
+    w2 = rs.randn(e, 8, d).astype(np.float32) * 0.3
+    (out,) = get_op("MixtureOfExperts").apply(
+        Ctx(), lp, [jnp.asarray(router), jnp.asarray(w1),
+                    jnp.asarray(w2)], [jnp.asarray(x)])
+    out = np.asarray(out)
+
+    logits = x @ router
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.zeros_like(x)
+    for i in range(n):
+        top2 = np.argsort(p[i])[::-1][:2]
+        gsum = p[i][top2].sum()
+        for ex in top2:
+            h = np.maximum(x[i] @ w1[ex], 0.0)
+            want[i] += (p[i][ex] / gsum) * (h @ w2[ex])
+    np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-5)
+
+
 def test_space_to_depth_stem_conv():
     """_s2d_conv must equal the direct strided conv exactly (same
     arithmetic reordered): AlexNet conv1 (11x11s4 no pad) and ResNet
