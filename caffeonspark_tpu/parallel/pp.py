@@ -2,17 +2,27 @@
 
 Not present in the reference (SURVEY §2.7: pipeline parallel — no);
 provided as a TPU-native extension for models too large for one chip's
-HBM.  Design (GPipe-style):
+HBM.  Design:
 
-  * the layer graph is cut into contiguous stages balanced by parameter
-    count (`partition_layers`), each stage's params pinned to one device;
+  * the layer graph is cut into contiguous stages balanced by
+    parameter + activation cost (`partition_layers` — activation sizes
+    come from the net's static shape inference), each stage's params
+    pinned to one device;
   * forward runs per-stage jitted functions with explicit inter-stage
     `device_put` (the activation hop rides ICI on real hardware);
   * backward chains `jax.vjp` through the stages in reverse — stage s's
     parameter cotangents materialize on stage s's device;
   * microbatches accumulate gradients before one optimizer update
-    (identical numerics to the full batch), and jax's async dispatch
-    overlaps microbatch m's stage k with m+1's earlier stages;
+    (identical numerics to the full batch);
+  * ops are dispatched in a **1F1B schedule** (`schedule_1f1b`).  This
+    matters because JAX devices execute their queues FIFO in enqueue
+    order: enqueueing microbatch m's whole fwd+bwd chain before m+1
+    (the naive loop) parks bwd(0, m) at the head of stage 0's queue
+    where it blocks fwd(0, m+1) — serializing the pipeline.  The 1F1B
+    order enqueues every op only after its dependencies, per device in
+    executable order, so async dispatch overlaps stages for real, and
+    each microbatch's activation stash is freed at its bwd (bounded
+    live memory: ≤ S in-flight microbatches, not M);
   * the per-stage optimizer update reuses the Solver's Caffe update rule
     (lr_mult/decay/momentum) restricted to that stage's layers.
 """
@@ -32,14 +42,21 @@ from ..solver import OptState, Solver, learning_rate
 Array = jax.Array
 
 
-def partition_layers(net: Net, num_stages: int) -> List[List[str]]:
-    """Contiguous stages balanced by learnable parameter count, ≥1 layer
-    per stage."""
+def partition_layers(net: Net, num_stages: int, *,
+                     act_weight: float = 1.0) -> List[List[str]]:
+    """Contiguous stages balanced by parameter + activation cost, ≥1
+    layer per stage.  Activation cost (top-blob elements, from the
+    net's static shape inference) matters as much as parameter count:
+    early conv layers are param-light but activation-heavy, and a
+    param-only balance starves the later stages' devices of work while
+    overloading stage 0's memory with stashed activations."""
     costs = []
     for lp in net.compute_layers:
         n = sum(math.prod(s) for _, s, _ in
                 net.param_layout.get(lp.name, []))
-        costs.append((lp.name, max(n, 1)))
+        a = sum(math.prod(s) for s in
+                net._top_shapes.get(lp.name, {}).values())
+        costs.append((lp.name, max(n + act_weight * a, 1)))
     n = len(costs)
     num_stages = min(num_stages, n)
     total = sum(c for _, c in costs)
@@ -60,6 +77,59 @@ def partition_layers(net: Net, num_stages: int) -> List[List[str]]:
     bounds = [0] + cuts + [n]
     return [[costs[i][0] for i in range(bounds[s], bounds[s + 1])]
             for s in range(num_stages)]
+
+
+def schedule_1f1b(num_stages: int, num_microbatches: int
+                  ) -> List[Tuple[str, int, int]]:
+    """Global dispatch order for one training step: list of
+    ("F"|"B", stage, microbatch).
+
+    Per-stage pattern is classic non-interleaved 1F1B — stage s warms
+    up with min(M, S-1-s) forwards, then alternates one-forward/
+    one-backward, then drains backwards.  The per-stage sequences are
+    merged into one global order by a round-robin that only emits an op
+    whose dependencies (fwd(s-1, m) for F; bwd(s+1, m) for B; F before
+    its own B) are already emitted.  The result is a topological order,
+    so per-device FIFO execution can never head-of-line block: every
+    device is free to run as soon as its inputs arrive — this is the
+    property that turns async dispatch into real pipeline overlap.
+    """
+    S, M = num_stages, num_microbatches
+    seqs: List[List[Tuple[str, int]]] = []
+    for s in range(S):
+        w = min(M, S - 1 - s)
+        seq: List[Tuple[str, int]] = [("F", m) for m in range(w)]
+        f, b = w, 0
+        while f < M or b < M:
+            if f < M:
+                seq.append(("F", f))
+                f += 1
+            if b < M:
+                seq.append(("B", b))
+                b += 1
+        seqs.append(seq)
+    order: List[Tuple[str, int, int]] = []
+    emitted = set()
+    idx = [0] * S
+    while any(idx[s] < len(seqs[s]) for s in range(S)):
+        progressed = False
+        for s in range(S):                 # one op per stage per round
+            if idx[s] >= len(seqs[s]):
+                continue
+            kind, m = seqs[s][idx[s]]
+            if kind == "F":
+                ok = s == 0 or ("F", s - 1, m) in emitted
+            else:
+                ok = (("F", s, m) in emitted
+                      and (s == S - 1 or ("B", s + 1, m) in emitted))
+            if ok:
+                order.append((kind, s, m))
+                emitted.add((kind, s, m))
+                idx[s] += 1
+                progressed = True
+        if not progressed:
+            raise RuntimeError("1F1B schedule deadlock (bug)")
+    return order
 
 
 class PipelineSolver:
@@ -116,6 +186,9 @@ class PipelineSolver:
 
         self._stage_fns = None
         self._update_fns = None
+        # test/diagnostic hook: set to a list to record the dispatch
+        # order as (kind, stage, microbatch) tuples
+        self._trace: Optional[List[Tuple[str, int, int]]] = None
 
     # ------------------------------------------------------------------
     def place_params(self, params: Params) -> Params:
@@ -172,62 +245,63 @@ class PipelineSolver:
         self._stage_fns = fns
         return fns
 
-    def _forward_backward(self, params, micro, rng=None):
-        """One microbatch: returns (loss, grads) with grads on each
-        stage's own device."""
-        import jax.random as jrandom
-        if rng is None:
-            rng = jrandom.key(0)
+    def _run_fwd(self, params, s, mb, rng):
+        """Dispatch stage s's forward for one microbatch state `mb`
+        (dict with 'acts', 'vjps', 'state_shapes', 'fwd_state')."""
         fns = self._build_stage_fns()
-        S = len(self.stages)
-        acts: Dict[str, Array] = dict(micro)
-        vjps = []
-        fwd_state: Dict[str, List[Array]] = {}
-        stage_state_shapes = []
-        for s in range(S):
-            ins = {b: jax.device_put(acts[b], self.devices[s])
-                   for b in self.stage_in[s]}
-            sp = self.stage_params(params, s)
-            (outs, st_out), vjp = jax.vjp(
-                lambda p, a, _f=fns[s]: _f(p, a, rng), sp, ins)
-            vjps.append(vjp)
-            stage_state_shapes.append(st_out)
-            fwd_state.update(st_out)
-            acts.update(outs)
-        # total loss (weighted) on the last device
-        loss = jnp.zeros((), jnp.float32)
-        for b, w in self.net.loss_weights.items():
-            loss = loss + w * jnp.sum(
-                jax.device_put(acts[b], self.devices[-1]))
-        # backward: seed cotangents per stage output
-        grads: Params = {}
-        cot: Dict[str, Array] = {
-            b: jnp.full_like(acts[b], w)
-            for b, w in self.net.loss_weights.items()}
-        for s in reversed(range(S)):
-            out_cot = {}
-            for b in self.stage_out[s]:
-                if b in cot:
-                    # POP: in-place layers reuse blob names across stages
-                    # (relu2's 'fc_big' vs conv's 'fc_big'); each stage's
-                    # cotangent belongs to ITS version of the value
-                    out_cot[b] = jax.device_put(cot.pop(b),
-                                                self.devices[s])
-                else:
-                    out_cot[b] = jnp.zeros_like(
-                        jax.device_put(acts[b], self.devices[s]))
-            state_cot = jax.tree_util.tree_map(
-                jnp.zeros_like, stage_state_shapes[s])
-            g_sp, g_in = vjps[s]((out_cot, state_cot))
-            grads.update(g_sp)
-            for b, g in g_in.items():
-                if b in cot:
-                    # same-version fan-out to several consumer stages
-                    dev = next(iter(cot[b].devices()))
-                    cot[b] = cot[b] + jax.device_put(g, dev)
-                else:
-                    cot[b] = g
-        return loss, grads, fwd_state
+        acts = mb["acts"]
+        ins = {b: jax.device_put(acts[b], self.devices[s])
+               for b in self.stage_in[s]}
+        sp = self.stage_params(params, s)
+        (outs, st_out), vjp = jax.vjp(
+            lambda p, a, _f=fns[s]: _f(p, a, rng), sp, ins)
+        mb["vjps"][s] = vjp
+        mb["state_shapes"][s] = st_out
+        mb["fwd_state"].update(st_out)
+        acts.update(outs)
+        if s == len(self.stages) - 1:
+            loss = jnp.zeros((), jnp.float32)
+            for b, w in self.net.loss_weights.items():
+                loss = loss + w * jnp.sum(
+                    jax.device_put(acts[b], self.devices[-1]))
+            mb["loss"] = loss
+
+    def _run_bwd(self, params, s, mb, grads_acc):
+        """Dispatch stage s's backward for microbatch state `mb`,
+        accumulating parameter cotangents into grads_acc; frees the
+        stage's vjp residuals afterwards (the 1F1B memory bound)."""
+        acts = mb["acts"]
+        if mb["cot"] is None:
+            mb["cot"] = {b: jnp.full_like(acts[b], w)
+                         for b, w in self.net.loss_weights.items()}
+        cot = mb["cot"]
+        out_cot = {}
+        for b in self.stage_out[s]:
+            if b in cot:
+                # POP: in-place layers reuse blob names across stages
+                # (relu2's 'fc_big' vs conv's 'fc_big'); each stage's
+                # cotangent belongs to ITS version of the value
+                out_cot[b] = jax.device_put(cot.pop(b), self.devices[s])
+            else:
+                out_cot[b] = jnp.zeros_like(
+                    jax.device_put(acts[b], self.devices[s]))
+        state_cot = jax.tree_util.tree_map(
+            jnp.zeros_like, mb["state_shapes"][s])
+        g_sp, g_in = mb["vjps"][s]((out_cot, state_cot))
+        mb["vjps"][s] = None          # release activation stash
+        for ln, bl in g_sp.items():
+            if ln in grads_acc:
+                grads_acc[ln] = {bn: grads_acc[ln][bn] + g
+                                 for bn, g in bl.items()}
+            else:
+                grads_acc[ln] = dict(bl)
+        for b, g in g_in.items():
+            if b in cot:
+                # same-version fan-out to several consumer stages
+                dev = next(iter(cot[b].devices()))
+                cot[b] = cot[b] + jax.device_put(g, dev)
+            else:
+                cot[b] = g
 
     # ------------------------------------------------------------------
     def _build_update_fn(self):
@@ -248,21 +322,41 @@ class PipelineSolver:
         solver = self.solver
         m = self.num_microbatches
         clip = solver.param.clip_gradients
+        S = len(self.stages)
+        order = schedule_1f1b(S, m)
 
         def step(params, state, microbatches, rng):
-            grads_acc: Optional[Params] = None
-            loss_acc = 0.0
-            fwd_state_last = {}
+            mbs = []
             for i in range(m):
-                micro = {k: v[i] for k, v in microbatches.items()}
-                loss, grads, fwd_state = self._forward_backward(
-                    params, micro, jax.random.fold_in(rng, i))
-                grads_acc = grads if grads_acc is None else {
-                    ln: {bn: grads_acc[ln][bn] + g
-                         for bn, g in bl.items()}
-                    for ln, bl in grads.items()}
-                loss_acc = loss_acc + loss
-                fwd_state_last.update(fwd_state)
+                mbs.append({
+                    "acts": {k: v[i] for k, v in microbatches.items()},
+                    "vjps": [None] * S,
+                    "state_shapes": [None] * S,
+                    "fwd_state": {},
+                    "cot": None,
+                    "loss": None,
+                })
+            grads_acc: Params = {}
+            for kind, s, i in order:
+                if self._trace is not None:
+                    self._trace.append((kind, s, i))
+                if kind == "F":
+                    self._run_fwd(params, s, mbs[i],
+                                  jax.random.fold_in(rng, i))
+                else:
+                    self._run_bwd(params, s, mbs[i], grads_acc)
+                    if s == 0:
+                        # microbatch i fully drained: free its boundary
+                        # activations/cotangents so live memory tracks
+                        # the ≤S in-flight microbatches, not all M
+                        # (loss + last microbatch's fwd_state are kept)
+                        mbs[i]["acts"] = None
+                        mbs[i]["cot"] = None
+                        mbs[i]["state_shapes"] = None
+                        if i != m - 1:
+                            mbs[i]["fwd_state"] = None
+            loss_acc = sum(mb["loss"] for mb in mbs)
+            fwd_state_last = mbs[-1]["fwd_state"]
             grads_mean = {ln: {bn: g / m for bn, g in bl.items()}
                           for ln, bl in grads_acc.items()}
             # global clip across ALL stages (per-stage _apply_update

@@ -167,6 +167,84 @@ def test_pipeline_parallel_matches_single_device():
     np.testing.assert_allclose(w1, w4, rtol=5e-3, atol=5e-5)
 
 
+@pytest.mark.parametrize("S,M", [(2, 4), (4, 8), (3, 3), (4, 2)])
+def test_schedule_1f1b_properties(S, M):
+    """The 1F1B order must be (a) complete, (b) topological w.r.t.
+    pipeline dependencies, (c) overlap-enabling — fwd(0, m+1) is
+    dispatched before bwd(0, m), which the naive per-microbatch loop
+    violates (it parks bwd at the head of stage 0's FIFO queue,
+    serializing the pipeline), and (d) memory-bounded: at most S
+    microbatches have a live activation stash per stage."""
+    from caffeonspark_tpu.parallel.pp import schedule_1f1b
+    order = schedule_1f1b(S, M)
+    assert len(order) == 2 * S * M
+    assert len(set(order)) == len(order)
+    pos = {op: i for i, op in enumerate(order)}
+    for s in range(S):
+        for m in range(M):
+            assert ("F", s, m) in pos and ("B", s, m) in pos
+            if s > 0:
+                assert pos[("F", s, m)] > pos[("F", s - 1, m)]
+            if s < S - 1:
+                assert pos[("B", s, m)] > pos[("B", s + 1, m)]
+            assert pos[("B", s, m)] > pos[("F", s, m)]
+    if M > 1 and S > 1:
+        assert pos[("F", 0, 1)] < pos[("B", 0, 0)], (
+            "stage 0 must forward the next microbatch before draining "
+            "the previous one's backward — otherwise no overlap")
+    # per-stage live activation stash never exceeds the pipeline depth
+    for s in range(S):
+        live = peak = 0
+        for kind, ss, _ in order:
+            if ss != s:
+                continue
+            live += 1 if kind == "F" else -1
+            peak = max(peak, live)
+        assert peak <= S, f"stage {s} stashes {peak} > S={S} microbatches"
+    # FIFO-executability: walking per-device queues in dispatch order
+    # with cross-stage deps never deadlocks
+    queues = {s: [op for op in order if op[1] == s] for s in range(S)}
+    done = set()
+    for _ in range(len(order)):
+        for s in range(S):
+            if not queues[s]:
+                continue
+            kind, ss, m = queues[s][0]
+            deps = []
+            if kind == "F" and s > 0:
+                deps.append(("F", s - 1, m))
+            if kind == "B":
+                deps.append(("F", s, m))
+                if s < S - 1:
+                    deps.append(("B", s + 1, m))
+            if all(d in done for d in deps):
+                done.add(queues[s].pop(0))
+    assert len(done) == len(order), "FIFO execution deadlocked"
+
+
+def test_pipeline_dispatch_follows_1f1b():
+    """The PipelineSolver's actual dispatch order IS the 1F1B schedule
+    (recorded via the _trace hook during a real 4-stage step on the
+    virtual mesh).  On single-core CI the overlap cannot show up in
+    wall-clock; the enqueue order is the device-visible property that
+    produces overlap on real multi-chip hardware (per-device FIFO
+    queues execute as soon as inputs arrive)."""
+    from caffeonspark_tpu.parallel import PipelineSolver
+    from caffeonspark_tpu.parallel.pp import schedule_1f1b
+    sp = SolverParameter.from_text(SOLVER)
+    npm = NetParameter.from_text(NET)
+    batch = _global_batch()
+    s4 = Solver(sp, npm)
+    pp = PipelineSolver(s4, num_stages=4, num_microbatches=4)
+    p4, st4 = pp.init()
+    step4 = pp.train_step()
+    pp._trace = []
+    p4, st4, out = step4(p4, st4, pp.split_microbatches(batch),
+                         s4.step_rng(0))
+    assert pp._trace == schedule_1f1b(4, 4)
+    assert np.isfinite(float(out["loss"]))
+
+
 def test_moe_ep_training_matches_single_device():
     """Expert parallelism: a MixtureOfExperts net trains on a dp2×ep4
     mesh with expert tensors sharded over ep — numerics match the
