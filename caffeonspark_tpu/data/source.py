@@ -227,16 +227,61 @@ class DataSource:
             epoch += 1
 
 
-class LMDB(DataSource):
-    """LMDB of Caffe Datum records (source_class com.yahoo.ml.caffe.LMDB)."""
+class _DBSource(DataSource):
+    """Shared rank-sharded read loop for key-value databases of Datum
+    records; subclasses provide `_reader()`."""
+
+    def _reader(self):
+        raise NotImplementedError
 
     def records(self) -> Iterator[ImageRecord]:
-        path = self.source_uri()
-        with LmdbReader(path) as r:
+        with self._reader() as r:
             ranges = r.partition_ranges(self.num_ranks)
             lo, hi = ranges[self.rank % len(ranges)]
             for k, v in r.items(lo, hi):
                 yield datum_to_record(k, v)
+
+
+class LMDB(_DBSource):
+    """LMDB of Caffe Datum records (source_class com.yahoo.ml.caffe.LMDB)."""
+
+    def _reader(self):
+        return LmdbReader(self.source_uri())
+
+
+class CaffeDataSource(_DBSource):
+    """Caffe's own `Data` layer (`data_param { source backend }`):
+    LMDB or LEVELDB databases of serialized Datum records — the
+    db_lmdb.cpp / db_leveldb.cpp pair.  Geometry comes from the first
+    record (Caffe infers shapes from the database the same way)."""
+
+    def _batch_size(self) -> int:
+        return int(self.layer.data_param.batch_size)
+
+    def source_uri(self) -> str:
+        return _strip_scheme(self.layer.data_param.source)
+
+    def _reader(self):
+        from ..proto.caffe import DBBackend
+        if self.layer.data_param.backend == DBBackend.LEVELDB:
+            from .leveldb_io import LevelDBReader
+            return LevelDBReader(self.source_uri())
+        return LmdbReader(self.source_uri())
+
+    def image_dims(self) -> Tuple[int, int, int]:
+        dims = getattr(self, "_dims", None)
+        if dims is None:
+            with self._reader() as r:
+                for k, v in r.items(None, None):
+                    d = Datum.from_binary(v)
+                    dims = (int(d.channels), int(d.height),
+                            int(d.width))
+                    break
+            if dims is None:
+                raise ValueError(
+                    f"{self.source_uri()!r}: empty database")
+            self._dims = dims
+        return dims
 
 
 class SeqImageDataSource(DataSource):
@@ -372,6 +417,10 @@ def get_source(layer: LayerParameter, **kw) -> DataSource:
         return HDF5Source(layer, **kw)
     if layer.type == "ImageData":
         return ImageListSource(layer, **kw)
+    if layer.type == "Data" and not layer.source_class:
+        # source_class-less Data layer: Caffe's own LMDB/LevelDB path;
+        # WITH a source_class the CoS dispatch below takes precedence
+        return CaffeDataSource(layer, **kw)
     cls_name = layer.source_class
     if not cls_name:
         raise ValueError(f"data layer {layer.name!r} has no source_class")

@@ -80,6 +80,27 @@ def _cos_top_shape(top, batch: int) -> Tuple[int, ...]:
     return (batch, int(top.channels), int(top.height), int(top.width))
 
 
+def _peek_db_dims(lp: LayerParameter) -> Tuple[int, int, int]:
+    """First-record (C, H, W) of a Data layer's LMDB/LevelDB database;
+    (3, 0, 0) when the database isn't readable at graph-build time
+    (deploy nets parsed away from the data)."""
+    from .proto.caffe import DBBackend, Datum
+    try:
+        from .data.source import _strip_scheme
+        source = _strip_scheme(lp.data_param.source)
+        if lp.data_param.backend == DBBackend.LEVELDB:
+            from .data.leveldb_io import LevelDBReader as _Reader
+        else:
+            from .data.lmdb_io import LmdbReader as _Reader
+        with _Reader(source) as r:
+            for _k, v in r.items(None, None):
+                d = Datum.from_binary(v)
+                return int(d.channels), int(d.height), int(d.width)
+    except Exception:
+        pass
+    return 3, 0, 0
+
+
 def data_layer_input_specs(lp: LayerParameter) -> List[Tuple[str, Tuple[int, ...], str]]:
     """(blob_name, shape, kind) for each top of a data layer.
     kind ∈ {'data','label','int'} guides dtype selection downstream."""
@@ -133,8 +154,13 @@ def data_layer_input_specs(lp: LayerParameter) -> List[Tuple[str, Tuple[int, ...
         p = lp.data_param
         b = int(p.batch_size)
         cs = int(p.crop_size or lp.transform_param.crop_size or 0)
-        # channels/size unknown until records arrive; caller overrides
-        shape = (b, 3, cs or 1, cs or 1)
+        # Caffe's DataLayer reads the first Datum at LayerSetUp to size
+        # its tops (data_layer.cpp); do the same so downstream layers
+        # compile against the real geometry
+        c, h, w = _peek_db_dims(lp)
+        if cs:
+            h = w = cs
+        shape = (b, c, h or 1, w or 1)
         specs = [(lp.top[0], shape, "data")]
         if len(lp.top) > 1:
             specs.append((lp.top[1], (b,), "label"))

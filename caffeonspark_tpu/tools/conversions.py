@@ -31,22 +31,34 @@ def coco_to_image_caption(annotation_json: str, image_root: str,
     with open(annotation_json) as f:
         coco = json.load(f)
     images = {im["id"]: im for im in coco.get("images", [])}
-    rows: List[Dict] = []
-    for ann in coco.get("annotations", []):
-        im = images.get(ann["image_id"])
-        if im is None:
-            continue
-        row = {"id": str(ann["image_id"]),
+
+    def base_row(im):
+        row = {"id": str(im["id"]),
                "height": int(im.get("height", 0)),
-               "width": int(im.get("width", 0)),
-               "caption": ann["caption"]}
+               "width": int(im.get("width", 0))}
         fname = os.path.join(image_root, im["file_name"])
         if embed_image_bytes and os.path.exists(fname):
             with open(fname, "rb") as imf:
                 row["data"] = imf.read()
         else:
             row["data"] = b""
-        rows.append(row)
+        return row
+
+    rows: List[Dict] = []
+    if coco.get("annotations"):
+        for ann in coco["annotations"]:
+            im = images.get(ann["image_id"])
+            if im is None:
+                continue
+            row = base_row(im)
+            row["caption"] = ann["caption"]
+            rows.append(row)
+    else:
+        # caption-less dataset (inference/feature extraction): one row
+        # per image — the Image2Embedding input shape
+        # (CocoDataSetConverter.scala:41-45 branch on a missing
+        # 'caption' column)
+        rows = [base_row(im) for im in coco.get("images", [])]
     if output_path:
         _write_parquet(rows, output_path)
     return rows
@@ -76,6 +88,22 @@ def image_caption_to_embedding(caption_rows: Iterable[Dict], vocab: Vocab,
                     target_sentence=target_sentence,
                     cont_sentence=cont_sentence,
                     label=0.0)
+        out.append(erow)
+    if output_path:
+        _write_parquet(out, output_path)
+    return out
+
+
+def image_to_embedding(caption_rows: Iterable[Dict],
+                       output_path: Optional[str] = None) -> List[Dict]:
+    """Caption-less rows → embedding rows (id, image data, label 0) —
+    `Conversions.Image2Embedding` (Conversions.scala:107-137): the
+    image-only deploy-time input for caption generation."""
+    out: List[Dict] = []
+    for row in caption_rows:
+        erow = dict(row)
+        erow.pop("caption", None)
+        erow["label"] = 0.0
         out.append(erow)
     if output_path:
         _write_parquet(out, output_path)

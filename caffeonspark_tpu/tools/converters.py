@@ -102,13 +102,37 @@ def sequence2lmdb(seq_path: str, output: str) -> int:
     return len(recs)
 
 
+def leveldb2lmdb(leveldb_path: str, output: str) -> int:
+    """Migrate a Caffe LevelDB database to LMDB (the faster TPU-feed
+    path; also what `data_param.backend: LEVELDB` users convert with
+    when they want LmdbRDD-style range partitioning)."""
+    from ..data.leveldb_io import LevelDBReader
+    with LevelDBReader(leveldb_path) as r:
+        recs = list(r.items(None, None))
+    LmdbWriter(output).write(recs)
+    return len(recs)
+
+
 def _write_parquet(rows: List[Dict], path: str) -> None:
-    import pyarrow as pa
-    import pyarrow.parquet as pq
+    """Row dicts → parquet, or json-lines when the path ends .json
+    (Spark's DataFrame json sink base64-encodes binary columns; same
+    here so the files interop)."""
     if not rows:
         raise ValueError(f"no rows to write to {path} (empty input?)")
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
+    if path.endswith(".json"):
+        import base64
+        import json as _json
+        with open(path, "w") as f:
+            for r in rows:
+                enc = {k: (base64.b64encode(v).decode("ascii")
+                           if isinstance(v, (bytes, bytearray)) else v)
+                       for k, v in r.items()}
+                f.write(_json.dumps(enc) + "\n")
+        return
+    import pyarrow as pa
+    import pyarrow.parquet as pq
     pq.write_table(pa.table({k: [r.get(k) for r in rows]
                              for k in rows[0]}), path)
 
@@ -141,14 +165,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     s2l.add_argument("-sequence", required=True)
     s2l.add_argument("-output", required=True)
 
-    coco = sub.add_parser("cocodataset")
+    ldb = sub.add_parser("leveldb2lmdb")
+    ldb.add_argument("-leveldb", required=True)
+    ldb.add_argument("-output", required=True)
+
+    coco = sub.add_parser(
+        "cocodataset",
+        description="COCO caption pipeline driver "
+                    "(CocoDataSetConverter.scala:1-49 analog): "
+                    "annotations json -> caption DF [-> vocab -> "
+                    "LRCN embedding DF], or image-only embedding when "
+                    "the json has no annotations")
     coco.add_argument("-captionFile", required=True)
     coco.add_argument("-imageRoot", required=True)
-    coco.add_argument("-imageCaptionDFDir", required=True)
+    coco.add_argument("-imageCaptionDFDir", default="",
+                      help="optional: also write the caption DF here")
     coco.add_argument("-vocabDir", required=True)
     coco.add_argument("-embeddingDFDir", required=True)
     coco.add_argument("-vocabSize", type=int, default=10000)
     coco.add_argument("-captionLength", type=int, default=20)
+    coco.add_argument("-outputFormat", default="parquet",
+                      choices=["parquet", "json"])
 
     a = p.parse_args(argv)
     if a.tool == "binary2sequence":
@@ -161,18 +198,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         n = lmdb2dataframe(a.lmdb, a.output)
     elif a.tool == "sequence2lmdb":
         n = sequence2lmdb(a.sequence, a.output)
-    else:  # cocodataset (CocoDataSetConverter.scala analog)
+    elif a.tool == "leveldb2lmdb":
+        n = leveldb2lmdb(a.leveldb, a.output)
+    else:  # cocodataset (CocoDataSetConverter.scala:17-49 analog)
         from .conversions import (coco_to_image_caption,
-                                  image_caption_to_embedding)
+                                  image_caption_to_embedding,
+                                  image_to_embedding)
         from .vocab import Vocab
         rows = coco_to_image_caption(
             a.captionFile, a.imageRoot,
-            os.path.join(a.imageCaptionDFDir, "captions.parquet"))
-        vocab = Vocab.build((r["caption"] for r in rows), a.vocabSize)
-        vocab.save(a.vocabDir)
-        emb = image_caption_to_embedding(
-            rows, vocab, a.captionLength,
-            os.path.join(a.embeddingDFDir, "embedding.parquet"))
+            os.path.join(a.imageCaptionDFDir, "captions.parquet")
+            if a.imageCaptionDFDir else None)
+        out_path = os.path.join(a.embeddingDFDir,
+                                "embedding." + a.outputFormat)
+        if rows and "caption" in rows[0]:
+            # reuse an existing vocab (the fs.exists branch,
+            # CocoDataSetConverter.scala:35-39) so a shared vocab stays
+            # stable across dataset conversions
+            if Vocab.exists(a.vocabDir):
+                vocab = Vocab.load(a.vocabDir)
+            else:
+                vocab = Vocab.build((r["caption"] for r in rows),
+                                    a.vocabSize)
+                vocab.save(a.vocabDir)
+            emb = image_caption_to_embedding(rows, vocab,
+                                             a.captionLength, out_path)
+        else:
+            emb = image_to_embedding(rows, out_path)
         n = len(emb)
     print(f"{a.tool}: {n} records")
     return 0

@@ -39,19 +39,30 @@ class Vocab:
         return cls(most)
 
     # -- io ----------------------------------------------------------------
-    def save(self, path: str) -> None:
+    @staticmethod
+    def resolve_path(path: str) -> str:
+        """The vocab FILE for a save/load path: directories (existing or
+        intended — no file extension) hold `vocab.txt`; anything with an
+        extension is the file itself.  One rule shared by save/load/
+        exists so callers can't drift apart."""
         if os.path.isdir(path) or not os.path.splitext(path)[1]:
-            os.makedirs(path, exist_ok=True)
-            path = os.path.join(path, "vocab.txt")
+            return os.path.join(path, "vocab.txt")
+        return path
+
+    @classmethod
+    def exists(cls, path: str) -> bool:
+        return os.path.exists(cls.resolve_path(path))
+
+    def save(self, path: str) -> None:
+        path = self.resolve_path(path)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "w") as f:
             for w in self.words:
                 f.write(w + "\n")
 
     @classmethod
     def load(cls, path: str) -> "Vocab":
-        if os.path.isdir(path):
-            path = os.path.join(path, "vocab.txt")
-        with open(path) as f:
+        with open(cls.resolve_path(path)) as f:
             return cls([l.rstrip("\n") for l in f if l.strip()])
 
     # -- mapping -----------------------------------------------------------

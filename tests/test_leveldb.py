@@ -1,0 +1,186 @@
+"""LevelDB backend tests: the `data_param.backend: LEVELDB` path
+(reference: caffe-public db_leveldb.cpp, VERDICT r2 item 10).
+
+No LevelDB library exists in this image, so the writer half of
+`leveldb_io` builds the fixtures; it emits the documented on-disk
+format (SSTable blocks + restart arrays + crc32c-masked trailers +
+footer magic, write-ahead log records) and snappy mode produces a
+spec-valid all-literal stream, which makes the reader's real
+decompression path run.  Cross-validation against a C++ leveldb was
+not possible in-image; structural conformance is asserted instead
+(magic, crc verification on by default — corrupting one byte fails).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from caffeonspark_tpu.data.leveldb_io import (LevelDBReader,
+                                              LevelDBWriter, crc32c,
+                                              snappy_decompress)
+from caffeonspark_tpu.proto.caffe import Datum
+
+
+def _records(n=40, vsize=200, seed=0):
+    rs = np.random.RandomState(seed)
+    return [(b"%08d" % i, rs.bytes(vsize)) for i in range(n)]
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 / public test vectors
+    assert crc32c(b"") == 0
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(bytes(32)) == 0x8A9136AA
+
+
+def test_snappy_decompress_copies():
+    # literal "abcd" + copy(offset 4, len 4) => "abcdabcd"
+    comp = bytes([8]) + bytes([(4 - 1) << 2]) + b"abcd" \
+        + bytes([(4 - 4) << 3 | 1, 4])
+    assert snappy_decompress(comp) == b"abcdabcd"
+    with pytest.raises(ValueError):
+        snappy_decompress(bytes([4, 1, 4]))   # copy before any output
+
+
+@pytest.mark.parametrize("snappy", [False, True])
+def test_sstable_round_trip(tmp_path, snappy):
+    recs = _records(100, 500)
+    path = str(tmp_path / "db")
+    LevelDBWriter(path, block_size=2048, snappy=snappy).write(recs)
+    with LevelDBReader(path) as r:
+        got = list(r.items(None, None))
+    assert got == sorted(recs)
+
+
+def test_crc_detects_corruption(tmp_path):
+    recs = _records(50)
+    path = str(tmp_path / "db")
+    LevelDBWriter(path).write(recs)
+    sst = os.path.join(path, "000005.ldb")
+    data = bytearray(open(sst, "rb").read())
+    data[10] ^= 0xFF
+    open(sst, "wb").write(bytes(data))
+    with pytest.raises(ValueError, match="crc"):
+        with LevelDBReader(path) as r:
+            list(r.items(None, None))
+
+
+def test_log_merge_overwrite_and_delete(tmp_path):
+    """WAL entries shadow SSTable entries (higher sequence wins), and
+    deletions hide keys — the version-merge semantics of a real
+    database mid-compaction."""
+    from caffeonspark_tpu.data import leveldb_io as L
+    recs = _records(20)
+    path = str(tmp_path / "db")
+    w = LevelDBWriter(path)
+    w.write(recs)
+    # log: overwrite key 3, add key 99, delete key 5
+    import struct
+    batch = bytearray(struct.pack("<QI", 500, 3))
+    for etype, k, v in [(1, b"00000003", b"NEWVALUE"),
+                        (1, b"00000099", b"ADDED"),
+                        (0, b"00000005", b"")]:
+        batch += bytes([etype]) + L._put_uvarint(len(k)) + k
+        if etype == 1:
+            batch += L._put_uvarint(len(v)) + v
+    payload = bytes(batch)
+    with open(os.path.join(path, "000007.log"), "wb") as f:
+        crc = L.crc_mask(L.crc32c(payload, L.crc32c(bytes([L.LOG_FULL]))))
+        f.write(struct.pack("<IHB", crc, len(payload), L.LOG_FULL)
+                + payload)
+    with LevelDBReader(path) as r:
+        got = dict(r.items(None, None))
+    assert got[b"00000003"] == b"NEWVALUE"
+    assert got[b"00000099"] == b"ADDED"
+    assert b"00000005" not in got
+    assert got[b"00000001"] == dict(recs)[b"00000001"]
+
+
+def test_log_only_database_and_fragmentation(tmp_path):
+    """A database of only write-ahead logs (never compacted), with a
+    payload large enough to fragment across 32 KiB log blocks."""
+    recs = _records(300, 400, seed=2)
+    path = str(tmp_path / "db")
+    LevelDBWriter(path).write_log(recs)
+    with LevelDBReader(path) as r:
+        assert list(r.items(None, None)) == sorted(recs)
+
+
+def test_partition_ranges_cover_disjoint(tmp_path):
+    recs = _records(64)
+    path = str(tmp_path / "db")
+    LevelDBWriter(path).write(recs)
+    with LevelDBReader(path) as r:
+        ranges = r.partition_ranges(4)
+        parts = [list(r.items(lo, hi)) for lo, hi in ranges]
+    total = [kv for p in parts for kv in p]
+    assert total == sorted(recs)
+    assert all(len(p) > 0 for p in parts)
+
+
+def test_partition_more_ranks_than_keys(tmp_path):
+    """Surplus ranks get DISTINCT empty ranges (LmdbReader contract) —
+    never an alias of rank 0's keys, which would double-read records."""
+    recs = _records(3)
+    path = str(tmp_path / "db")
+    LevelDBWriter(path).write(recs)
+    with LevelDBReader(path) as r:
+        ranges = r.partition_ranges(4)
+        assert len(ranges) == 4
+        parts = [list(r.items(lo, hi)) for lo, hi in ranges]
+    total = [kv for p in parts for kv in p]
+    assert total == sorted(recs)             # disjoint cover, no dupes
+    assert sum(1 for p in parts if not p) == 1
+
+
+def test_data_layer_leveldb_source(tmp_path):
+    """End to end: a Caffe `Data` layer with backend LEVELDB feeds
+    batches through the standard source SPI."""
+    from caffeonspark_tpu.data import get_source
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    rs = np.random.RandomState(1)
+    recs = []
+    for i in range(32):
+        img = rs.randint(0, 255, (1, 12, 12), dtype=np.uint8)
+        recs.append((b"%08d" % i,
+                     Datum(channels=1, height=12, width=12,
+                           label=i % 7, data=img.tobytes()).to_binary()))
+    LevelDBWriter(str(tmp_path / "db"), snappy=True).write(recs)
+    lp = LayerParameter.from_text(f'''
+      name: "data" type: "Data" top: "data" top: "label"
+      data_param {{ source: "{tmp_path}/db" batch_size: 8
+                    backend: LEVELDB }}''')
+    src = get_source(lp, phase_train=False, seed=0)
+    assert src.image_dims() == (1, 12, 12)
+    batches = list(src.batches(loop=False, shuffle=False))
+    assert len(batches) == 4
+    assert batches[0]["data"].shape == (8, 1, 12, 12)
+    assert batches[0]["label"].tolist() == [i % 7 for i in range(8)]
+    # rank sharding: 2 ranks cover the set disjointly
+    s0 = get_source(lp, phase_train=False, num_ranks=2, rank=0)
+    s1 = get_source(lp, phase_train=False, num_ranks=2, rank=1)
+    ids0 = [r[0] for r in s0.records()]
+    ids1 = [r[0] for r in s1.records()]
+    assert not set(ids0) & set(ids1)
+    assert len(ids0) + len(ids1) == 32
+
+
+def test_leveldb2lmdb_tool(tmp_path):
+    from caffeonspark_tpu.data.lmdb_io import LmdbReader
+    from caffeonspark_tpu.tools.converters import leveldb2lmdb
+    recs = _records(25, 100, seed=3)
+    LevelDBWriter(str(tmp_path / "ldb")).write(recs)
+    n = leveldb2lmdb(str(tmp_path / "ldb"), str(tmp_path / "lmdb"))
+    assert n == 25
+    with LmdbReader(str(tmp_path / "lmdb")) as r:
+        assert list(r.items(None, None)) == sorted(recs)
+
+
+def test_missing_or_invalid_database_errors(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        LevelDBReader(str(tmp_path / "nope"))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="not a LevelDB"):
+        LevelDBReader(str(empty))

@@ -178,3 +178,38 @@ def test_coco_pipeline_cli(tmp_path, image_dir):
     assert t.num_rows == 4
     assert set(t.column_names) >= {"id", "data", "input_sentence",
                                    "target_sentence", "cont_sentence"}
+
+    # re-run: the existing vocab must be REUSED, not rebuilt
+    # (CocoDataSetConverter.scala:35-39 fs.exists branch)
+    vocab_file = tmp_path / "vocab" / "vocab.txt"
+    before = vocab_file.read_text()
+    vocab_file.write_text(before + "zzz_sentinel\n")
+    r2 = subprocess.run(
+        [sys.executable, "-m", "caffeonspark_tpu.tools.converters",
+         "cocodataset", "-captionFile", str(cf), "-imageRoot", str(d),
+         "-vocabDir", str(tmp_path / "vocab"),
+         "-embeddingDFDir", str(tmp_path / "embdf2"),
+         "-vocabSize", "50", "-captionLength", "8"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r2.returncode == 0, r2.stderr[-800:]
+    assert "zzz_sentinel" in vocab_file.read_text()
+
+    # caption-less json → image-only embedding (Image2Embedding path),
+    # json output format
+    cf2 = tmp_path / "images_only.json"
+    cf2.write_text(json.dumps({"images": coco["images"]}))
+    r3 = subprocess.run(
+        [sys.executable, "-m", "caffeonspark_tpu.tools.converters",
+         "cocodataset", "-captionFile", str(cf2), "-imageRoot", str(d),
+         "-vocabDir", str(tmp_path / "vocab"),
+         "-embeddingDFDir", str(tmp_path / "embdf3"),
+         "-outputFormat", "json"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r3.returncode == 0, r3.stderr[-800:]
+    lines = (tmp_path / "embdf3" / "embedding.json").read_text() \
+        .strip().splitlines()
+    assert len(lines) == 4
+    row = json.loads(lines[0])
+    assert row["label"] == 0.0 and "input_sentence" not in row
+    import base64
+    assert len(base64.b64decode(row["data"])) > 100  # real jpeg bytes
