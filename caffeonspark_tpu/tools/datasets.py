@@ -134,6 +134,81 @@ def build_digits(out: str, train_frac: float = 0.85,
     print(f"mnist_test_lmdb: {n2} records")
 
 
+_LENET_NET = """name: "LeNet"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  include {{ phase: TRAIN }}
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param {{ source: "{out}/mnist_train_lmdb" batch_size: 64
+    channels: 1 height: 28 width: 28 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  include {{ phase: TEST }}
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param {{ source: "{out}/mnist_test_lmdb" batch_size: 100
+    channels: 1 height: 28 width: 28 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  param {{ lr_mult: 1 }} param {{ lr_mult: 2 }}
+  convolution_param {{ num_output: 20 kernel_size: 5 stride: 1
+    weight_filler {{ type: "xavier" }}
+    bias_filler {{ type: "constant" }} }} }}
+layer {{ name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param {{ pool: MAX kernel_size: 2 stride: 2 }} }}
+layer {{ name: "conv2" type: "Convolution" bottom: "pool1" top: "conv2"
+  param {{ lr_mult: 1 }} param {{ lr_mult: 2 }}
+  convolution_param {{ num_output: 50 kernel_size: 5 stride: 1
+    weight_filler {{ type: "xavier" }}
+    bias_filler {{ type: "constant" }} }} }}
+layer {{ name: "pool2" type: "Pooling" bottom: "conv2" top: "pool2"
+  pooling_param {{ pool: MAX kernel_size: 2 stride: 2 }} }}
+layer {{ name: "ip1" type: "InnerProduct" bottom: "pool2" top: "ip1"
+  param {{ lr_mult: 1 }} param {{ lr_mult: 2 }}
+  inner_product_param {{ num_output: 500
+    weight_filler {{ type: "xavier" }}
+    bias_filler {{ type: "constant" }} }} }}
+layer {{ name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }}
+layer {{ name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  param {{ lr_mult: 1 }} param {{ lr_mult: 2 }}
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }}
+    bias_filler {{ type: "constant" }} }} }}
+layer {{ name: "accuracy" type: "Accuracy" bottom: "ip2" bottom: "label"
+  top: "accuracy" include {{ phase: TEST }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
+  bottom: "label" top: "loss" }}
+"""
+
+_LENET_SOLVER = """net: "{out}/lenet_train_test.prototxt"
+test_iter: 10
+test_interval: 100
+base_lr: 0.01
+momentum: 0.9
+weight_decay: 0.0005
+lr_policy: "inv"
+gamma: 0.0001
+power: 0.75
+display: 100
+max_iter: 1000
+snapshot: 500
+snapshot_prefix: "lenet"
+random_seed: 1
+"""
+
+
+def emit_lenet_configs(out: str) -> None:
+    """Ready-to-train LeNet configs pointing at the built LMDBs —
+    the `data/lenet_memory_{solver,train_test}.prototxt` pair of the
+    reference, with sources resolved (reference users get them
+    pre-baked in `data/`; here the builder writes them next to the
+    data so quickstarts/compose files can train immediately)."""
+    out_abs = os.path.abspath(out)
+    with open(os.path.join(out, "lenet_train_test.prototxt"), "w") as f:
+        f.write(_LENET_NET.format(out=out_abs))
+    with open(os.path.join(out, "lenet_solver.prototxt"), "w") as f:
+        f.write(_LENET_SOLVER.format(out=out_abs))
+    print("lenet_{solver,train_test}.prototxt written")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="cos_datasets", description=__doc__)
     ap.add_argument("dataset", choices=["mnist", "cifar10", "digits"])
@@ -144,10 +219,12 @@ def main(argv=None) -> int:
     os.makedirs(a.out, exist_ok=True)
     if a.dataset == "mnist":
         build_mnist(a.src, a.out)
+        emit_lenet_configs(a.out)
     elif a.dataset == "cifar10":
         build_cifar10(a.src, a.out)
     else:
         build_digits(a.out)
+        emit_lenet_configs(a.out)
     return 0
 
 

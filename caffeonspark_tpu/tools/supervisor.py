@@ -10,12 +10,34 @@ down (a dead peer leaves survivors blocked in the gradient all-reduce
 — the same hang a dead NCCL/MPI peer causes) and relaunches everyone
 from the newest snapshot pair found in the output directory.
 
+Single host (all ranks local):
+
     python -m caffeonspark_tpu.tools.supervisor \
         -solver solver.prototxt -train /path/lmdb -output out/ \
         -cluster 4 [-max_restarts 3] [-port 47788] \
         [-- extra mini_cluster flags...]
 
-Exit code 0 iff a run completes (every rank exits 0).
+Multi-host pod (one supervisor per TPU-VM worker — see docs/deploy.md
+and scripts/launch-tpu-pod.sh): each host launches only its slice of
+ranks and every host points at the SAME rank-0 coordinator:
+
+    python -m caffeonspark_tpu.tools.supervisor \
+        -solver ... -output gs://bucket/run1 -cluster 16 \
+        -server ${WORKER0_IP}:47788 -rank_base $((WORKER_ID*4)) \
+        -local_ranks 4 -stall_timeout 300
+
+Cross-host restart coordination: a remote rank's death stalls local
+ranks inside the collective instead of killing them, so each
+supervisor also watches run PROGRESS (snapshot files + local rank
+logs); `-stall_timeout` turns a silent hang into a local teardown.
+Every attempt uses coordinator port `port + attempt`, so supervisors
+that restart independently reconverge on the same attempt number —
+a host that is behind tears down its stale attempt when its ranks die
+against the vanished old coordinator.  `-output` should be shared
+storage (NFS/GCS via fsspec) so any host can resume from the newest
+snapshot.
+
+Exit code 0 iff a run completes (every local rank exits 0).
 """
 
 from __future__ import annotations
@@ -59,9 +81,11 @@ class Supervisor:
                 ) -> subprocess.Popen:
         a = self.args
         port = getattr(self, "attempt_port", a.port)
+        host = (a.server.rsplit(":", 1)[0] if a.server
+                else "127.0.0.1")
         cmd = [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
                "-solver", a.solver, "-output", a.output,
-               "-server", f"127.0.0.1:{port}",
+               "-server", f"{host}:{port}",
                "-cluster", str(a.cluster), "-rank", str(rank)]
         if a.train:
             cmd += ["-train", a.train]
@@ -81,21 +105,45 @@ class Supervisor:
                 pass
         self.procs = []
 
+    def _progress_stamp(self, prefix: str) -> float:
+        """Newest snapshot mtime in the output dir (progress signal for
+        multi-host stall detection); 0 when none."""
+        a = self.args
+        newest = 0.0
+        if os.path.isdir(a.output):
+            for name in os.listdir(a.output):
+                if name.startswith(prefix):
+                    try:
+                        newest = max(newest, os.path.getmtime(
+                            os.path.join(a.output, name)))
+                    except OSError:
+                        pass
+        return newest
+
     def run(self) -> int:
         a = self.args
         from ..proto import read_solver
         prefix = read_solver(a.solver).snapshot_prefix or "model"
+        base_port = a.port
+        if a.server and ":" in a.server:
+            base_port = int(a.server.rsplit(":", 1)[1])
+        local_ranks = list(range(
+            a.rank_base, a.rank_base + (a.local_ranks or a.cluster)))
         attempt = 0
         while True:
             snap = find_latest_snapshot(a.output, prefix)
-            print(f"supervisor: attempt {attempt + 1} from "
+            print(f"supervisor: attempt {attempt + 1} ranks "
+                  f"{local_ranks} from "
                   f"{snap[0] if snap else 'scratch'}", flush=True)
             # fresh coordinator port per attempt (the previous one can
-            # linger in TIME_WAIT after a teardown)
-            self.attempt_port = a.port + attempt
-            self.procs = [self._launch(r, snap)
-                          for r in range(a.cluster)]
+            # linger in TIME_WAIT after a teardown; across hosts the
+            # attempt number keeps independent supervisors converging
+            # on the same coordinator address)
+            self.attempt_port = base_port + attempt
+            self.procs = [self._launch(r, snap) for r in local_ranks]
             failed = False
+            stall_base = time.time()
+            stall_stamp = self._progress_stamp(prefix)
             while True:
                 time.sleep(a.poll_interval)
                 codes = [p.poll() for p in self.procs]
@@ -103,13 +151,27 @@ class Supervisor:
                     print("supervisor: run complete", flush=True)
                     return 0
                 if any(c is not None and c != 0 for c in codes):
-                    dead = [i for i, c in enumerate(codes)
+                    dead = [local_ranks[i] for i, c in enumerate(codes)
                             if c is not None and c != 0]
                     print(f"supervisor: rank(s) {dead} died "
-                          f"(codes {[codes[i] for i in dead]}) — "
-                          "tearing down for relaunch", flush=True)
+                          "— tearing down for relaunch", flush=True)
                     failed = True
                     break
+                if a.stall_timeout:
+                    stamp = self._progress_stamp(prefix)
+                    if stamp > stall_stamp:
+                        stall_stamp, stall_base = stamp, time.time()
+                    elif time.time() - stall_base > a.stall_timeout:
+                        # a remote rank died: local ranks hang in the
+                        # collective instead of dying — treat silence
+                        # as failure so every host's supervisor
+                        # converges on the next attempt
+                        print("supervisor: no progress for "
+                              f"{a.stall_timeout:.0f}s — assuming a "
+                              "remote rank died; tearing down",
+                              flush=True)
+                        failed = True
+                        break
                 # some finished cleanly, others still running: fine
             self._teardown()
             if not failed:
@@ -131,6 +193,18 @@ def main(argv=None) -> int:
     ap.add_argument("-port", type=int, default=47788)
     ap.add_argument("-max_restarts", type=int, default=3)
     ap.add_argument("-poll_interval", type=float, default=1.0)
+    ap.add_argument("-server", default=None,
+                    help="external coordinator HOST[:PORT] (rank-0 "
+                         "host) for multi-host pods; default local")
+    ap.add_argument("-rank_base", type=int, default=0,
+                    help="first global rank hosted here")
+    ap.add_argument("-local_ranks", type=int, default=0,
+                    help="ranks launched on this host "
+                         "(default: all of -cluster)")
+    ap.add_argument("-stall_timeout", type=float, default=0.0,
+                    help="seconds without snapshot progress before "
+                         "assuming a remote-rank failure (0 = off; "
+                         "set on multi-host pods)")
     args, passthrough = ap.parse_known_args(argv)
     if passthrough and passthrough[0] == "--":
         passthrough = passthrough[1:]

@@ -1,0 +1,70 @@
+#!/bin/bash
+# Multi-host TPU pod launch — the analog of the reference's YARN
+# submission (scripts/{yarn,core,hdfs,mapred}-site.xml templates +
+# spark-submit --master yarn) and EC2 bring-up (ec2-cloud-config.txt).
+# See docs/deploy.md for the full mapping.
+#
+# Runs one cos_supervisor per TPU-VM worker over `gcloud ... ssh
+# --worker=all`; worker 0 is the jax.distributed coordinator.  Each
+# supervisor launches that host's rank slice and relaunches from the
+# newest snapshot on shared storage after failures (stall detection
+# covers remote-rank death).
+#
+# Usage:
+#   scripts/launch-tpu-pod.sh TPU_NAME ZONE SOLVER OUTPUT [CLUSTER] \
+#       [RANKS_PER_HOST] [-- extra mini_cluster flags...]
+#
+#   TPU_NAME        TPU VM / pod slice name (e.g. v5e-16-pod)
+#   ZONE            GCE zone (e.g. us-central2-b)
+#   SOLVER          solver prototxt path, visible on every worker
+#                   (bake into the image, or a gs:// path)
+#   OUTPUT          SHARED output dir (gs://bucket/run or NFS mount) —
+#                   snapshots land here; resume-after-failure needs
+#                   every host to see them
+#   CLUSTER         total ranks (default: #workers, 1 rank per host —
+#                   one jax process per host drives all local chips)
+#   RANKS_PER_HOST  default 1
+set -eu
+
+TPU_NAME=$1; ZONE=$2; SOLVER=$3; OUTPUT=$4
+CLUSTER=${5:-}
+RANKS_PER_HOST=${6:-1}
+shift $(( $# >= 6 ? 6 : $# ))
+[ "${1:-}" = "--" ] && shift
+EXTRA="$*"
+PORT=${COS_COORD_PORT:-47788}
+
+# worker 0's internal address = the coordinator every rank dials
+# (MiniCluster's rank-assignment server analog, mini_cluster.cpp:22-43)
+WORKER0_IP=$(gcloud compute tpus tpu-vm describe "$TPU_NAME" \
+    --zone "$ZONE" \
+    --format='value(networkEndpoints[0].ipAddress)')
+N_WORKERS=$(gcloud compute tpus tpu-vm describe "$TPU_NAME" \
+    --zone "$ZONE" \
+    --format='value(networkEndpoints.length())')
+CLUSTER=${CLUSTER:-$N_WORKERS}
+
+echo "pod $TPU_NAME: $N_WORKERS workers, cluster=$CLUSTER," \
+     "coordinator $WORKER0_IP:$PORT"
+
+# one supervisor per worker; WORKER_ID comes from the TPU runtime env
+# on each host.  nohup so the ssh fan-out returns; logs land next to
+# the supervisor on each worker.
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" \
+    --worker=all --command "
+set -eu
+WORKER_ID=\${TPU_WORKER_ID:-0}
+RANK_BASE=\$(( WORKER_ID * $RANKS_PER_HOST ))
+mkdir -p ~/cos_logs
+nohup python -m caffeonspark_tpu.tools.supervisor \
+    -solver '$SOLVER' -output '$OUTPUT' \
+    -cluster $CLUSTER -server $WORKER0_IP:$PORT \
+    -rank_base \$RANK_BASE -local_ranks $RANKS_PER_HOST \
+    -stall_timeout 300 $EXTRA \
+    > ~/cos_logs/supervisor_w\$WORKER_ID.log 2>&1 &
+echo \"worker \$WORKER_ID: supervisor up (ranks \$RANK_BASE..\$(( RANK_BASE + $RANKS_PER_HOST - 1 )))\"
+"
+
+echo "launched. tail logs with:"
+echo "  gcloud compute tpus tpu-vm ssh $TPU_NAME --zone $ZONE" \
+     "--worker=0 --command 'tail -f ~/cos_logs/supervisor_w0.log'"
