@@ -233,6 +233,14 @@ def snapshot(net: Net, params: Params, opt_state: OptState, prefix: str,
     return model_path, state_path
 
 
+_LIVE_SNAPSHOTTERS = None   # lazily-created weakref.WeakSet + atexit hook
+
+
+def _drain_live_snapshotters():
+    for snap in list(_LIVE_SNAPSHOTTERS or ()):
+        snap._drain()
+
+
 class AsyncSnapshotter:
     """Write-behind snapshots (orbax-style async checkpointing).
 
@@ -249,18 +257,42 @@ class AsyncSnapshotter:
         import atexit
         import queue as _q
         import threading
+        import weakref
         self._q: "_q.Queue" = _q.Queue(maxsize=1)
         self._thread: Optional[threading.Thread] = None
         self._last_done: Optional[threading.Event] = None
         self._err: Optional[BaseException] = None
         # interpreter exit must not abandon an in-flight write (the
         # worker is a daemon thread); files themselves are additionally
-        # crash-safe via temp+rename in fsutils
-        atexit.register(self._drain)
+        # crash-safe via temp+rename in fsutils.  ONE module-level hook
+        # over a weakref set — a per-instance atexit.register would pin
+        # every snapshotter alive for the process and stack drain waits
+        global _LIVE_SNAPSHOTTERS
+        if _LIVE_SNAPSHOTTERS is None:
+            _LIVE_SNAPSHOTTERS = weakref.WeakSet()
+            atexit.register(_drain_live_snapshotters)
+        _LIVE_SNAPSHOTTERS.add(self)
 
     def _drain(self):
+        # _last_done is the event of the most recently *enqueued* write
+        # (set in submit before put returns), so this also covers a
+        # snapshot the worker has not picked up yet — the worker is
+        # alive during atexit (daemon threads die after handlers run)
         if self._last_done is not None:
             self._last_done.wait(timeout=120)
+
+    def close(self):
+        """Drain, stop the worker thread, detach from the exit hook —
+        without this a short-lived snapshotter in a long-lived process
+        leaks its thread (whose bound-method target also pins the
+        instance alive in the WeakSet)."""
+        self._drain()
+        if self._thread is not None and self._thread.is_alive():
+            self._q.put((None, None))       # sentinel: worker exits
+            self._thread.join(timeout=10)
+        self._thread = None
+        if _LIVE_SNAPSHOTTERS is not None:
+            _LIVE_SNAPSHOTTERS.discard(self)
 
     def _ensure_thread(self):
         import threading
@@ -272,6 +304,8 @@ class AsyncSnapshotter:
     def _run(self):
         while True:
             fn, done = self._q.get()
+            if fn is None:                  # close() sentinel
+                return
             try:
                 fn()
             except BaseException as e:  # noqa: BLE001 — surfaced later

@@ -1193,9 +1193,12 @@ def _moe(ctx, lp, params, bottoms):
     # before any token's 2nd choice (GShard dispatch order)
     flat_e = topi.T.reshape(-1)                 # (k·N,)
     onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.float32)
-    # position of each assignment within its expert's buffer
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0)
-    pos = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # (k·N,)
+    # position of each assignment within its expert's buffer — int32
+    # accumulation: a float32 cumsum is exact only to 2^24, beyond
+    # which positions silently collide and corrupt capacity accounting
+    ionehot = onehot.astype(jnp.int32)
+    pos = jnp.cumsum(ionehot, axis=0) - 1
+    pos = jnp.sum(pos * ionehot, axis=-1)       # (k·N,) int32
     keep = pos < cap
 
     tokens = jnp.tile(xf, (k, 1))               # (k·N, D) slot-major

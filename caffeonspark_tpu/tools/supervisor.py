@@ -51,23 +51,29 @@ import sys
 import time
 from typing import List, Optional, Tuple
 
+from ..utils import fsutils
+
 
 def find_latest_snapshot(outdir: str, prefix: str
                          ) -> Optional[Tuple[str, str]]:
-    """Newest (state, model) pair `<prefix>_iter_<N>.*` in outdir."""
-    if not os.path.isdir(outdir):
-        return None
+    """Newest (state, model) pair `<prefix>_iter_<N>.*` in outdir.
+
+    Listing goes through fsutils so `-output gs://bucket/run` (the
+    documented multi-host layout, docs/deploy.md) resumes correctly —
+    a plain os.listdir on a remote URL silently found nothing and every
+    relaunch restarted from scratch."""
+    names = set(fsutils.listdir(outdir))
     pat = re.compile(re.escape(prefix) + r"_iter_(\d+)\.solverstate(\.h5)?$")
     best, best_it = None, -1
-    for name in os.listdir(outdir):
+    for name in names:
         m = pat.match(name)
         if not m:
             continue
         it = int(m.group(1))
         model = name.replace(".solverstate", ".caffemodel")
-        if it > best_it and os.path.exists(os.path.join(outdir, model)):
-            best, best_it = (os.path.join(outdir, name),
-                             os.path.join(outdir, model)), it
+        if it > best_it and model in names:
+            best, best_it = (fsutils.join(outdir, name),
+                             fsutils.join(outdir, model)), it
     return best
 
 
@@ -105,20 +111,24 @@ class Supervisor:
                 pass
         self.procs = []
 
-    def _progress_stamp(self, prefix: str) -> float:
-        """Newest snapshot mtime in the output dir (progress signal for
-        multi-host stall detection); 0 when none."""
+    def _progress_stamp(self, prefix: str) -> Tuple[int, int]:
+        """Progress signal for multi-host stall detection: (newest
+        snapshot iteration, snapshot-file count) in the output dir.
+        Content-derived rather than mtime-based so it is monotonic on
+        ANY storage backend — object stores may not expose mtimes, and
+        os.path.getmtime on a gs:// URL always failed, which made the
+        stall timer fire every `-stall_timeout` on a healthy run."""
         a = self.args
-        newest = 0.0
-        if os.path.isdir(a.output):
-            for name in os.listdir(a.output):
-                if name.startswith(prefix):
-                    try:
-                        newest = max(newest, os.path.getmtime(
-                            os.path.join(a.output, name)))
-                    except OSError:
-                        pass
-        return newest
+        pat = re.compile(re.escape(prefix) + r"_iter_(\d+)\.")
+        iters, count = -1, 0
+        for name in fsutils.listdir(a.output):
+            if not name.startswith(prefix):
+                continue
+            count += 1
+            m = pat.match(name)
+            if m:
+                iters = max(iters, int(m.group(1)))
+        return (iters, count)
 
     def run(self) -> int:
         a = self.args

@@ -67,6 +67,50 @@ def makedirs(path: str) -> None:
         os.makedirs(strip_local(path), exist_ok=True)
 
 
+def listdir(path: str) -> list:
+    """Entry basenames in a directory; [] when the directory is missing.
+    Works on any fsspec scheme — the supervisor's snapshot discovery and
+    stall detection go through here so `-output gs://bucket/run` behaves
+    like a local dir (FSUtils.scala:21-89 analog surface)."""
+    if is_remote(path):
+        fs, p = _fs(path)
+        # fsspec caches both filesystem instances and their dircache —
+        # a supervisor polling for new snapshots written by OTHER
+        # processes would otherwise see a frozen listing forever
+        try:
+            fs.invalidate_cache(p)
+        except Exception:  # noqa: BLE001 — backend-specific, optional
+            pass
+        if not fs.exists(p):
+            return []
+        return [posixpath.basename(e.rstrip("/"))
+                for e in fs.ls(p, detail=False)]
+    p = strip_local(path)
+    return os.listdir(p) if os.path.isdir(p) else []
+
+
+def getmtime(path: str) -> float:
+    """Modification time, best-effort on remote schemes (object stores
+    report LastModified/mtime under different keys; 0.0 when the backend
+    exposes none — callers needing a monotonic progress signal should
+    prefer content-derived stamps, see tools/supervisor.py)."""
+    if not is_remote(path):
+        return os.path.getmtime(strip_local(path))
+    fs, p = _fs(path)
+    info = fs.info(p)
+    for key in ("mtime", "LastModified", "last_modified", "created"):
+        v = info.get(key)
+        if v is None:
+            continue
+        if hasattr(v, "timestamp"):
+            return v.timestamp()
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            continue
+    return 0.0
+
+
 def open_file(path: str, mode: str = "rb"):
     if is_remote(path):
         import fsspec

@@ -109,3 +109,30 @@ def test_dataframe_remote_write():
             fsutils.read_bytes("memory://out/validation.json")
             .decode().splitlines()]
     assert rows == [{"accuracy": 0.9, "loss": 0.1}]
+
+
+def test_listdir_local_and_remote(tmp_path):
+    _clear_memfs()
+    assert fsutils.listdir(str(tmp_path / "missing")) == []
+    assert fsutils.listdir("memory://no-such-dir") == []
+    (tmp_path / "a.bin").write_bytes(b"x")
+    (tmp_path / "b.bin").write_bytes(b"y")
+    assert sorted(fsutils.listdir(str(tmp_path))) == ["a.bin", "b.bin"]
+    fsutils.write_bytes("memory://ld/one", b"1")
+    fsutils.write_bytes("memory://ld/two", b"2")
+    # second call must see files added after the first (dircache
+    # invalidation — the supervisor polls this in a loop)
+    assert sorted(fsutils.listdir("memory://ld")) == ["one", "two"]
+    fsutils.write_bytes("memory://ld/three", b"3")
+    assert sorted(fsutils.listdir("memory://ld")) == [
+        "one", "three", "two"]
+
+
+def test_getmtime_local_and_remote(tmp_path):
+    p = tmp_path / "f.bin"
+    p.write_bytes(b"x")
+    assert fsutils.getmtime(str(p)) > 0
+    _clear_memfs()
+    fsutils.write_bytes("memory://mt/f", b"x")
+    # memory backend exposes created-time; any non-negative float is ok
+    assert fsutils.getmtime("memory://mt/f") >= 0.0
