@@ -5,15 +5,27 @@ step (forward + backward + SGD momentum update) on bvlc_reference_net
 at batch 256 / 227x227x3 on whatever single chip is available, and
 reports images/sec plus MFU against the chip's bf16 peak.
 
-HARNESS CONTRACT (round 3 — the driver must always get a number):
-  * Every backend-touching phase runs in a SUBPROCESS with a hard
-    timeout; on expiry the whole process group is SIGKILLed.  The
-    known axon-tunnel failure mode is jax.devices() hanging for tens
-    of minutes (BENCH_r02.json: one init attempt spanned ~25 min) —
-    an in-process retry loop cannot bound that; a subprocess can.
-  * The parent ALWAYS prints exactly one JSON line on stdout: on
-    success the worker's measurement, on failure
-    {metric, value: 0, error, attempts: [per-attempt rc/seconds/tail]}.
+HARNESS CONTRACT (round 4 — fight for a number until the deadline):
+  * ONE combined worker per attempt: it initializes the backend, runs
+    a tiny forced-sync matmul, prints a `{"phase": "probe", ...}`
+    marker line, then runs the full measurement IN THE SAME PROCESS —
+    a successful tunnel init is never thrown away (round 3 ran probe
+    and bench in separate subprocesses, so the tunnel had to come up
+    twice per number).
+  * The worker runs in its own process group with the parent reading
+    stdout incrementally; the probe marker gets an escalating budget
+    (90 -> 180 -> 300 s per attempt), and once it appears the attempt
+    is granted the full run timeout.  The known axon-tunnel failure
+    mode is jax.devices() hanging for tens of minutes (BENCH_r02: one
+    init spanned ~25 min) — only a SIGKILLed subprocess bounds that.
+  * Attempts repeat until `remaining() < 60` — the whole deadline is
+    spent hunting, not a fixed retry count (BENCH_r03 retired with
+    ~half its 780 s budget unspent; that is the one unforgivable
+    failure mode for this harness).
+  * The parent ALWAYS prints exactly one final JSON line: on success
+    the worker's measurement, on failure {metric, value: 0, error,
+    attempts: [...], claimed: {builder-reported numbers + env
+    fingerprint}} so the artifact carries the full context.
   * A global deadline (default 780 s) bounds total runtime so the
     driver's timeout can never produce rc=124 with no output.
 
@@ -59,10 +71,12 @@ Env knobs:
   BENCH_SMOKE=1      tiny-shape backend liveness probe only: separates
                      "tunnel up" from "CaffeNet compiles"
   BENCH_PEAK_TFLOPS  chip bf16 peak for MFU (default 197 = TPU v5e)
-  BENCH_RETRIES      liveness-probe attempts (default 4)
-  BENCH_INIT_TIMEOUT per-probe hard timeout seconds (default 90)
-  BENCH_RUN_TIMEOUT  full-bench hard timeout seconds (default 420)
+  BENCH_INIT_TIMEOUT first-attempt probe timeout seconds (default 90;
+                     escalates 2x then 300 s cap on later attempts)
+  BENCH_RUN_TIMEOUT  post-probe measurement timeout seconds (default 420)
   BENCH_DEADLINE     global wall-clock budget seconds (default 780)
+  BENCH_EVIDENCE_DIR where successful runs drop raw evidence bundles
+                     (default bench_evidence/ next to this file)
 
 vs_baseline: the reference repo publishes no throughput numbers
 (BASELINE.md); the ratio anchors to ~150 img/s, the commonly cited
@@ -96,42 +110,147 @@ def _metric_name():
     return f"{model}_imagenet_train_images_per_sec_per_chip"
 
 
-def _run_worker(mode, timeout):
-    """Run `python bench.py --worker <mode>` in its own process group
-    with a hard timeout; SIGKILL the group on expiry.  Returns
-    (rc, seconds, output_text); rc -9/'timeout' on kill."""
-    t0 = time.monotonic()
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--worker", mode],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        start_new_session=True, text=True)
-    timed_out = False
-    try:
-        out, _ = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        timed_out = True
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            proc.kill()
-        out, _ = proc.communicate()
-    return (("timeout" if timed_out else proc.returncode),
-            time.monotonic() - t0, out or "")
+class _Worker:
+    """`python bench.py --worker <mode>` in its own process group with
+    stdout streamed into the parent, so the parent can see the probe
+    marker the moment the tunnel comes up and only then grant the full
+    measurement budget.  SIGKILLs the whole group on kill()."""
 
+    def __init__(self, mode):
+        import threading
+        self.t0 = time.monotonic()
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker", mode],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True, text=True)
+        self._lines = []
+        self._lock = threading.Lock()
+        self._reader = threading.Thread(target=self._read, daemon=True)
+        self._reader.start()
 
-def _last_json(text):
-    for line in reversed(text.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
+    def _read(self):
+        for line in self.proc.stdout:
+            with self._lock:
+                self._lines.append(line.rstrip("\n"))
+
+    def text(self):
+        with self._lock:
+            return "\n".join(self._lines)
+
+    def parsed_lines(self):
+        with self._lock:
+            lines = list(self._lines)
+        out = []
+        for line in lines:
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+        return out
+
+    def wait_json(self, pred, timeout):
+        """Poll until some stdout line parses as JSON matching pred;
+        returns the parsed object or None on timeout/exit."""
+        end = time.monotonic() + timeout
+        seen = 0
+        while time.monotonic() < end:
+            with self._lock:
+                lines, seen = self._lines[seen:], len(self._lines)
+            for line in lines:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if pred(obj):
+                    return obj
+            if self.proc.poll() is not None:
+                # flush any straggler lines after exit
+                self._reader.join(timeout=2)
+                with self._lock:
+                    tail_new = self._lines[seen:]
+                for line in tail_new:
+                    line = line.strip()
+                    if line.startswith("{"):
+                        try:
+                            obj = json.loads(line)
+                            if pred(obj):
+                                return obj
+                        except json.JSONDecodeError:
+                            pass
+                return None
+            time.sleep(0.25)
+        return None
+
+    def kill(self):
+        if self.proc.poll() is None:
             try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                continue
-    return None
+                os.killpg(self.proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                self.proc.kill()
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+
+    @property
+    def seconds(self):
+        return time.monotonic() - self.t0
+
 
 
 def _tail(text, n=600):
     return text[-n:] if text else ""
+
+
+#: docs/benchmarks.md table, builder-reported — embedded in the failure
+#: record so a tunnel-down round still carries the claimed numbers and
+#: where their raw evidence lives (VERDICT r3 ask #1).
+_CLAIMED = {
+    "source": "docs/benchmarks.md + bench_evidence/ (builder-reported; "
+              "not driver-verified when this block appears)",
+    "caffenet_imagenet_train_images_per_sec_per_chip": {
+        "batch": 256, "dtype": "mixed", "value": 17322, "mfu": 0.382},
+    "caffenet_b64_f32_reference_shape": {"value": 8518, "mfu": 0.188},
+    "caffenet_imagenet_forward_images_per_sec_per_chip": {
+        "batch": 256, "dtype": "mixed", "value": 45383, "mfu": 0.334},
+    "resnet50_imagenet_train_images_per_sec_per_chip": {
+        "batch": 64, "dtype": "mixed", "value": 2163, "mfu": 0.254},
+    "lenet_mnist_onchip_test_accuracy": 0.9926,
+}
+
+
+def _env_fingerprint():
+    import platform
+    fp = {"python": platform.python_version(),
+          "hostname": platform.node(),
+          "machine": platform.machine(),
+          "pallas_axon_pool": bool(os.environ.get("PALLAS_AXON_POOL_IPS")),
+          "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    try:  # metadata only — does NOT init a jax backend / dial the tunnel
+        from importlib.metadata import version
+        fp["jax"] = version("jax")
+        fp["jaxlib"] = version("jaxlib")
+    except Exception:
+        pass
+    return fp
+
+
+def _claimed_block():
+    import glob
+    block = dict(_CLAIMED)
+    evdir = os.environ.get(
+        "BENCH_EVIDENCE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "bench_evidence"))
+    block["evidence_bundles"] = sorted(
+        os.path.basename(p) for p in glob.glob(os.path.join(evdir, "*.json")))
+    block["env"] = _env_fingerprint()
+    return block
 
 
 def main():
@@ -139,7 +258,6 @@ def main():
     deadline = float(os.environ.get("BENCH_DEADLINE", "780"))
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "90"))
     run_timeout = float(os.environ.get("BENCH_RUN_TIMEOUT", "420"))
-    retries = int(os.environ.get("BENCH_RETRIES", "4"))
     smoke_only = os.environ.get("BENCH_SMOKE") == "1"
 
     def remaining():
@@ -153,60 +271,112 @@ def main():
             "unit": "ms" if smoke_only else "images/sec",
             "vs_baseline": 0.0, "error": error,
             "attempts": attempts,
+            "claimed": _claimed_block(),
         }))
         sys.exit(1)
 
-    # Phase 1: backend liveness probe (tiny matmul, forced sync).
-    # Cheap (~seconds when the tunnel is healthy), hard-killed at
-    # init_timeout when it wedges inside jax.devices().
-    probe = None
-    for attempt in range(retries):
-        budget = min(init_timeout, remaining())
-        if budget < 20:
-            fail("deadline exhausted during backend liveness probes")
-        rc, secs, out = _run_worker("smoke", budget)
-        parsed = _last_json(out)
-        attempts.append({"phase": "probe", "rc": rc,
-                         "seconds": round(secs, 1),
-                         "tail": _tail(out, 300)})
-        if rc == 0 and parsed is not None:
-            probe = parsed
-            break
-        backoff = min(5.0 * (2 ** attempt), max(0.0, remaining() - 30))
-        if attempt < retries - 1 and backoff > 0:
-            print(f"bench: probe attempt {attempt + 1}/{retries} failed "
-                  f"(rc={rc}, {secs:.0f}s); retrying in {backoff:.0f}s",
-                  file=sys.stderr)
-            time.sleep(backoff)
-    if probe is None:
-        fail(f"TPU backend failed liveness probe {retries}x "
-             "(known axon-tunnel wedge at init; see attempts[].tail)")
-    if smoke_only:
-        print(json.dumps(probe))
-        return
+    mode = "smoke" if smoke_only else "bench"
+    attempt = 0
+    bench_failures = 0      # deterministic failures (worker crashes,
+    #                         post-probe errors) are code bugs, not the
+    #                         tunnel — capped; probe TIMEOUTS retry
+    #                         until the deadline runs dry
+    while remaining() >= 60:
+        # escalating probe budget: a wedged init dies fast early, and
+        # later attempts give a slow-to-wake tunnel progressively more
+        # room (90 -> 180 -> 300 s, VERDICT r3 prescription)
+        probe_budget = min(init_timeout * (2 ** min(attempt, 2)),
+                           300.0, max(20.0, remaining() - 30))
+        w = _Worker(mode)
+        probe = w.wait_json(
+            lambda o: o.get("phase") == "probe" or "metric" in o,
+            probe_budget)
+        if probe is None:
+            rc_now = w.proc.poll()   # before kill: None = hung (tunnel
+            #                          wedge), int = worker crashed
+            w.kill()
+            attempts.append({"phase": "probe",
+                             "rc": "timeout" if rc_now is None else rc_now,
+                             "seconds": round(w.seconds, 1),
+                             "budget": round(probe_budget, 1),
+                             "tail": _tail(w.text(), 300)})
+            print(f"bench: attempt {attempt + 1} no backend after "
+                  f"{w.seconds:.0f}s (budget {probe_budget:.0f}s, "
+                  f"{remaining():.0f}s left); retrying", file=sys.stderr)
+            if rc_now is not None:
+                # a clean exit is deterministic (import error, broken
+                # config) — the deadline-long hunt is for tunnel WEDGES;
+                # three identical crashes won't become a number
+                bench_failures += 1
+                if bench_failures >= 3:
+                    fail("worker crashed 3x before backend init — "
+                         "deterministic failure, not the tunnel "
+                         "(see attempts[].tail)")
+            attempt += 1
+            time.sleep(min(5.0, max(0.0, remaining() - 60)))
+            continue
 
-    # Phase 2: the real measurement, also subprocess-bounded.  One
-    # retry if the budget allows (compile cache makes retry cheaper).
-    for _ in range(2):
-        budget = min(run_timeout, remaining())
-        if budget < 60:
-            fail("deadline exhausted before measurement "
-                 "(probes consumed the budget)")
-        rc, secs, out = _run_worker("bench", budget)
-        parsed = _last_json(out)
-        attempts.append({"phase": "bench", "rc": rc,
-                         "seconds": round(secs, 1),
-                         "tail": _tail(out)})
-        if parsed is not None and "metric" in parsed:
-            # a valid record printed before a late kill (e.g. the
-            # pipeline host-scaling sweep overrunning) still counts —
-            # the measurement itself completed
-            if rc != 0:
-                parsed["partial"] = True
-            print(json.dumps(parsed))
+        if smoke_only:
+            final = probe if "metric" in probe else w.wait_json(
+                lambda o: "metric" in o, min(30.0, remaining()))
+            w.kill()
+            if final is not None:
+                print(json.dumps(final))
+                return
+            attempts.append({"phase": "smoke", "rc": "no-record",
+                             "seconds": round(w.seconds, 1),
+                             "tail": _tail(w.text(), 300)})
+            attempt += 1
+            continue
+
+        # tunnel is up in THIS worker — grant the measurement budget to
+        # the same process (init is never thrown away).  Preliminary
+        # records (the pipeline path prints one before its host-scaling
+        # sweep) don't end the wait; they are the timeout fallback.
+        final = w.wait_json(
+            lambda o: "metric" in o and not o.get("preliminary"),
+            min(run_timeout, max(30.0, remaining() - 5)))
+        if final is not None:
+            # let the worker finish its evidence-bundle write and exit
+            # on its own — a SIGKILL racing the bundle json.dump would
+            # truncate committed evidence
+            try:
+                w.proc.wait(timeout=min(30.0, max(5.0, remaining() - 5)))
+            except subprocess.TimeoutExpired:
+                pass
+        rc_after = w.proc.poll()
+        if final is None:
+            # timed out waiting for the full record: a preliminary one
+            # that did arrive still counts as a partial measurement
+            final = next((o for o in w.parsed_lines()
+                          if "metric" in o), None)
+            if final is not None:
+                final["partial"] = True
+        w.kill()
+        if final is not None:
+            if rc_after not in (0, None):
+                final["partial"] = True
+            final.pop("preliminary", None)
+            final["probe"] = {k: probe[k] for k in ("value", "chip")
+                              if k in probe}
+            print(json.dumps(final))
             return
-    fail("measurement subprocess failed twice after a healthy probe "
-         "(see attempts[].tail)")
+        attempts.append({"phase": "bench", "rc": rc_after
+                         if rc_after is not None else "timeout",
+                         "seconds": round(w.seconds, 1),
+                         "tail": _tail(w.text())})
+        if rc_after is not None:
+            # post-probe CRASH is deterministic; a post-probe TIMEOUT
+            # may be a mid-run tunnel stall and keeps hunting
+            bench_failures += 1
+            if bench_failures >= 3:
+                fail("worker failed deterministically 3x "
+                     "(see attempts[].tail)")
+        attempt += 1
+
+    fail(f"deadline exhausted: {len(attempts)} distinct backend init "
+         "attempts, none produced a record (known axon-tunnel wedge; "
+         "see attempts[].tail and claimed)")
 
 
 # --------------------------------------------------------------------
@@ -285,6 +455,66 @@ def _host_pipeline_scaling(batch, dshape, tmpdir, threads_list,
     return out
 
 
+def _emit_record(metric, ips, flops_step, iters, dt, batch, precision,
+                 chip, extra):
+    """Compute MFU, refuse impossible numbers, print the JSON record.
+    Callable more than once per worker (the pipeline path prints before
+    and after its host-scaling sweep; the parent takes the last line)."""
+    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+    tflops = flops_step * iters / dt / 1e12
+    mfu = tflops / peak_tflops
+    if mfu > 1.0:
+        print(f"bench: ERROR implied {tflops:.0f} TFLOP/s exceeds chip "
+              f"peak {peak_tflops:.0f} — timing is broken, refusing to "
+              "report", file=sys.stderr)
+        sys.exit(1)
+    rec = {
+        "metric": metric,
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / 150.0, 3),
+        "mfu": round(mfu, 4),
+        "model_tflops_per_sec": round(tflops, 2),
+        "flops_per_step": flops_step,
+        "batch": batch, "iters": iters,
+        "precision": precision, "chip": chip,
+    }
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def _write_evidence(rec, timing):
+    """Raw evidence bundle for every successful on-chip measurement
+    (VERDICT r3 ask #2): env fingerprint + exact knobs + timings, named
+    by timestamp+config, committed for audit.  Failure to write must
+    never kill a successful measurement."""
+    try:
+        explicit = os.environ.get("BENCH_EVIDENCE_DIR")
+        if explicit is None and "cpu" in rec.get("chip", "").lower():
+            return   # CPU harness checks must not pollute the committed
+            #          on-chip evidence directory
+        evdir = explicit or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_evidence")
+        os.makedirs(evdir, exist_ok=True)
+        knobs = {k: v for k, v in sorted(os.environ.items())
+                 if k.startswith(("BENCH_", "COS_", "JAX_"))}
+        bundle = {"record": rec, "timing": timing, "env_knobs": knobs,
+                  "env": _env_fingerprint()}
+        ts = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+        cfg = "-".join(str(x) for x in (
+            rec.get("metric", "bench"), "b%s" % rec.get("batch", "?"),
+            os.environ.get("BENCH_DTYPE", "mixed")))
+        path = os.path.join(evdir, f"{ts}-{cfg}.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:           # temp+rename: a kill racing
+            json.dump(bundle, f, indent=1)  # this write can never leave
+        os.replace(tmp, path)               # a truncated bundle
+        print(f"bench: evidence bundle {path}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: evidence write failed: {e}", file=sys.stderr)
+
+
 def worker(mode):
     import jax
     import jax.numpy as jnp
@@ -309,17 +539,24 @@ def worker(mode):
     devs = jax.devices()
     chip = str(devs[0])
 
+    # liveness probe in-process: tiny forced-sync matmul.  In "bench"
+    # mode this doubles as the probe MARKER the parent is polling for —
+    # the same process then proceeds to the measurement, so a
+    # successful tunnel init is never discarded.
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    t0 = time.perf_counter()
+    v = _sync(jax.jit(lambda a: (a @ a).sum())(x))
+    probe_ms = (time.perf_counter() - t0) * 1e3
+
     if mode == "smoke":
-        x = jnp.ones((256, 256), jnp.bfloat16)
-        t0 = time.perf_counter()
-        v = _sync(jax.jit(lambda a: (a @ a).sum())(x))
-        dt = time.perf_counter() - t0
         print(json.dumps({
             "metric": "backend_smoke_roundtrip_ms",
-            "value": round(dt * 1e3, 2), "unit": "ms",
+            "value": round(probe_ms, 2), "unit": "ms",
             "vs_baseline": 1.0, "chip": chip,
             "result": float(v)}))
         return
+    print(json.dumps({"phase": "probe", "value": round(probe_ms, 2),
+                      "unit": "ms", "chip": chip}), flush=True)
 
     model = os.environ.get("BENCH_MODEL", "caffenet")
     default_batch = {"caffenet": 256, "resnet50": 64, "vgg16": 64,
@@ -328,7 +565,6 @@ def worker(mode):
     iters = int(os.environ.get("BENCH_ITERS", "50"))
     pipeline = os.environ.get("BENCH_PIPELINE") == "1"
     forward_only = os.environ.get("BENCH_FORWARD") == "1"
-    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
 
     from caffeonspark_tpu.proto import SolverParameter, read_net
     from caffeonspark_tpu.solver import Solver
@@ -352,11 +588,11 @@ def worker(mode):
         "base_lr: 0.001 momentum: 0.9 weight_decay: 0.0005 "
         "lr_policy: 'step' gamma: 0.1 stepsize: 100000 max_iter: 450000 "
         "random_seed: 1")
-    dt = os.environ.get("BENCH_DTYPE", "mixed")
+    dts = os.environ.get("BENCH_DTYPE", "mixed")
     dtype_kw = {}
-    if dt == "mixed":
+    if dts == "mixed":
         dtype_kw = dict(dtype=jnp.float32, compute_dtype=jnp.bfloat16)
-    elif dt == "bfloat16":
+    elif dts == "bfloat16":
         dtype_kw = dict(dtype=jnp.bfloat16)
     solver = Solver(sp, npm, **dtype_kw)
     params, st = solver.init()
@@ -370,6 +606,7 @@ def worker(mode):
     label = jnp.asarray(rng.randint(0, 1000, batch).astype(np.float32))
     fixed = {"data": data, "label": label}
     extra = {}
+    timing = {"probe_roundtrip_ms": round(probe_ms, 2)}
 
     if forward_only:
         # the features()/test() path: jitted forward, batches chained
@@ -391,8 +628,11 @@ def worker(mode):
 
         import functools
         runf = jax.jit(functools.partial(run_fwd, n=iters))
+        t0 = time.perf_counter()
         tot, losses = runf(params, fixed)
         _sync(tot)
+        timing["warmup_compile_seconds"] = round(
+            time.perf_counter() - t0, 3)
         t0 = time.perf_counter()
         tot, losses = runf(params, fixed)
         _sync(tot)
@@ -417,6 +657,15 @@ def worker(mode):
             _sync(out["loss"])
             dt = time.perf_counter() - t0
             ips = batch * iters / dt
+            metric = (f"{model}_imagenet_train_images_per_sec"
+                      "_per_chip_pipeline")
+            # print the throughput record BEFORE the host-scaling sweep:
+            # if the sweep overruns the worker's hard timeout, the
+            # completed measurement must survive.  Marked preliminary so
+            # the parent keeps waiting for the full record and only
+            # falls back to this one on a timeout.
+            _emit_record(metric, ips, flops_step, iters, dt, batch,
+                         precision, chip, {"preliminary": True})
             # host-side decode+transform scaling: how many cores does
             # it take to feed the chip at the on-chip rate?
             ncpu = os.cpu_count() or 1
@@ -427,7 +676,6 @@ def worker(mode):
                 "host_cores": ncpu,
                 "decode_transform_img_per_sec_by_threads": scaling,
             }
-        metric = f"{model}_imagenet_train_images_per_sec_per_chip_pipeline"
     else:
         # ON-DEVICE loop: lax.scan over the chained train step, one
         # dispatch + one forced sync — measures the chip, not the tunnel
@@ -444,38 +692,35 @@ def worker(mode):
         runj = jax.jit(run, donate_argnums=(0, 1))
         rngs = jnp.stack([solver.step_rng(i) for i in range(iters)])
         # warmup/compile pass
-        params, st, losses = runj(params, st, fixed, rngs)
-        _sync(losses)
         t0 = time.perf_counter()
         params, st, losses = runj(params, st, fixed, rngs)
-        final = _sync(losses)
-        dt = time.perf_counter() - t0
+        _sync(losses)
+        timing["warmup_compile_seconds"] = round(
+            time.perf_counter() - t0, 3)
+        # 3 timed repeats: the first is the headline (methodology
+        # unchanged vs earlier rounds); all go into the evidence bundle
+        # so internal consistency is auditable
+        repeats = []
+        final = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            params, st, losses = runj(params, st, fixed, rngs)
+            final = _sync(losses)
+            repeats.append(time.perf_counter() - t0)
+        dt = repeats[0]
+        timing["timed_repeat_seconds"] = [round(r, 4) for r in repeats]
+        timing["losses_tail"] = [float(x) for x in final[-3:]]
         if not np.all(np.isfinite(final)):
             print(f"bench: WARNING non-finite losses: {final[-3:]}",
                   file=sys.stderr)
         ips = batch * iters / dt
         metric = f"{model}_imagenet_train_images_per_sec_per_chip"
 
-    tflops = flops_step * iters / dt / 1e12
-    mfu = tflops / peak_tflops
-    if mfu > 1.0:
-        print(f"bench: ERROR implied {tflops:.0f} TFLOP/s exceeds chip "
-              f"peak {peak_tflops:.0f} — timing is broken, refusing to "
-              "report", file=sys.stderr)
-        sys.exit(1)
-    rec = {
-        "metric": metric,
-        "value": round(ips, 2),
-        "unit": "images/sec",
-        "vs_baseline": round(ips / 150.0, 3),
-        "mfu": round(mfu, 4),
-        "model_tflops_per_sec": round(tflops, 2),
-        "flops_per_step": flops_step,
-        "batch": batch, "iters": iters,
-        "precision": precision, "chip": chip,
-    }
-    rec.update(extra)
-    print(json.dumps(rec))
+    timing["timed_seconds"] = round(dt, 4)
+    timing["iters"] = iters
+    rec = _emit_record(metric, ips, flops_step, iters, dt, batch,
+                       precision, chip, extra)
+    _write_evidence(rec, timing)
 
 
 if __name__ == "__main__":

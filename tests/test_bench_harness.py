@@ -1,0 +1,58 @@
+"""bench.py parent-orchestrator contract (round 4).
+
+The harness's whole reason to exist is: the driver ALWAYS gets exactly
+one JSON line, and the deadline is spent hunting when the backend
+wedges.  These tests drive `python bench.py` as a subprocess — the real
+surface the driver runs — never the in-process pytest backend.
+Reference perf-harness analog:
+/root/reference/caffe-distri/src/test/java/com/yahoo/ml/jcaffe/PerfTest.java:69-118
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(env_overrides, timeout):
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}   # never dial the tunnel
+    env.update(env_overrides)
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=timeout, env=env)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    assert lines, f"no JSON on stdout: {proc.stdout!r} {proc.stderr!r}"
+    return proc.returncode, json.loads(lines[-1])
+
+
+@pytest.mark.slow
+def test_smoke_emits_one_record_cpu():
+    rc, rec = _run({"JAX_PLATFORMS": "cpu", "BENCH_SMOKE": "1",
+                    "BENCH_DEADLINE": "240"}, timeout=260)
+    assert rc == 0
+    assert rec["metric"] == "backend_smoke_roundtrip_ms"
+    assert rec["value"] > 0
+
+
+@pytest.mark.slow
+def test_crashing_worker_fails_fast_with_claimed_block(tmp_path):
+    # an unknown platform makes the worker exit nonzero immediately —
+    # the parent must bail after the crash cap (not churn the full
+    # deadline, not hang) and emit the claimed/ env-fingerprint block
+    rc, rec = _run({"JAX_PLATFORMS": "no_such_platform",
+                    "BENCH_DEADLINE": "600",
+                    "BENCH_EVIDENCE_DIR": str(tmp_path)}, timeout=300)
+    assert rc == 1
+    assert rec["value"] == 0.0
+    assert rec["attempts"], "failure record must carry the attempt log"
+    assert all(a["rc"] != "timeout" for a in rec["attempts"])
+    assert rec["claimed"]["env"]["jax"]
+    assert "caffenet_imagenet_train_images_per_sec_per_chip" \
+        in rec["claimed"]
