@@ -6,7 +6,8 @@ PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build native install test bench smoke docs clean
+.PHONY: build native install test bench smoke tpu-tests bench-evidence \
+  docs clean
 
 build: native install
 
@@ -24,6 +25,20 @@ bench:
 
 smoke:
 	BENCH_SMOKE=1 $(PY) bench.py
+
+# on-chip gated test leg with an always-written JSON artifact
+tpu-tests:
+	$(PY) tpu_tests.py
+
+# refresh the committed raw evidence bundles: one bench run per
+# headline docs/benchmarks.md row (needs a live TPU backend)
+# rows are independent: `-` keeps one tunnel-down row from blocking
+# the rest
+bench-evidence:
+	-$(PY) bench.py
+	-BENCH_BATCH=64 BENCH_DTYPE=float32 $(PY) bench.py
+	-BENCH_FORWARD=1 $(PY) bench.py
+	-BENCH_MODEL=resnet50 $(PY) bench.py
 
 docs:
 	$(PY) docs/gen_html.py

@@ -150,6 +150,20 @@ class _Worker:
                     pass
         return out
 
+    @staticmethod
+    def _first_match(lines, pred):
+        for line in lines:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if pred(obj):
+                return obj
+        return None
+
     def wait_json(self, pred, timeout):
         """Poll until some stdout line parses as JSON matching pred;
         returns the parsed object or None on timeout/exit."""
@@ -158,31 +172,15 @@ class _Worker:
         while time.monotonic() < end:
             with self._lock:
                 lines, seen = self._lines[seen:], len(self._lines)
-            for line in lines:
-                line = line.strip()
-                if not line.startswith("{"):
-                    continue
-                try:
-                    obj = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if pred(obj):
-                    return obj
+            obj = self._first_match(lines, pred)
+            if obj is not None:
+                return obj
             if self.proc.poll() is not None:
                 # flush any straggler lines after exit
                 self._reader.join(timeout=2)
                 with self._lock:
                     tail_new = self._lines[seen:]
-                for line in tail_new:
-                    line = line.strip()
-                    if line.startswith("{"):
-                        try:
-                            obj = json.loads(line)
-                            if pred(obj):
-                                return obj
-                        except json.JSONDecodeError:
-                            pass
-                return None
+                return self._first_match(tail_new, pred)
             time.sleep(0.25)
         return None
 
@@ -346,10 +344,14 @@ def main():
                 pass
         rc_after = w.proc.poll()
         if final is None:
-            # timed out waiting for the full record: a preliminary one
-            # that did arrive still counts as a partial measurement
-            final = next((o for o in w.parsed_lines()
-                          if "metric" in o), None)
+            # timed out waiting for the full record: prefer the newest
+            # complete record that may have landed right after the wait
+            # expired, else the newest preliminary one — either way a
+            # partial measurement beats none
+            recs = [o for o in w.parsed_lines() if "metric" in o]
+            final = next((o for o in reversed(recs)
+                          if not o.get("preliminary")), None) \
+                or (recs[-1] if recs else None)
             if final is not None:
                 final["partial"] = True
         w.kill()

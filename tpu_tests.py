@@ -1,0 +1,165 @@
+"""On-chip test runner: make the TPU-gated test leg driver-capturable.
+
+The 5 gated tests (tests/test_pallas_tpu.py — Pallas LRN fwd+VJP parity
+on the real compiler; tests/test_tpu_train.py — LSTM + transformer
+train steps on chip) skip silently without COS_TPU_TESTS=1 and used to
+leave no artifact when they did run.  This runner applies the same
+contract as bench.py (round 3/4): every backend-touching phase runs in
+a SIGKILL-bounded subprocess, attempts escalate until the deadline is
+spent, and an artifact JSON is ALWAYS written — pass, fail, or
+tunnel-down — with per-test outcomes and output tails.
+
+    python tpu_tests.py                # writes TPU_TESTS_r04.json
+    TPU_TESTS_OUT=foo.json python tpu_tests.py
+
+Env knobs:
+  TPU_TESTS_OUT       artifact path (default TPU_TESTS_r04.json)
+  TPU_TESTS_DEADLINE  global wall-clock budget seconds (default 600)
+  TPU_TESTS_TIMEOUT   first-attempt timeout seconds (default 240;
+                      escalates 1.5x per attempt) — the suite needs
+                      compile time (~20-40s/model first run) ON TOP of
+                      tunnel init, so attempts start roomier than
+                      bench's probes
+
+Exit code 0 iff every test passed.  Reference analog: the reference
+runs its on-device leg inside `mvn test` (CaffeNetTest.java) and CI
+records the surefire report; this is that report for the TPU leg.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import xml.etree.ElementTree as ET
+
+TEST_FILES = ["tests/test_pallas_tpu.py", "tests/test_tpu_train.py"]
+
+
+# shared with the bench harness (side-effect-free import): keeps the
+# fingerprint fields — notably pallas_axon_pool, the bit that separates
+# "tunnel env absent" from "tunnel wedged" — from drifting
+from bench import _env_fingerprint  # noqa: E402
+
+
+def _parse_junit(path):
+    """junitxml -> [{name, outcome, seconds, message?}]"""
+    tests = []
+    root = ET.parse(path).getroot()
+    for case in root.iter("testcase"):
+        name = f"{case.get('classname', '')}::{case.get('name', '')}"
+        rec = {"name": name,
+               "seconds": round(float(case.get("time", 0.0)), 2)}
+        child = next(iter(case), None)
+        if child is None:
+            rec["outcome"] = "passed"
+        else:
+            rec["outcome"] = {"failure": "failed", "error": "error",
+                              "skipped": "skipped"}.get(child.tag,
+                                                        child.tag)
+            rec["message"] = (child.get("message") or "")[:400]
+        tests.append(rec)
+    return tests
+
+
+def main():
+    t_start = time.monotonic()
+    deadline = float(os.environ.get("TPU_TESTS_DEADLINE", "600"))
+    base_timeout = float(os.environ.get("TPU_TESTS_TIMEOUT", "240"))
+    out_path = os.environ.get("TPU_TESTS_OUT", "TPU_TESTS_r04.json")
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def remaining():
+        return deadline - (time.monotonic() - t_start)
+
+    attempts = []
+    result = {"ok": False, "tests": [], "attempts": attempts,
+              "env": _env_fingerprint()}
+
+    def emit(error=None):
+        if error:
+            result["error"] = error
+        tmp = f"{out_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=1)
+        os.replace(tmp, out_path)
+        print(json.dumps({"artifact": out_path, "ok": result["ok"],
+                          "tests": len(result["tests"]),
+                          "error": error}))
+        sys.exit(0 if result["ok"] else 1)
+
+    attempt = 0
+    while remaining() >= 45:
+        budget = min(base_timeout * (1.5 ** attempt), 420.0,
+                     max(30.0, remaining() - 10))
+        junit = os.path.join(repo, f".tpu_tests_{os.getpid()}.xml")
+        env = dict(os.environ, COS_TPU_TESTS="1")
+        t0 = time.monotonic()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pytest", *TEST_FILES, "-q",
+             f"--junitxml={junit}"],
+            cwd=repo, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True, text=True, env=env)
+        timed_out = False
+        try:
+            out, _ = proc.communicate(timeout=budget)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            out, _ = proc.communicate()
+        secs = time.monotonic() - t0
+        attempts.append({"rc": "timeout" if timed_out else proc.returncode,
+                         "seconds": round(secs, 1),
+                         "budget": round(budget, 1),
+                         "tail": (out or "")[-600:]})
+        if not timed_out and os.path.exists(junit):
+            try:
+                result["tests"] = _parse_junit(junit)
+            except ET.ParseError:
+                # pytest died mid-write (segfault/OOM-kill without our
+                # timeout tripping): truncated XML must not break the
+                # always-write-an-artifact contract — treat like a
+                # failed attempt and keep hunting
+                os.unlink(junit)
+                print(f"tpu_tests: attempt {attempt + 1} left a "
+                      "truncated junit report; retrying",
+                      file=sys.stderr)
+                attempt += 1
+                time.sleep(min(5.0, max(0.0, remaining() - 45)))
+                continue
+            finally:
+                if os.path.exists(junit):
+                    os.unlink(junit)
+            outcomes = [t["outcome"] for t in result["tests"]]
+            result["summary"] = {o: outcomes.count(o)
+                                 for o in set(outcomes)}
+            result["ok"] = (proc.returncode == 0 and bool(outcomes)
+                            and all(o == "passed" for o in outcomes))
+            if result["tests"]:
+                if all(o == "skipped" for o in outcomes):
+                    emit("all tests skipped — no TPU backend visible "
+                         "to the suite")
+                emit(None if result["ok"] else
+                     "suite ran; see tests[] for non-passed outcomes")
+            # ran but collected nothing — deterministic, don't churn
+            emit("pytest produced an empty junit report "
+                 "(collection failure?); see attempts[].tail")
+        if os.path.exists(junit):
+            os.unlink(junit)
+        print(f"tpu_tests: attempt {attempt + 1} "
+              f"{'timed out' if timed_out else 'failed'} after "
+              f"{secs:.0f}s (budget {budget:.0f}s, {remaining():.0f}s "
+              "left); retrying", file=sys.stderr)
+        attempt += 1
+        time.sleep(min(5.0, max(0.0, remaining() - 45)))
+
+    emit(f"deadline exhausted: {len(attempts)} attempts, backend never "
+         "came up (known axon-tunnel wedge; see attempts[].tail)")
+
+
+if __name__ == "__main__":
+    main()
