@@ -6,16 +6,20 @@ CoS via `source_class`-less `Data` layers); the rebuild's LMDB side has
 its own reader/writer (`lmdb_io.py`), and this module closes the
 LevelDB half:
 
-  * `LevelDBReader` — merges every SSTable (`*.ldb`/`*.sst`) and
-    write-ahead log (`*.log`) in the directory into one sorted
-    key→value stream, newest sequence number wins, deletions honored.
+  * `LevelDBReader` — merges the database's LIVE SSTables and
+    write-ahead logs into one sorted key→value stream, newest sequence
+    number wins, deletions honored.  Live = the CURRENT→MANIFEST
+    VersionEdit replay (new_file/deleted_file set + log_number floor);
+    without a usable manifest it falls back to scanning every
+    `*.ldb`/`*.sst`/`*.log` in the directory (fixture-grade databases).
     Tables are streamed block-by-block (one decompressed block per
     table in memory); only log entries are buffered (they are the
     recent, small tail of a database).
   * `LevelDBWriter` — enough of the on-disk format to build databases
-    for tests/tools: a single sorted SSTable + CURRENT/MANIFEST stub.
-    It can emit blocks "snappy-compressed" as all-literal streams,
-    which exercises the real decompression path on read.
+    for tests/tools: sorted SSTables + a real CURRENT/MANIFEST
+    (VersionEdit records in log framing).  It can emit blocks
+    "snappy-compressed" as all-literal streams, which exercises the
+    real decompression path on read.
   * pure-Python `snappy_decompress` (block format: varint length +
     literal/copy tags) — no native snappy library exists in this
     environment, and Caffe-written databases default to snappy.
@@ -81,6 +85,13 @@ def _uvarint(buf: bytes, off: int) -> Tuple[int, int]:
         if not b & 0x80:
             return x, off
         shift += 7
+
+
+def internal_key(key: bytes, seq: int = 1,
+                 etype: int = TYPE_VALUE) -> bytes:
+    """user key + 8-byte trailer (sequence << 8 | type) — the SSTable
+    entry / manifest-boundary key encoding (table_format.md)."""
+    return key + struct.pack("<Q", (seq << 8) | etype)
 
 
 def _put_uvarint(x: int) -> bytes:
@@ -208,14 +219,17 @@ class _Table:
         self._f.close()
 
 
-def _log_entries(path: str, *, verify_crc: bool = True
-                 ) -> Iterator[Tuple[bytes, int, int, bytes]]:
-    """(user_key, seq, type, value) from a write-ahead log file."""
+def _log_records(path: str, *, verify_crc: bool = True
+                 ) -> List[bytes]:
+    """Reassembled record payloads from a LevelDB log-format file
+    (32 KiB blocks, FULL/FIRST/MIDDLE/LAST fragments).  Both the WAL
+    (WriteBatch payloads) and the MANIFEST (VersionEdit payloads) use
+    this framing."""
     with open(path, "rb") as f:
         data = f.read()
     payload = bytearray()
     off = 0
-    batches: List[bytes] = []
+    records: List[bytes] = []
     while off + LOG_HEADER <= len(data):
         block_left = LOG_BLOCK - off % LOG_BLOCK
         if block_left < LOG_HEADER:          # trailer padding
@@ -236,7 +250,14 @@ def _log_entries(path: str, *, verify_crc: bool = True
         else:
             payload += frag
         if rtype in (LOG_FULL, LOG_LAST):
-            batches.append(bytes(payload))
+            records.append(bytes(payload))
+    return records
+
+
+def _log_entries(path: str, *, verify_crc: bool = True
+                 ) -> Iterator[Tuple[bytes, int, int, bytes]]:
+    """(user_key, seq, type, value) from a write-ahead log file."""
+    batches = _log_records(path, verify_crc=verify_crc)
     for batch in batches:
         if len(batch) < 12:
             continue
@@ -258,6 +279,87 @@ def _log_entries(path: str, *, verify_crc: bool = True
             yield key, seq + i, etype, val
 
 
+# VersionEdit tags (leveldb version_edit.cc)
+_VE_COMPARATOR = 1
+_VE_LOG_NUMBER = 2
+_VE_NEXT_FILE = 3
+_VE_LAST_SEQ = 4
+_VE_COMPACT_POINTER = 5
+_VE_DELETED_FILE = 6
+_VE_NEW_FILE = 7
+_VE_PREV_LOG = 9
+
+
+def _live_file_set(path: str, *, verify_crc: bool = True
+                   ) -> Optional[Tuple[set, int, int]]:
+    """Replay CURRENT -> MANIFEST VersionEdits into (live-SSTable
+    file-number set, log_number, prev_log_number).  Live WALs are those
+    numbered >= log_number OR == prev_log_number — LevelDB's own
+    recovery rule; anything else is obsolete (a min() floor would
+    replay logs strictly between prev_log and log_number and resurrect
+    deleted keys).  Returns None when the database has no usable
+    manifest (absent, stub, or unparseable) — callers then fall back to
+    scanning every file, the pre-round-4 behavior, which is fine for
+    fixtures but can resurrect deleted keys from crash-leftover
+    obsolete tables in real Caffe-written databases."""
+    try:
+        with open(os.path.join(path, "CURRENT"), "r") as f:
+            name = f.read().strip()
+    except OSError:
+        return None
+    man = os.path.join(path, name)
+    if not os.path.isfile(man) or os.path.getsize(man) == 0:
+        return None
+    live: set = set()
+    log_floor = 0
+    prev_log = 0
+
+    def _skip_string(payload, p):
+        ln, p = _uvarint(payload, p)
+        return p + ln
+
+    try:
+        for payload in _log_records(man, verify_crc=verify_crc):
+            p = 0
+            while p < len(payload):
+                tag, p = _uvarint(payload, p)
+                if tag == _VE_COMPARATOR:
+                    p = _skip_string(payload, p)
+                elif tag == _VE_LOG_NUMBER:
+                    log_floor, p = _uvarint(payload, p)
+                elif tag in (_VE_NEXT_FILE, _VE_LAST_SEQ):
+                    _, p = _uvarint(payload, p)
+                elif tag == _VE_COMPACT_POINTER:
+                    _, p = _uvarint(payload, p)          # level
+                    p = _skip_string(payload, p)         # internal key
+                elif tag == _VE_DELETED_FILE:
+                    _, p = _uvarint(payload, p)          # level
+                    fn, p = _uvarint(payload, p)
+                    live.discard(fn)
+                elif tag == _VE_NEW_FILE:
+                    _, p = _uvarint(payload, p)          # level
+                    fn, p = _uvarint(payload, p)
+                    _, p = _uvarint(payload, p)          # file size
+                    p = _skip_string(payload, p)         # smallest
+                    p = _skip_string(payload, p)         # largest
+                    live.add(fn)
+                elif tag == _VE_PREV_LOG:
+                    prev_log, p = _uvarint(payload, p)
+                else:
+                    raise ValueError(
+                        f"{man}: unknown VersionEdit tag {tag}")
+            if p != len(payload):
+                raise ValueError(f"{man}: trailing VersionEdit bytes")
+    except (ValueError, IndexError):
+        return None
+    return live, log_floor, prev_log
+
+
+def _file_number(p: str) -> Optional[int]:
+    stem = os.path.basename(p).split(".", 1)[0]
+    return int(stem) if stem.isdigit() else None
+
+
 class LevelDBReader:
     """Directory of SSTables + logs → one sorted (key, value) stream.
 
@@ -270,16 +372,28 @@ class LevelDBReader:
         if not os.path.isdir(path):
             raise FileNotFoundError(
                 f"LevelDB directory not found: {path!r}")
-        self._tables = [
-            _Table(p, verify_crc=verify_crc) for p in
-            sorted(glob.glob(os.path.join(path, "*.ldb"))
-                   + glob.glob(os.path.join(path, "*.sst")))]
-        self._logs = sorted(glob.glob(os.path.join(path, "*.log")))
-        self._verify_crc = verify_crc
-        if not self._tables and not self._logs:
+        table_paths = sorted(glob.glob(os.path.join(path, "*.ldb"))
+                             + glob.glob(os.path.join(path, "*.sst")))
+        log_paths = sorted(glob.glob(os.path.join(path, "*.log")))
+        if not table_paths and not log_paths:
             raise ValueError(
                 f"{path!r} has no *.ldb/*.sst/*.log files — not a "
                 "LevelDB database")
+        # honor the MANIFEST's live-file set when one exists: a
+        # crash-leftover obsolete table whose deletion marker was
+        # compacted away would otherwise resurrect deleted keys
+        live = _live_file_set(path, verify_crc=verify_crc)
+        if live is not None:
+            live_nums, log_num, prev_log = live
+            table_paths = [p for p in table_paths
+                           if _file_number(p) in live_nums]
+            log_paths = [p for p in log_paths
+                         if (_file_number(p) or 0) >= log_num
+                         or _file_number(p) == prev_log]
+        self._tables = [_Table(p, verify_crc=verify_crc)
+                        for p in table_paths]
+        self._logs = log_paths
+        self._verify_crc = verify_crc
 
     def __enter__(self):
         return self
@@ -337,20 +451,37 @@ class LevelDBReader:
         if n <= 1:
             return [(None, None)]
         ks = self._index_keys()
-        if len(ks) < 4 * n:
-            ks = self.keys()
+        if len(ks) >= 4 * n:
+            count, key_at = len(ks), ks      # list indexes like the dict
+        else:
+            count, key_at = self._stream_boundaries(n)
         bounds: List[Tuple[Optional[bytes], Optional[bytes]]] = []
         for i in range(n):
-            si = len(ks) * i // n
-            ei = len(ks) * (i + 1) // n
+            si = count * i // n
+            ei = count * (i + 1) // n
             if si >= ei:
-                k0 = ks[0] if ks else b""
+                k0 = key_at[0] if count else b""
                 bounds.append((k0, k0))
                 continue
-            lo = None if i == 0 else ks[si]
-            hi = None if ei >= len(ks) else ks[ei]
+            lo = None if i == 0 else key_at[si]
+            hi = None if ei >= count else key_at[ei]
             bounds.append((lo, hi))
         return bounds
+
+    def _stream_boundaries(self, n: int
+                           ) -> Tuple[int, Dict[int, bytes]]:
+        """Boundary keys for n partitions from two streaming scans —
+        O(n) memory, never a materialized full key list (real
+        Caffe-written databases hold millions of keys)."""
+        count = sum(1 for _ in self._merged())
+        needed = {0} | {count * i // n for i in range(1, n)}
+        key_at: Dict[int, bytes] = {}
+        for idx, (k, _) in enumerate(self._merged()):
+            if idx in needed:
+                key_at[idx] = k
+                if len(key_at) == len(needed):
+                    break
+        return count, key_at
 
     def _index_keys(self) -> List[bytes]:
         """Sorted user keys from the tables' index blocks — block-level
@@ -416,10 +547,27 @@ class LevelDBWriter:
             off += len(chunk)
         return bytes(out)
 
-    def write(self, records: List[Tuple[bytes, bytes]]) -> None:
+    def write(self, records: List[Tuple[bytes, bytes]], *,
+              file_number: int = 5) -> None:
+        self.write_table(records, file_number=file_number)
+        records = sorted(records)
+        files = []
+        if records:
+            size = os.path.getsize(os.path.join(
+                self.path, f"{file_number:06d}.ldb"))
+            files.append((file_number, size,
+                          internal_key(records[0][0]),
+                          internal_key(records[-1][0])))
+        self.write_manifest(files, log_number=0)
+
+    def write_table(self, records: List[Tuple[bytes, bytes]], *,
+                    file_number: int = 5) -> None:
+        """One sorted SSTable, no CURRENT/MANIFEST bookkeeping — tests
+        use this to plant crash-leftover obsolete tables."""
         os.makedirs(self.path, exist_ok=True)
         records = sorted(records)
-        with open(os.path.join(self.path, "000005.ldb"), "wb") as f:
+        with open(os.path.join(self.path,
+                               f"{file_number:06d}.ldb"), "wb") as f:
             index: List[Tuple[bytes, bytes]] = []
 
             def emit(block_entries):
@@ -439,7 +587,7 @@ class LevelDBWriter:
             cur: List[Tuple[bytes, bytes]] = []
             size = 0
             for k, v in records:
-                ikey = k + struct.pack("<Q", (1 << 8) | TYPE_VALUE)
+                ikey = internal_key(k)
                 cur.append((ikey, v))
                 size += len(ikey) + len(v)
                 if size >= self.block_size:
@@ -464,34 +612,59 @@ class LevelDBWriter:
             footer += b"\x00" * (40 - len(footer))
             footer += struct.pack("<Q", MAGIC)
             f.write(footer)
+
+    def write_manifest(self, files: List[Tuple[int, int, bytes, bytes]],
+                       *, log_number: int = 0,
+                       manifest_number: int = 4) -> None:
+        """Real CURRENT + MANIFEST: one VersionEdit record declaring
+        comparator, live log floor, and the live table set as
+        (file_number, size, smallest_ikey, largest_ikey) level-0
+        entries — the read side replays this in `_live_file_set`."""
+        os.makedirs(self.path, exist_ok=True)
+        cmp_name = b"leveldb.BytewiseComparator"
+        edit = bytearray()
+        edit += _put_uvarint(_VE_COMPARATOR)
+        edit += _put_uvarint(len(cmp_name)) + cmp_name
+        edit += _put_uvarint(_VE_LOG_NUMBER) + _put_uvarint(log_number)
+        for num, size, smallest, largest in files:
+            edit += _put_uvarint(_VE_NEW_FILE) + _put_uvarint(0)
+            edit += _put_uvarint(num) + _put_uvarint(size)
+            edit += _put_uvarint(len(smallest)) + smallest
+            edit += _put_uvarint(len(largest)) + largest
+        name = f"MANIFEST-{manifest_number:06d}"
+        with open(os.path.join(self.path, name), "wb") as f:
+            self._append_framed(f, bytes(edit))
         with open(os.path.join(self.path, "CURRENT"), "w") as f:
-            f.write("MANIFEST-000004\n")
-        # stub manifest: our reader scans files directly, but the file's
-        # presence makes the directory look like a real database
-        open(os.path.join(self.path, "MANIFEST-000004"), "wb").close()
+            f.write(name + "\n")
+
+    @staticmethod
+    def _append_framed(f, payload: bytes) -> None:
+        """Write one record in log framing (32 KiB blocks, fragment
+        types) — shared by the WAL and the MANIFEST."""
+        off = 0
+        first = True
+        while first or off < len(payload):
+            room = LOG_BLOCK - f.tell() % LOG_BLOCK - LOG_HEADER
+            frag = payload[off:off + room]
+            off += len(frag)
+            end = off >= len(payload)
+            rtype = (LOG_FULL if first and end else
+                     LOG_FIRST if first else
+                     LOG_LAST if end else LOG_MIDDLE)
+            crc = crc_mask(crc32c(frag, crc32c(bytes([rtype]))))
+            f.write(struct.pack("<IHB", crc, len(frag), rtype) + frag)
+            first = False
 
     def write_log(self, records: List[Tuple[bytes, bytes]],
-                  seq_start: int = 100) -> None:
+                  seq_start: int = 100, *,
+                  file_number: int = 7) -> None:
         """Append records as a write-ahead log file (the un-compacted
         recent-writes path)."""
         batch = bytearray(struct.pack("<QI", seq_start, len(records)))
         for k, v in records:
             batch += bytes([TYPE_VALUE]) + _put_uvarint(len(k)) + k
             batch += _put_uvarint(len(v)) + v
-        payload = bytes(batch)
         os.makedirs(self.path, exist_ok=True)
-        with open(os.path.join(self.path, "000007.log"), "wb") as f:
-            off = 0
-            first = True
-            while first or off < len(payload):
-                room = LOG_BLOCK - f.tell() % LOG_BLOCK - LOG_HEADER
-                frag = payload[off:off + room]
-                off += len(frag)
-                end = off >= len(payload)
-                rtype = (LOG_FULL if first and end else
-                         LOG_FIRST if first else
-                         LOG_LAST if end else LOG_MIDDLE)
-                crc = crc_mask(crc32c(frag, crc32c(bytes([rtype]))))
-                f.write(struct.pack("<IHB", crc, len(frag), rtype)
-                        + frag)
-                first = False
+        with open(os.path.join(self.path,
+                               f"{file_number:06d}.log"), "wb") as f:
+            self._append_framed(f, bytes(batch))
