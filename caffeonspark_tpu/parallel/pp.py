@@ -30,6 +30,7 @@ HBM.  Design:
 from __future__ import annotations
 
 import math
+import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
@@ -132,6 +133,47 @@ def schedule_1f1b(num_stages: int, num_microbatches: int
     return order
 
 
+def simulate_makespan(order: List[Tuple[str, int, int]], num_stages: int,
+                      *, fwd_cost: float = 1.0, bwd_cost: float = 2.0,
+                      hop_cost: float = 0.0) -> float:
+    """Makespan of a dispatch order under the FIFO-device execution
+    model (the model JAX async dispatch actually follows: each device
+    runs its queue in enqueue order; an op starts when it reaches the
+    queue head AND its cross-stage inputs exist).  This is the
+    quantitative form of the schedule_1f1b docstring's claim: a
+    topological order turns async dispatch into real overlap, while the
+    naive per-microbatch order head-of-line blocks into a serial chain.
+    Used by tests to prove the overlap property machine-independently,
+    and usable for stage-count planning."""
+    dev_free = [0.0] * num_stages
+    done: Dict[Tuple[str, int, int], float] = {}
+    for kind, s, m in order:
+        dur = fwd_cost if kind == "F" else bwd_cost
+        deps = []
+        if kind == "F":
+            if s > 0:
+                deps.append(("F", s - 1, m))
+        else:
+            deps.append(("F", s, m))
+            if s < num_stages - 1:
+                deps.append(("B", s + 1, m))
+        start = max([dev_free[s]] + [done[d] + hop_cost for d in deps])
+        done[(kind, s, m)] = dev_free[s] = start + dur
+    return max(done.values()) if done else 0.0
+
+
+def naive_schedule(num_stages: int, num_microbatches: int
+                   ) -> List[Tuple[str, int, int]]:
+    """The per-microbatch loop order (fwd all stages, then bwd all
+    stages, one microbatch at a time) — the baseline schedule_1f1b
+    exists to beat."""
+    order = []
+    for m in range(num_microbatches):
+        order += [("F", s, m) for s in range(num_stages)]
+        order += [("B", s, m) for s in reversed(range(num_stages))]
+    return order
+
+
 class PipelineSolver:
     """Stage-partitioned training for a Solver."""
 
@@ -189,6 +231,12 @@ class PipelineSolver:
         # test/diagnostic hook: set to a list to record the dispatch
         # order as (kind, stage, microbatch) tuples
         self._trace: Optional[List[Tuple[str, int, int]]] = None
+        # wall-clock instrumentation: set to a list to record per-op
+        # dispatch timestamps (kind, stage, mb, t_dispatch_s); set
+        # _serialize_ops to block after every op — the serialized-sum
+        # baseline an overlap measurement compares against
+        self._op_times: Optional[List[Tuple[str, int, int, float]]] = None
+        self._serialize_ops = False
 
     # ------------------------------------------------------------------
     def place_params(self, params: Params) -> Params:
@@ -340,11 +388,21 @@ class PipelineSolver:
             for kind, s, i in order:
                 if self._trace is not None:
                     self._trace.append((kind, s, i))
+                if self._op_times is not None:
+                    self._op_times.append((kind, s, i,
+                                           time.perf_counter()))
                 if kind == "F":
                     self._run_fwd(params, s, mbs[i],
                                   jax.random.fold_in(rng, i))
+                    if self._serialize_ops:
+                        jax.block_until_ready(
+                            [mbs[i]["acts"][b]
+                             for b in self.stage_out[s]])
                 else:
                     self._run_bwd(params, s, mbs[i], grads_acc)
+                    if self._serialize_ops:
+                        jax.block_until_ready(
+                            jax.tree_util.tree_leaves(grads_acc))
                     if s == 0:
                         # microbatch i fully drained: free its boundary
                         # activations/cotangents so live memory tracks
