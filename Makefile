@@ -20,6 +20,11 @@ install:
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -x -q
 
+# real-SparkContext leg (needs pyspark + a JVM; skips itself
+# otherwise): InterleaveTest / PythonApiTest analogs at local[4]
+spark-test:
+	$(CPU_ENV) $(PY) -m pytest tests/spark -q -rs
+
 bench:
 	$(PY) bench.py
 

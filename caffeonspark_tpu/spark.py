@@ -26,8 +26,9 @@ actionable error otherwise."""
 
 from __future__ import annotations
 
+import os
 import socket
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from .config import Config
 
@@ -35,9 +36,14 @@ from .config import Config
 def spark_available() -> bool:
     try:
         import pyspark  # noqa: F401
-        return True
     except ImportError:
         return False
+    # pyspark without a JVM fails at SparkContext construction with a
+    # gateway error, not an ImportError — count that as unavailable so
+    # callers/tests skip instead of erroring
+    import shutil
+    return bool(shutil.which("java")
+                or os.environ.get("JAVA_HOME"))
 
 
 def require_spark():
@@ -88,7 +94,8 @@ class SparkEngine:
     def app_id(self) -> str:
         return getattr(self.sc, "applicationId", "") or ""
 
-    def setup(self) -> List[Dict[str, Any]]:
+    def setup(self, *, interleave_validation: bool = False
+              ) -> List[Dict[str, Any]]:
         """Start processors on every executor, multi-host mesh up.
 
         Each executor also starts a FeedDaemon (spark_daemon.py): Spark
@@ -101,6 +108,7 @@ class SparkEngine:
         n = self.cluster_size
         port = coordinator_port(self.app_id)
         app_id = self.app_id
+        interleave = interleave_validation
 
         def start(it):
             ctx = _get_barrier_context()
@@ -115,6 +123,7 @@ class SparkEngine:
             if n > 1:
                 distributed_init(f"{coord_host}:{port}", n, rank)
             proc = CaffeProcessor.instance(conf, rank=rank)
+            proc.interleave_validation = interleave
             proc.start()
             proc._feed_daemon = FeedDaemon(proc, app_id, rank=rank)
             yield {"rank": rank, "host": socket.gethostname(),
@@ -172,6 +181,56 @@ class SparkEngine:
             yield fed
 
         return sum(rdd.mapPartitionsWithIndex(feed).collect())
+
+    def collect_report(self, rank: int = 0) -> Optional[Dict[str, Any]]:
+        """Processor progress + validation rows from one executor (the
+        validation-DataFrame collect of CaffeOnSpark.scala:344-357).
+        Runs a 1-task job that queries the host-local daemon; returns
+        {"rank", "alive", "iter", "validation": {names, rounds}} or
+        None when no daemon answered."""
+        app_id = self.app_id
+        n = self.cluster_size
+
+        def query(_):
+            from .spark_daemon import FeedClient
+            client = FeedClient.discover(app_id, rank=rank)
+            if client is None:
+                yield None
+                return
+            try:
+                yield client.report()
+            finally:
+                client.close()
+
+        # fan out one task per rank: daemon discovery is HOST-LOCAL, so
+        # a single task landing on the wrong executor host would find
+        # the wrong rank's daemon (or none); with n tasks at least one
+        # runs where the target daemon lives, and reports carry their
+        # rank so the match is exact
+        out = [r for r in (self.sc.parallelize(range(n), n)
+                           .mapPartitions(query).collect())
+               if r is not None]
+        for r in out:
+            if r.get("rank") == rank:
+                return r
+        return out[0] if out else None
+
+    def wait_done(self, timeout: float = 600.0,
+                  poll: float = 2.0) -> Optional[Dict[str, Any]]:
+        """Poll collect_report until the executor's solver thread
+        finishes (max_iter reached) or timeout; returns the final
+        report.  The driver feeds records separately — this is the
+        'solvers finish, then shutdownProcessors' join of
+        CaffeOnSpark.scala:227-230."""
+        import time
+        deadline = time.monotonic() + timeout
+        rep = None
+        while time.monotonic() < deadline:
+            rep = self.collect_report()
+            if rep is not None and not rep["alive"]:
+                return rep
+            time.sleep(poll)
+        return rep
 
     def shutdown(self):
         """Stop every executor's processor + daemon.  Goes through the

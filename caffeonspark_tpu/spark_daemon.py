@@ -52,8 +52,10 @@ OP_FEED = 1
 OP_EPOCH_END = 2
 OP_PING = 3
 OP_STOP = 4
+OP_REPORT = 5        # -> length-prefixed pickled status/validation
 
 _HDR = struct.Struct("<BI")
+_LEN = struct.Struct("<I")
 CHUNK = 64  # records per FEED message (amortizes the ack round-trip)
 
 
@@ -143,6 +145,14 @@ class FeedDaemon:
                     threading.Thread(target=self._stop_all,
                                      daemon=True).start()
                     break
+                elif op == OP_REPORT:
+                    # the driver-side window into the executor-resident
+                    # processor: progress + validation rows
+                    # (CaffeOnSpark.scala:344-357 — validation collected
+                    # from one executor into the driver's DataFrame)
+                    blob = pickle.dumps(self._report())
+                    conn.sendall(b"\x01" + _LEN.pack(len(blob)) + blob)
+                    continue
                 elif op != OP_PING:
                     ok = False
                 conn.sendall(b"\x01" if ok else b"\x00")
@@ -152,6 +162,28 @@ class FeedDaemon:
             pass
         finally:
             conn.close()
+
+    def _report(self) -> dict:
+        p = self.processor
+        thread = getattr(p, "_thread", None)
+        alive = thread is not None and thread.is_alive()
+        err = getattr(p, "_error", None)
+        rep = {"rank": self.rank, "alive": alive, "iter": None,
+               "validation": None,
+               # a solver thread that DIED must be distinguishable from
+               # one that finished: alive=False + error set = crash
+               "error": repr(err) if err is not None else None}
+        try:
+            st = getattr(p, "opt_state", None)
+            if st is not None:
+                rep["iter"] = int(st.iter)
+        except Exception:       # mid-step device value; best-effort
+            pass
+        val = getattr(p, "validation", None)
+        if val is not None:
+            rep["validation"] = {"names": list(val.names),
+                                 "rounds": list(val.rounds)}
+        return rep
 
     def _stop_all(self):
         self.stop()
@@ -252,6 +284,18 @@ class FeedClient:
 
     def epoch_end(self, queue_idx: int) -> bool:
         return self._request(OP_EPOCH_END, queue_idx)
+
+    def report(self) -> Optional[dict]:
+        """Processor status + validation rows from the daemon's host
+        (None on protocol failure)."""
+        try:
+            self._sock.sendall(_HDR.pack(OP_REPORT, 0))
+            if _recv_exact(self._sock, 1) != b"\x01":
+                return None
+            ln = _LEN.unpack(_recv_exact(self._sock, _LEN.size))[0]
+            return pickle.loads(_recv_exact(self._sock, ln))
+        except (OSError, ConnectionError, pickle.PickleError):
+            return None
 
     def close(self):
         try:

@@ -1,0 +1,189 @@
+"""In-process fake GCS JSON-API server (stdlib only, offline).
+
+Implements just enough of the Google Cloud Storage JSON API for gcsfs
+(`GCSFileSystem(token="anon", endpoint_url=...)`) to list, stat,
+upload (multipart + resumable), download, and delete objects — the
+operations caffeonspark_tpu.utils.fsutils needs for snapshot upload /
+resume / supervisor discovery on `gs://` outputs.  This is the
+fake-gcs-server idea shrunk to a test helper: requests ride a real
+HTTP socket and the real gcsfs client code path, not a monkeypatch.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Tuple
+
+
+class FakeGCS:
+    def __init__(self):
+        self.store: Dict[Tuple[str, str], bytes] = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # noqa: D102 — quiet
+                pass
+
+            def _json(self, obj, code=200):
+                blob = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def _meta(self, b, n):
+                return {"kind": "storage#object", "bucket": b, "name": n,
+                        "size": str(len(outer.store[(b, n)])),
+                        "generation": "1",
+                        "updated": "2026-01-01T00:00:00.000Z",
+                        "timeCreated": "2026-01-01T00:00:00.000Z",
+                        "contentType": "application/octet-stream"}
+
+            def do_GET(self):
+                u = urllib.parse.urlparse(self.path)
+                q = urllib.parse.parse_qs(u.query)
+                m = re.match(r"^/download/storage/v1/b/([^/]+)/o/(.+)$",
+                             u.path)
+                if m and q.get("alt") == ["media"]:
+                    key = (m.group(1),
+                           urllib.parse.unquote(m.group(2)))
+                    data = outer.store.get(key)
+                    if data is None:
+                        return self._json({"error": {"code": 404}}, 404)
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                m = re.match(r"^/storage/v1/b/([^/]+)/o/(.+)$", u.path)
+                if m:
+                    key = (m.group(1),
+                           urllib.parse.unquote(m.group(2)))
+                    if key not in outer.store:
+                        return self._json(
+                            {"error": {"code": 404,
+                                       "message": "Not Found"}}, 404)
+                    return self._json(self._meta(*key))
+                m = re.match(r"^/storage/v1/b/([^/]+)/o/?$", u.path)
+                if m:
+                    b = m.group(1)
+                    prefix = q.get("prefix", [""])[0]
+                    delim = q.get("delimiter", [None])[0]
+                    items, prefixes = [], set()
+                    for (bb, n) in sorted(outer.store):
+                        if bb != b or not n.startswith(prefix):
+                            continue
+                        rest = n[len(prefix):]
+                        if delim and delim in rest:
+                            prefixes.add(prefix + rest.split(delim)[0]
+                                         + delim)
+                        else:
+                            items.append(self._meta(b, n))
+                    out = {"kind": "storage#objects", "items": items}
+                    if prefixes:
+                        out["prefixes"] = sorted(prefixes)
+                    return self._json(out)
+                m = re.match(r"^/storage/v1/b/([^/]+)/?$", u.path)
+                if m:
+                    return self._json({"kind": "storage#bucket",
+                                       "name": m.group(1)})
+                self._json({"error": {"code": 404,
+                                      "message": self.path}}, 404)
+
+            def do_POST(self):
+                u = urllib.parse.urlparse(self.path)
+                q = urllib.parse.parse_qs(u.query)
+                ln = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(ln)
+                m = re.match(r"^/upload/storage/v1/b/([^/]+)/o/?$",
+                             u.path)
+                if m and q.get("uploadType") == ["multipart"]:
+                    b = m.group(1)
+                    ctype = self.headers.get("Content-Type", "")
+                    bm = re.search(r"boundary=['\"]?([^'\";]+)", ctype)
+
+                    def payload(part):
+                        # part = headers, blank line, body, newline;
+                        # gcsfs frames with bare \n, the spec says \r\n
+                        # — accept both
+                        for sep in (b"\r\n\r\n", b"\n\n"):
+                            if sep in part:
+                                out = part.split(sep, 1)[1]
+                                break
+                        else:
+                            out = part
+                        if out.endswith(b"\r\n"):
+                            return out[:-2]
+                        return out[:-1] if out.endswith(b"\n") else out
+
+                    parts = body.split(b"--" + bm.group(1).encode())
+                    meta = json.loads(payload(parts[1]))
+                    outer.store[(b, meta["name"])] = payload(parts[2])
+                    return self._json(self._meta(b, meta["name"]))
+                if m and q.get("uploadType") == ["resumable"]:
+                    ctype = self.headers.get("Content-Type", "")
+                    if "json" in ctype:
+                        # session initiation: metadata JSON -> Location
+                        meta = json.loads(body or b"{}")
+                        name = urllib.parse.quote(meta.get("name", ""),
+                                                  safe="")
+                        loc = (f"http://127.0.0.1:{outer.port}"
+                               f"/upload/storage/v1/b/{m.group(1)}/o"
+                               f"?uploadType=resumable&name={name}")
+                        self.send_response(200)
+                        self.send_header("Location", loc)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    # data POSTed to the session URL (gcsfs does POST,
+                    # not PUT, for the final chunk)
+                    name = urllib.parse.unquote(q.get("name", [""])[0])
+                    outer.store[(m.group(1), name)] = body
+                    return self._json(self._meta(m.group(1), name))
+                self._json({"error": {"code": 400,
+                                      "message": "bad " + self.path}},
+                           400)
+
+            def do_PUT(self):
+                u = urllib.parse.urlparse(self.path)
+                q = urllib.parse.parse_qs(u.query)
+                ln = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(ln)
+                m = re.match(r"^/upload/storage/v1/b/([^/]+)/o/?$",
+                             u.path)
+                if m and q.get("uploadType") == ["resumable"]:
+                    name = urllib.parse.unquote(q["name"][0])
+                    outer.store[(m.group(1), name)] = body
+                    return self._json(self._meta(m.group(1), name))
+                self._json({"error": {"code": 400}}, 400)
+
+            def do_DELETE(self):
+                u = urllib.parse.urlparse(self.path)
+                m = re.match(r"^/storage/v1/b/([^/]+)/o/(.+)$", u.path)
+                if m:
+                    outer.store.pop(
+                        (m.group(1),
+                         urllib.parse.unquote(m.group(2))), None)
+                    self.send_response(204)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self._json({"error": {"code": 404}}, 404)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()

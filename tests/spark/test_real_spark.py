@@ -1,0 +1,195 @@
+"""The reference's defining integration under a REAL SparkContext.
+
+These are the InterleaveTest.scala:36-57 and PythonApiTest.py:45
+analogs: a genuine pyspark `local[4]` application drives SparkEngine —
+barrier stage bring-up, FeedDaemon cross-process record delivery, the
+driver re-feed loop, validation collection over the daemon REPORT op,
+rank-0 snapshotting — against the reference's own LeNet configs on
+real handwritten digits (tools/datasets build_digits; airgapped
+MNIST-geometry stand-in, same as tests/test_real_digits.py).
+
+Skips when pyspark (or its JVM) is unavailable — the zero-egress dev
+box can only contract-test the choreography against doubles
+(tests/test_spark_engine.py); THIS file is the real proof and runs in
+environments with egress: `make spark-test`, the docker image
+(docker/standalone/Dockerfile), and the ci.yml `spark-suite` job.
+"""
+
+import os
+
+import pytest
+
+from caffeonspark_tpu.spark import spark_available
+
+pytestmark = [
+    pytest.mark.skipif(not spark_available(),
+                       reason="pyspark not installed"),
+    pytest.mark.slow,
+]
+
+REF = "/root/reference/data"
+
+
+@pytest.fixture(scope="module")
+def sc():
+    from pyspark import SparkConf, SparkContext
+    conf = (SparkConf().setMaster("local[4]")
+            .setAppName("cos-real-spark-test")
+            .set("spark.python.worker.reuse", "true")
+            .set("spark.ui.enabled", "false"))
+    sc = SparkContext(conf=conf)
+    yield sc
+    sc.stop()
+
+
+def _lenet_net_and_solver():
+    """Reference lenet_memory configs when /root/reference exists (the
+    dev box); otherwise the repo's own zoo LeNet with TRAIN/TEST
+    MemoryData layers spliced in — CI runners and the docker image have
+    no reference checkout, and these tests must actually RUN there (a
+    skip would make the spark-suite job a permanent green no-op)."""
+    from caffeonspark_tpu.proto import (NetParameter, SolverParameter,
+                                        read_net, read_solver)
+    if os.path.exists(os.path.join(REF, "lenet_memory_solver.prototxt")):
+        return (read_net(os.path.join(
+                    REF, "lenet_memory_train_test.prototxt")),
+                read_solver(os.path.join(
+                    REF, "lenet_memory_solver.prototxt")))
+    from caffeonspark_tpu.models import zoo
+    npm = zoo.lenet()
+    frag = NetParameter.from_text("""
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  include { phase: TRAIN }
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param { source: "TRAIN" batch_size: 64
+    channels: 1 height: 28 width: 28 }
+  transform_param { scale: 0.00390625 } }
+layer { name: "tdata" type: "MemoryData" top: "data" top: "label"
+  include { phase: TEST }
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param { source: "TEST" batch_size: 100
+    channels: 1 height: 28 width: 28 }
+  transform_param { scale: 0.00390625 } }""")
+    npm.layer[0:1] = list(frag.layer)
+    # the public Caffe MNIST solver settings (lenet_memory_solver)
+    sp = SolverParameter.from_text(
+        'base_lr: 0.01 momentum: 0.9 weight_decay: 0.0005 '
+        'lr_policy: "inv" gamma: 0.0001 power: 0.75 random_seed: 1')
+    return npm, sp
+
+
+def _lenet_conf(tmp_path, *, max_iter, test_interval=0, test_iter=0,
+                extra_args=()):
+    """LeNet solver/net with LMDB sources redirected at real-digit
+    LMDBs (the reference's own CI does the same rewrite)."""
+    from caffeonspark_tpu.config import Config
+    from caffeonspark_tpu.proto import Phase
+    from caffeonspark_tpu.tools.datasets import build_digits
+
+    build_digits(str(tmp_path))
+    npm, sp = _lenet_net_and_solver()
+    for lp in npm.layer:
+        if lp.type != "MemoryData":
+            continue
+        is_train = any(r.has("phase") and r.phase == Phase.TRAIN
+                       for r in lp.include)
+        lp.memory_data_param.source = str(
+            tmp_path / ("mnist_train_lmdb" if is_train
+                        else "mnist_test_lmdb"))
+    net_path = tmp_path / "lenet_net.prototxt"
+    net_path.write_text(npm.to_text())
+    sp.net = str(net_path)
+    sp.max_iter = max_iter
+    sp.test_interval = test_interval
+    if test_iter:
+        sp.test_iter = [test_iter]
+    sp.snapshot_prefix = str(tmp_path / "out" / "lenet")
+    solver_path = tmp_path / "lenet_solver.prototxt"
+    solver_path.write_text(sp.to_text())
+    return Config(["-conf", str(solver_path), "-train", "-devices", "1",
+                   "-clusterSize", "1", *extra_args])
+
+
+def _lmdb_records(path):
+    """LMDB -> the 7-tuple record stream the feed queue consumes
+    (id, label, channels, height, width, encoded, bytes)."""
+    from caffeonspark_tpu.data.lmdb_io import LmdbReader
+    from caffeonspark_tpu.proto.caffe import Datum
+    out = []
+    with LmdbReader(str(path)) as r:
+        for k, v in r.items():
+            d = Datum.from_binary(v)
+            out.append((k.decode(), float(d.label), d.channels,
+                        d.height, d.width, bool(d.encoded), d.data))
+    return out
+
+
+def test_interleave_local4(sc, tmp_path):
+    """InterleaveTest analog: trainWithValidation through the real
+    barrier stage + feed daemon; final validation accuracy > 0.8 and
+    loss < 0.5 (the reference's own CI gates,
+    InterleaveTest.scala:53-55)."""
+    from caffeonspark_tpu.spark import SparkEngine
+
+    conf = _lenet_conf(tmp_path, max_iter=400, test_interval=200,
+                       test_iter=10)
+    engine = SparkEngine(sc, conf)
+    plan = engine.setup(interleave_validation=True)
+    assert [p["rank"] for p in plan] == [0]
+
+    train = _lmdb_records(tmp_path / "mnist_train_lmdb")
+    val = _lmdb_records(tmp_path / "mnist_test_lmdb")
+    train_rdd = sc.parallelize(train, 4)
+    val_rdd = sc.parallelize(val[:10 * 100], 1)
+
+    rep = None
+    for _ in range(40):                 # driver re-feed loop (:204-227)
+        engine.feed_partitions(train_rdd, 0)
+        engine.feed_partitions(val_rdd, 1)
+        rep = engine.collect_report()
+        if rep is not None and not rep["alive"]:
+            break
+    rep = engine.wait_done(timeout=300)
+    engine.shutdown()
+
+    assert rep is not None and rep["alive"] is False
+    assert rep["validation"], "no validation rounds returned"
+    names = rep["validation"]["names"]
+    assert "accuracy" in names and "loss" in names
+    last = rep["validation"]["rounds"][-1]
+    assert last["accuracy"] > 0.8, rep["validation"]["rounds"]
+    assert last["loss"] < 0.5, rep["validation"]["rounds"]
+
+
+def test_python_api_train_then_test(sc, tmp_path):
+    """PythonApiTest analog: full train over Spark, then test() on the
+    rank-0 final snapshot — accuracy > 0.9 (PythonApiTest.py:45)."""
+    from caffeonspark_tpu.caffe_on_spark import CaffeOnSpark
+    from caffeonspark_tpu.config import Config
+    from caffeonspark_tpu.data import get_source
+    from caffeonspark_tpu.spark import SparkEngine
+
+    conf = _lenet_conf(tmp_path, max_iter=400)
+    engine = SparkEngine(sc, conf)
+    engine.setup()
+    train = _lmdb_records(tmp_path / "mnist_train_lmdb")
+    train_rdd = sc.parallelize(train, 4)
+    rep = None
+    for _ in range(40):
+        engine.feed_partitions(train_rdd, 0)
+        rep = engine.collect_report()
+        if rep is not None and not rep["alive"]:
+            break
+    rep = engine.wait_done(timeout=300)
+    engine.shutdown()
+    assert rep is not None and rep["alive"] is False
+
+    model = tmp_path / "out" / "lenet_iter_400.caffemodel"
+    assert model.exists(), list((tmp_path / "out").iterdir())
+
+    test_conf = Config(["-conf", conf.protoFile, "-test",
+                        "-weights", str(model), "-devices", "1"])
+    src = get_source(test_conf.test_data_layer(), phase_train=False,
+                     seed=0)
+    res = CaffeOnSpark(sc).test(src, test_conf)
+    assert res["accuracy"][0] > 0.9, res

@@ -1,0 +1,110 @@
+"""fsutils against a REAL remote scheme: gs:// over a live HTTP server.
+
+VERDICT r3 #9: the remote-FS plumbing had only ever round-tripped
+through fsspec's in-process memory:// backend.  Here the snapshot
+upload / resume / supervisor-discovery paths run against gcsfs — the
+actual backend the deploy docs prescribe (`-output gs://bucket/run`) —
+talking to an in-process fake GCS JSON-API server (tests/fake_gcs.py)
+over a real socket via STORAGE_EMULATOR_HOST.  Every byte crosses HTTP;
+nothing is monkeypatched.  Reference analog: FSUtils.scala:21-89
+(CopyFileToHDFS/GenModelOrState against real HDFS).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+gcsfs = pytest.importorskip("gcsfs")
+
+from caffeonspark_tpu.utils import fsutils  # noqa: E402
+
+from fake_gcs import FakeGCS  # noqa: E402
+
+
+@pytest.fixture()
+def gcs(monkeypatch):
+    server = FakeGCS()
+    monkeypatch.setenv("STORAGE_EMULATOR_HOST", server.endpoint)
+    gcsfs.GCSFileSystem.clear_instance_cache()
+    yield server
+    server.close()
+    gcsfs.GCSFileSystem.clear_instance_cache()
+
+
+def test_bytes_and_upload_roundtrip(gcs, tmp_path):
+    fsutils.write_bytes("gs://bkt/run/a.bin", b"over-http")
+    assert fsutils.exists("gs://bkt/run/a.bin")
+    assert fsutils.read_bytes("gs://bkt/run/a.bin") == b"over-http"
+    local = tmp_path / "up.bin"
+    local.write_bytes(b"uploaded")
+    fsutils.upload(str(local), "gs://bkt/run/up.bin")
+    back = fsutils.download("gs://bkt/run/up.bin",
+                            str(tmp_path / "down.bin"))
+    assert open(back, "rb").read() == b"uploaded"
+    assert sorted(fsutils.listdir("gs://bkt/run")) == ["a.bin", "up.bin"]
+    # dircache must not freeze: a file created after the first listing
+    # (here by the server, in reality by another rank) shows up
+    gcs.store[("bkt", "run/late.bin")] = b"x"
+    assert "late.bin" in fsutils.listdir("gs://bkt/run")
+
+
+def test_snapshot_and_resume_over_gcs(gcs):
+    """GenModelOrState analog: snapshot straight to gs://, then resume
+    from it — the write-local-then-upload path + remote restore."""
+    import jax
+    from caffeonspark_tpu import checkpoint
+    from caffeonspark_tpu.proto import NetParameter, SolverParameter
+    from caffeonspark_tpu.solver import Solver
+
+    npm = NetParameter.from_text("""
+name: "t"
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 4 channels: 1 height: 8 width: 8 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 3
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }""")
+    sp = SolverParameter.from_text(
+        "base_lr: 0.01 max_iter: 4 random_seed: 3")
+    solver = Solver(sp, npm)
+    params, st = solver.init()
+    model, state = checkpoint.snapshot(
+        solver.train_net, params, st, "gs://bkt/run1/model")
+    assert model.startswith("gs://bkt/run1/") and fsutils.exists(model)
+    assert fsutils.exists(state)
+
+    p2, st2 = solver.init()
+    p2, st2 = checkpoint.restore(solver.train_net, p2, st2, state,
+                                 weights_path=model)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(params["ip"]["weight"])),
+        np.asarray(jax.device_get(p2["ip"]["weight"])))
+
+
+def test_supervisor_discovery_over_gcs(gcs):
+    """The multi-host recovery path (ADVICE r3 high): snapshot
+    discovery + content-derived progress stamps on a gs:// output dir,
+    every call an HTTP round trip."""
+    import argparse
+
+    from caffeonspark_tpu.tools.supervisor import (Supervisor,
+                                                   find_latest_snapshot)
+
+    out = "gs://bkt/run2"
+    assert find_latest_snapshot(out, "m") is None
+    for it in (10, 25):
+        fsutils.write_bytes(f"{out}/m_iter_{it}.solverstate", b"s")
+        fsutils.write_bytes(f"{out}/m_iter_{it}.caffemodel", b"m")
+    fsutils.write_bytes(f"{out}/m_iter_40.solverstate", b"s")  # no model
+    assert find_latest_snapshot(out, "m") == (
+        f"{out}/m_iter_25.solverstate", f"{out}/m_iter_25.caffemodel")
+
+    sup = Supervisor(argparse.Namespace(output=out), [])
+    st1 = sup._progress_stamp("m")
+    assert st1 == (40, 5)
+    # another rank writes a newer snapshot: the stamp must advance
+    # (the healthy-run stall-timer bug this fixes)
+    gcs.store[("bkt", "run2/m_iter_55.solverstate")] = b"s"
+    assert sup._progress_stamp("m") > st1

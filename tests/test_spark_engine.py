@@ -404,3 +404,81 @@ def test_feed_client_rejects_after_stop(conf, tmp_path):
             proc.stop()
         except Exception:
             pass
+
+
+def test_engine_interleave_validation_and_report(tmp_path, monkeypatch):
+    """trainWithValidation through the ENGINE: setup() propagates the
+    interleave flag to the executor-resident processor, validation rows
+    come back over the daemon's REPORT op, and wait_done() observes the
+    solver finishing — the driver-side choreography of
+    CaffeOnSpark.scala:239-358 under the barrier double."""
+    monkeypatch.setattr(
+        spark_mod, "_get_barrier_context",
+        lambda: _FakeBarrierContext._local.ctx)
+    monkeypatch.setenv("COS_FEED_DIR", str(tmp_path))
+
+    imgs, labels = make_images(64, seed=5)
+    recs = [(b"%08d" % i,
+             Datum(channels=1, height=28, width=28,
+                   data=(imgs[i, 0] * 255).astype(np.uint8).tobytes(),
+                   label=int(labels[i])).to_binary()) for i in range(64)]
+    LmdbWriter(str(tmp_path / "lmdb")).write(recs)
+    net = tmp_path / "net.prototxt"
+    net.write_text("""
+name: "tiny"
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  include { phase: TRAIN }
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param { source: "%s" batch_size: 16
+    channels: 1 height: 28 width: 28 }
+  transform_param { scale: 0.00390625 } }
+layer { name: "tdata" type: "MemoryData" top: "data" top: "label"
+  include { phase: TEST }
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param { source: "%s" batch_size: 16
+    channels: 1 height: 28 width: 28 }
+  transform_param { scale: 0.00390625 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 10
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }
+layer { name: "accuracy" type: "Accuracy" bottom: "ip" bottom: "label"
+  top: "accuracy" include { phase: TEST } }
+""" % (tmp_path / "lmdb", tmp_path / "lmdb"))
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(SOLVER.format(net=net, max_iter=8).replace(
+        "max_iter: 8", "max_iter: 8\ntest_interval: 4\ntest_iter: 2"))
+    conf = Config(["-conf", str(solver), "-train"])
+
+    sc = _FakeSparkContext()
+    engine = SparkEngine(sc, conf, require=False)
+    engine.setup(interleave_validation=True)
+    proc = CaffeProcessor.instance()
+    assert proc.interleave_validation is True
+
+    train_rdd = _FakeRDD([_records(4 * 16, seed=3)])
+    val_rdd = _FakeRDD([_records(2 * 16, seed=4)])
+    # the reference's re-feed loop (CaffeOnSpark.scala:204-227): keep
+    # feeding interleave rounds until the solver reaches max_iter —
+    # exactly max_iter batches is NOT enough because the device
+    # prefetcher (depth 2) pulls ahead of the step loop
+    for _ in range(6):
+        engine.feed_partitions(train_rdd, 0)
+        engine.feed_partitions(val_rdd, 1)
+        rep = engine.collect_report()
+        if rep is not None and not rep["alive"]:
+            break
+
+    rep = engine.wait_done(timeout=120)
+    assert rep is not None and rep["alive"] is False
+    assert rep["iter"] == 8
+    assert rep["validation"] is not None
+    names = rep["validation"]["names"]
+    assert "accuracy" in names and "loss" in names
+    assert len(rep["validation"]["rounds"]) == 2   # iters 4 and 8
+    engine.shutdown()
+    deadline = time.time() + 30
+    while CaffeProcessor._instance is not None and time.time() < deadline:
+        time.sleep(0.1)
+    assert CaffeProcessor._instance is None
