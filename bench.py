@@ -62,6 +62,10 @@ Env knobs:
   BENCH_DTYPE        'mixed' (default: f32 master weights, bf16
                      activations/compute — halves activation HBM
                      traffic) | 'float32' | 'bfloat16' (params too)
+  COS_STATE_DTYPE    optimizer-history dtype (e.g. 'bfloat16' halves
+                     the optimizer HBM round trip — the top remaining
+                     roofline lever per scripts/roofline.py; read by
+                     Solver directly)
   BENCH_PIPELINE=1   feed through the REAL data pipeline (JPEG LMDB ->
                      native decode -> transform -> device prefetch),
                      host-dispatched per step; also reports host

@@ -393,8 +393,12 @@ def restore(net: Net, params: Params, opt_state: OptState,
         for lname, specs in net.param_layout.items():
             for bname, shape, _ in specs:
                 if i < len(hist) and hist[i].size == int(np.prod(shape)):
+                    # keep the caller's state dtype: snapshots store f32
+                    # (binaryproto), but a COS_STATE_DTYPE=bfloat16 run
+                    # must not silently revert to f32 momentum on resume
                     dest[lname][bname] = jnp.asarray(
-                        hist[i].reshape(shape))
+                        hist[i].reshape(shape),
+                        dtype=dest[lname][bname].dtype)
                 i += 1
         if len(hist) < 2 * n_blobs:
             break  # old snapshot without second moments

@@ -30,6 +30,7 @@ for a mesh.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -48,8 +49,9 @@ class OptState(NamedTuple):
     history2: Params            # second moment (Adam) / delta accum (AdaDelta)
 
 
-def _zeros_like_params(params: Params) -> Params:
-    return jax.tree_util.tree_map(jnp.zeros_like, params)
+def _zeros_like_params(params: Params, dtype=None) -> Params:
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros_like(p, dtype=dtype), params)
 
 
 def learning_rate(sp: SolverParameter, it: Array) -> Array:
@@ -84,9 +86,37 @@ class Solver:
 
     def __init__(self, solver_param: SolverParameter,
                  net_param: Optional[NetParameter] = None, *,
-                 rank: int = 0, dtype=jnp.float32, compute_dtype=None):
+                 rank: int = 0, dtype=jnp.float32, compute_dtype=None,
+                 state_dtype=None):
         self.param = solver_param
         self.rank = rank
+        # optimizer-history dtype (default: match each param blob).
+        # bfloat16 halves the optimizer's HBM round trip — on CaffeNet
+        # b256 that is ~300 MB/step, the single biggest remaining lever
+        # per scripts/roofline.py (fc6/fc7 are optimizer-traffic-bound,
+        # not matmul-bound).  _apply_update already preserves history
+        # dtype (h_n.astype(h.dtype)): arithmetic upcasts to f32, only
+        # the STORED momentum is rounded.  COS_STATE_DTYPE=bfloat16
+        # flips it globally.
+        if state_dtype is None:
+            env = os.environ.get("COS_STATE_DTYPE", "")
+            state_dtype = jnp.dtype(env).type if env else None
+        stype = (solver_param.type or "SGD").upper()
+        if (state_dtype is not None
+                and jnp.dtype(state_dtype).itemsize < 4
+                and stype not in ("SGD", "NESTEROV")):
+            # second-moment accumulators (Adam/AdaGrad/RMSProp/AdaDelta
+            # keep them in `history`/`history2`) change by ~1e-3
+            # relative per step — below bf16 ulp, so a reduced state
+            # dtype would freeze them after warm-up.  Only the
+            # momentum-style first moments tolerate it.
+            import logging
+            logging.getLogger(__name__).warning(
+                "COS_STATE_DTYPE=%s ignored for solver type %s "
+                "(second-moment accumulators need >=f32)",
+                jnp.dtype(state_dtype).name, stype)
+            state_dtype = None
+        self.state_dtype = state_dtype
         if net_param is None:
             raise ValueError("net_param required (driver resolves "
                              "solver.net path → NetParameter)")
@@ -163,9 +193,10 @@ class Solver:
         return params, self.init_state(params)
 
     def init_state(self, params: Params) -> OptState:
-        return OptState(iter=jnp.zeros((), jnp.int32),
-                        history=_zeros_like_params(params),
-                        history2=_zeros_like_params(params))
+        return OptState(
+            iter=jnp.zeros((), jnp.int32),
+            history=_zeros_like_params(params, self.state_dtype),
+            history2=_zeros_like_params(params, self.state_dtype))
 
     # ------------------------------------------------------------------
     def _apply_update(self, params: Params, grads: Params, state: OptState,

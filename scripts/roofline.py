@@ -1,0 +1,150 @@
+"""Roofline analysis for a prototxt net on TPU (VERDICT r3 ask #4).
+
+For every compute layer, bounds one train step's time by
+max(FLOPs / MXU peak, HBM bytes / bandwidth) and aggregates into the
+roofline-implied throughput ceiling — the quantitative answer to
+"is 38% MFU the ceiling for CaffeNet's profile, or is there headroom?"
+
+Model (estimate-grade, stated so the numbers are auditable):
+  * forward bytes/layer = in + out activations + params read;
+  * backward ≈ 2x forward traffic (dL/dx needs weights + stashed
+    activations; dL/dW needs activations + writes grads) and 2x
+    forward FLOPs for weighted layers;
+  * optimizer: read param+momentum, write param+momentum in f32
+    (16 bytes/param) regardless of compute dtype;
+  * --fused drops elementwise layers' activation traffic (XLA fuses
+    ReLU/Dropout/eltwise into the producing matmul/conv) — the fused
+    and unfused totals bracket reality.
+
+Usage:
+  python scripts/roofline.py [--net PATH] [--batch N]
+      [--dtype mixed|float32] [--peak-tflops 197] [--hbm-gbs 819]
+      [--fused] [--json]
+
+Defaults model TPU v5e (197 bf16 TFLOP/s, 819 GB/s HBM) and the
+bench.py default config (bvlc_reference_net @ batch 256, mixed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from math import prod
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ELEMENTWISE = {"ReLU", "Dropout", "Eltwise", "Scale", "Bias", "PReLU",
+               "Sigmoid", "TanH", "ELU", "AbsVal", "Power", "Exp",
+               "Log", "BNLL"}
+MEMBOUND = {"Pooling", "LRN", "Softmax", "SoftmaxWithLoss", "Concat",
+            "Slice", "Flatten", "Reshape", "BatchNorm", "Accuracy"}
+
+
+def analyze(net, *, act_bytes: int, param_bytes: int, fused: bool):
+    rows = []
+    for lp in net.compute_layers:
+        tops = net._top_shapes.get(lp.name, {})
+        out_elems = sum(prod(s) for s in tops.values())
+        in_elems = sum(prod(net.blob_shapes[b]) for b in lp.bottom
+                       if b in net.blob_shapes)
+        p_elems = sum(prod(s) for _, s, _ in
+                      net.param_layout.get(lp.name, []))
+        flops = 0
+        for pname, pshape, _ in net.param_layout.get(lp.name, []):
+            if len(pshape) < 2 or "bias" in pname:
+                continue
+            first_top = next(iter(tops.values())) if tops else ()
+            flops += 2 * prod(first_top) * prod(pshape[1:])
+        fwd_bytes = ((in_elems + out_elems) * act_bytes
+                     + p_elems * param_bytes)
+        if fused and lp.type in ELEMENTWISE:
+            fwd_bytes = 0          # fused into the producer's epilogue
+        # backward: ~2x forward traffic and 2x weighted FLOPs; +
+        # optimizer f32 param/momentum round trip
+        step_bytes = 3 * fwd_bytes + 16 * p_elems
+        step_flops = 3 * flops
+        rows.append({"layer": lp.name, "type": lp.type,
+                     "flops": step_flops, "bytes": step_bytes,
+                     "params": p_elems})
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--net",
+                    default="/root/reference/data/bvlc_reference_net"
+                            ".prototxt")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--dtype", default="mixed",
+                    choices=["mixed", "float32"])
+    ap.add_argument("--peak-tflops", type=float, default=197.0)
+    ap.add_argument("--hbm-gbs", type=float, default=819.0)
+    ap.add_argument("--fused", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    from caffeonspark_tpu.net import Net
+    from caffeonspark_tpu.proto import NetState, Phase, read_net
+    if os.path.exists(args.net):
+        npm = read_net(args.net)
+        for lp in npm.layer:
+            if lp.type == "MemoryData":
+                lp.memory_data_param.batch_size = args.batch
+    else:
+        from caffeonspark_tpu.models.zoo import caffenet
+        npm = caffenet(batch_size=args.batch)
+    net = Net(npm, NetState(phase=Phase.TRAIN))
+
+    act_bytes = 2 if args.dtype == "mixed" else 4
+    # mixed keeps f32 master weights but computes in bf16: the compute
+    # path reads a bf16 copy (2B); the optimizer traffic (16B/param) is
+    # accounted separately in analyze()
+    param_bytes = 2 if args.dtype == "mixed" else 4
+    rows = analyze(net, act_bytes=act_bytes, param_bytes=param_bytes,
+                   fused=args.fused)
+
+    peak = args.peak_tflops * 1e12
+    bw = args.hbm_gbs * 1e9
+    total_flops = sum(r["flops"] for r in rows)
+    t_roof = 0.0
+    for r in rows:
+        r["t_flop_us"] = r["flops"] / peak * 1e6
+        r["t_mem_us"] = r["bytes"] / bw * 1e6
+        r["bound"] = ("mxu" if r["t_flop_us"] >= r["t_mem_us"]
+                      else "hbm")
+        r["t_us"] = max(r["t_flop_us"], r["t_mem_us"])
+        t_roof += r["t_us"]
+    ceil_ips = args.batch / t_roof * 1e6
+    ceil_mfu = total_flops / (t_roof * 1e-6) / peak
+
+    if args.json:
+        print(json.dumps({"rows": rows, "total_flops": total_flops,
+                          "roofline_step_us": round(t_roof, 1),
+                          "ceiling_images_per_sec": round(ceil_ips, 0),
+                          "ceiling_mfu": round(ceil_mfu, 4),
+                          "config": vars(args)}))
+        return
+
+    print(f"# roofline: {os.path.basename(args.net)} batch={args.batch}"
+          f" dtype={args.dtype} fused={args.fused}")
+    print(f"# peak {args.peak_tflops} TFLOP/s, HBM {args.hbm_gbs} GB/s")
+    hdr = (f"{'layer':<12}{'type':<16}{'GFLOPs':>9}{'MB':>9}"
+           f"{'t_flop':>9}{'t_mem':>9}{'bound':>6}")
+    print(hdr)
+    for r in rows:
+        if r["t_us"] < 1.0:
+            continue
+        print(f"{r['layer']:<12}{r['type']:<16}"
+              f"{r['flops'] / 1e9:>9.1f}{r['bytes'] / 1e6:>9.1f}"
+              f"{r['t_flop_us']:>8.0f}u{r['t_mem_us']:>8.0f}u"
+              f"{r['bound']:>6}")
+    print(f"\nroofline step time : {t_roof:>8.0f} us")
+    print(f"ceiling throughput : {ceil_ips:>8.0f} images/sec")
+    print(f"ceiling MFU        : {ceil_mfu * 100:>7.1f} %")
+
+
+if __name__ == "__main__":
+    main()

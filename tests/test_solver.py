@@ -343,3 +343,73 @@ def test_real_lenet_config_train_steps():
         if l0 is None:
             l0 = lN
     assert np.isfinite(lN) and lN < l0 * 1.5
+
+
+def test_bf16_optimizer_state():
+    """state_dtype=bfloat16 (COS_STATE_DTYPE knob): f32 master weights
+    with bf16 momentum — halves the optimizer's HBM round trip (the
+    biggest remaining roofline lever on CaffeNet, scripts/roofline.py)
+    — must keep its dtype across updates and track the f32-state
+    trajectory closely."""
+    sp_txt = ("base_lr: 0.05 momentum: 0.9 lr_policy: 'fixed' "
+              "random_seed: 1")
+    npm = NetParameter.from_text(SMALL_NET)
+    s16 = Solver(SolverParameter.from_text(sp_txt), npm,
+                 state_dtype=jnp.bfloat16)
+    # explicit f32 baseline: the env fallback must not let an exported
+    # COS_STATE_DTYPE turn this into a bf16-vs-bf16 comparison
+    s32 = Solver(SolverParameter.from_text(sp_txt), npm,
+                 state_dtype=jnp.float32)
+    p16, st16 = s16.init()
+    p32, st32 = s32.init()
+    assert st16.history["conv1"]["weight"].dtype == jnp.bfloat16
+    assert p16["conv1"]["weight"].dtype == jnp.float32
+    step16 = s16.jit_train_step()
+    step32 = s32.jit_train_step()
+    gen = batches(128, 32, seed=2, scale=1 / 256.0)
+    l16 = l32 = None
+    for i in range(40):
+        d, l = next(gen)
+        batch = {"data": jnp.asarray(d), "label": jnp.asarray(l)}
+        p16, st16, o16 = step16(p16, st16, batch, s16.step_rng(i))
+        p32, st32, o32 = step32(p32, st32, batch, s32.step_rng(i))
+        l16, l32 = float(o16["loss"]), float(o32["loss"])
+    assert st16.history["conv1"]["weight"].dtype == jnp.bfloat16
+    # converges, and lands near the f32-state trajectory
+    assert l16 == pytest.approx(l32, rel=0.15), (l16, l32)
+    w16 = np.asarray(p16["conv1"]["weight"], np.float32)
+    w32 = np.asarray(p32["conv1"]["weight"], np.float32)
+    np.testing.assert_allclose(w16, w32, atol=0.05)
+
+
+def test_state_dtype_guards_and_resume(tmp_path):
+    """bf16 state is refused for second-moment solvers, and a resumed
+    bf16-state run keeps bf16 history (snapshots serialize f32)."""
+    from caffeonspark_tpu import checkpoint
+    npm = NetParameter.from_text(SMALL_NET)
+    adam = Solver(SolverParameter.from_text(
+        "base_lr: 0.001 momentum: 0.9 momentum2: 0.999 type: 'Adam' "
+        "lr_policy: 'fixed' random_seed: 1"), npm,
+        state_dtype=jnp.bfloat16)
+    assert adam.state_dtype is None       # guarded off, warned
+
+    s = Solver(SolverParameter.from_text(
+        "base_lr: 0.05 momentum: 0.9 lr_policy: 'fixed' random_seed: 1"),
+        npm, state_dtype=jnp.bfloat16)
+    params, st = s.init()
+    step = s.jit_train_step()
+    gen = batches(64, 32, seed=2, scale=1 / 256.0)
+    for i in range(3):
+        d, l = next(gen)
+        params, st, _ = step(params, st,
+                             {"data": jnp.asarray(d),
+                              "label": jnp.asarray(l)}, s.step_rng(i))
+    model, state = checkpoint.snapshot(s.train_net, params, st,
+                                       str(tmp_path / "m"))
+    p2, st2 = s.init()
+    p2, st2 = checkpoint.restore(s.train_net, p2, st2, state,
+                                 weights_path=model)
+    assert st2.history["conv1"]["weight"].dtype == jnp.bfloat16
+    h_saved = np.asarray(st.history["conv1"]["weight"], np.float32)
+    h_back = np.asarray(st2.history["conv1"]["weight"], np.float32)
+    np.testing.assert_allclose(h_back, h_saved, rtol=1e-2, atol=1e-6)
