@@ -20,7 +20,8 @@ import logging
 import os
 import queue
 import threading
-from typing import Any, Dict, Iterator, List, Optional, Sequence
+from typing import (Any, Dict, Iterator, List, Optional,
+                    Sequence, Tuple)
 
 import numpy as np
 
@@ -357,16 +358,61 @@ class CaffeProcessor:
     def extract_features(self, source: DataSource,
                          blob_names: Sequence[str]
                          ) -> List[Dict[str, Any]]:
+        return self.extract_rows(source.records(), blob_names,
+                                 source=source)
+
+    def default_feature_blobs(self) -> List[str]:
+        net = self.solver.test_net or self.solver.train_net
+        return list(net.output_blobs)
+
+    def feature_source(self) -> Optional[DataSource]:
+        """Record decoder for feature extraction, ALWAYS test-phase:
+        the val source when the net has a TEST data layer, else one
+        built in phase_train=False from whatever data layer exists —
+        never train_source, whose transformer applies random
+        crop/mirror and would make predictions nondeterministic."""
+        src = self.val_source or getattr(self, "_feature_src", None)
+        if src is None:
+            lp = (self.conf.test_data_layer()
+                  or self.conf.train_data_layer())
+            if lp is not None:
+                from .data.source import get_source
+                src = get_source(lp, phase_train=False,
+                                 **self._source_kw)
+                self._feature_src = src
+        return src
+
+    def _feature_fwd(self, blob_names: Tuple[str, ...]):
+        """Jitted predict(blobNames) closure, cached per blob set — the
+        daemon's chunked EXTRACT requests must not retrace per chunk."""
+        import jax
+        cache = getattr(self, "_fwd_cache", None)
+        if cache is None:
+            cache = self._fwd_cache = {}
+        if blob_names not in cache:
+            net = self.solver.test_net or self.solver.train_net
+
+            # predict(blobNames) semantics (CaffeNet.cpp:677-697):
+            # forward, then read ANY named blob — not just net outputs
+            @jax.jit
+            def fwd(params, inputs):
+                blobs, _ = net.apply(params, inputs, train=False)
+                return {bn: blobs[bn] for bn in blob_names}
+
+            cache[blob_names] = fwd
+        return cache[blob_names]
+
+    def extract_rows(self, records, blob_names: Sequence[str],
+                     source: Optional[DataSource] = None
+                     ) -> List[Dict[str, Any]]:
+        """features()/predict core over an arbitrary record stream —
+        the Spark path hands partition records in over the feed daemon
+        (OP_EXTRACT) while the local path streams source.records()."""
         import jax
         self._init_params()
-        net = self.solver.test_net or self.solver.train_net
-
-        # predict(blobNames) semantics (CaffeNet.cpp:677-697): forward,
-        # then read ANY named blob — not just the net outputs
-        @jax.jit
-        def fwd(params, inputs):
-            blobs, _ = net.apply(params, inputs, train=False)
-            return {bn: blobs[bn] for bn in blob_names}
+        source = source or self.feature_source()
+        assert source is not None, "no data layer to decode records with"
+        fwd = self._feature_fwd(tuple(blob_names))
         rows: List[Dict[str, Any]] = []
         buf: List = []
         ids: List[str] = []
@@ -392,7 +438,7 @@ class CaffeProcessor:
                 rows.append(row)
             buf, ids = [], []
 
-        for rec in source.records():
+        for rec in records:
             buf.append(rec)
             ids.append(str(rec[0]) if isinstance(rec, tuple)
                        else str(rec.get("id", len(ids))))

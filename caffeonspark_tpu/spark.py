@@ -94,8 +94,8 @@ class SparkEngine:
     def app_id(self) -> str:
         return getattr(self.sc, "applicationId", "") or ""
 
-    def setup(self, *, interleave_validation: bool = False
-              ) -> List[Dict[str, Any]]:
+    def setup(self, *, interleave_validation: bool = False,
+              start_training: bool = True) -> List[Dict[str, Any]]:
         """Start processors on every executor, multi-host mesh up.
 
         Each executor also starts a FeedDaemon (spark_daemon.py): Spark
@@ -109,6 +109,7 @@ class SparkEngine:
         port = coordinator_port(self.app_id)
         app_id = self.app_id
         interleave = interleave_validation
+        training = start_training
 
         def start(it):
             ctx = _get_barrier_context()
@@ -124,7 +125,13 @@ class SparkEngine:
                 distributed_init(f"{coord_host}:{port}", n, rank)
             proc = CaffeProcessor.instance(conf, rank=rank)
             proc.interleave_validation = interleave
-            proc.start()
+            if training:
+                proc.start()
+            else:
+                # features/test mode (features2, :445-506): params come
+                # from -weights/-snapshot, no solver thread — the daemon
+                # serves EXTRACT requests
+                proc._init_params()
             proc._feed_daemon = FeedDaemon(proc, app_id, rank=rank)
             yield {"rank": rank, "host": socket.gethostname(),
                    "feed_port": proc._feed_daemon.port}
@@ -181,6 +188,41 @@ class SparkEngine:
             yield fed
 
         return sum(rdd.mapPartitionsWithIndex(feed).collect())
+
+    def features_partitions(self, rdd, blob_names=None):
+        """features()/test() over the cluster: each task ships its
+        partition's records to the host-local daemon, the
+        executor-resident net runs predict, rows come back to the
+        driver (featureRDD construction, CaffeOnSpark.scala:483-505).
+        Returns the collected rows (SampleID + per-blob lists)."""
+        app_id = self.app_id
+        n = self.cluster_size
+        names = list(blob_names) if blob_names else None
+
+        def extract(idx, it):
+            from .spark_daemon import FeedClient, strict_rank_enabled
+            client = FeedClient.discover(app_id, rank=idx % n)
+            if client is None:
+                if strict_rank_enabled():
+                    raise RuntimeError(
+                        f"strict rank pinning: no responsive feed "
+                        f"daemon for rank {idx % n} on this host")
+                from .processor import CaffeProcessor
+                try:
+                    proc = CaffeProcessor.instance()
+                except Exception as e:
+                    raise RuntimeError(
+                        "no feed daemon port file and no in-process "
+                        "CaffeProcessor — was setup() run?") from e
+                nm = names or proc.default_feature_blobs()
+                yield from proc.extract_rows(it, nm)
+                return
+            try:
+                yield from client.extract(it, names)
+            finally:
+                client.close()
+
+        return rdd.mapPartitionsWithIndex(extract).collect()
 
     def collect_report(self, rank: int = 0) -> Optional[Dict[str, Any]]:
         """Processor progress + validation rows from one executor (the

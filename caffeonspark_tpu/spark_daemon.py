@@ -53,6 +53,7 @@ OP_EPOCH_END = 2
 OP_PING = 3
 OP_STOP = 4
 OP_REPORT = 5        # -> length-prefixed pickled status/validation
+OP_EXTRACT = 6       # (blob_names|None, records) -> pickled rows
 
 _HDR = struct.Struct("<BI")
 _LEN = struct.Struct("<I")
@@ -123,7 +124,22 @@ class FeedDaemon:
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
+    def _extract_chunk(self, buf: list, blob_names, records,
+                       final: bool) -> list:
+        """Connection-scoped extraction: run only FULL batches until
+        the final chunk; the single ragged tail pads once, matching the
+        local extract_features semantics."""
+        proc = self.processor
+        names = blob_names or proc.default_feature_blobs()
+        buf.extend(records)
+        src = proc.feature_source()
+        bs = src.batch_size if src is not None else len(buf) or 1
+        take = len(buf) if final else len(buf) // bs * bs
+        batch, buf[:] = buf[:take], buf[take:]
+        return proc.extract_rows(batch, names) if batch else []
+
     def _serve(self, conn: socket.socket):
+        extract_buf: list = []
         try:
             while True:
                 op, ln = _HDR.unpack(_recv_exact(conn, _HDR.size))
@@ -145,6 +161,28 @@ class FeedDaemon:
                     threading.Thread(target=self._stop_all,
                                      daemon=True).start()
                     break
+                elif op == OP_EXTRACT:
+                    # features()/test() over Spark: the task ships its
+                    # partition's records here; the processor-resident
+                    # net runs predict and rows go back pickled
+                    # (doFeatures, CaffeProcessor.scala:473-523).
+                    # Records BUFFER across a connection's chunks and
+                    # only full batches run until the final flag — a
+                    # per-chunk ragged pad would duplicate records into
+                    # every batch and bias aggregated blobs (Accuracy)
+                    blob_names, records, final = payload
+                    try:
+                        rows = self._extract_chunk(
+                            extract_buf, blob_names, records, final)
+                        blob = pickle.dumps(rows)
+                        conn.sendall(b"\x01" + _LEN.pack(len(blob))
+                                     + blob)
+                    except Exception as e:  # noqa: BLE001 — to client
+                        blob = pickle.dumps(repr(e))
+                        conn.sendall(b"\x00" + _LEN.pack(len(blob))
+                                     + blob)
+                        break
+                    continue
                 elif op == OP_REPORT:
                     # the driver-side window into the executor-resident
                     # processor: progress + validation rows
@@ -284,6 +322,36 @@ class FeedClient:
 
     def epoch_end(self, queue_idx: int) -> bool:
         return self._request(OP_EPOCH_END, queue_idx)
+
+    def extract(self, records: Iterable,
+                blob_names: Optional[List[str]] = None) -> list:
+        """Ship records to the daemon's processor for feature
+        extraction; returns the rows (chunked like feed)."""
+        rows: list = []
+        chunk: list = []
+
+        # one framed request per chunk; the daemon buffers partials and
+        # runs full batches only, so chunking never pads mid-stream —
+        # `final` flushes the one true ragged tail
+        def _request_rows(c, final):
+            blob = pickle.dumps((blob_names, c, final))
+            self._sock.sendall(_HDR.pack(OP_EXTRACT, len(blob)) + blob)
+            status = _recv_exact(self._sock, 1)
+            ln = _LEN.unpack(_recv_exact(self._sock, _LEN.size))[0]
+            payload = pickle.loads(_recv_exact(self._sock, ln))
+            if status != b"\x01":
+                raise RuntimeError(
+                    f"feature extraction failed on the daemon: "
+                    f"{payload}")
+            return payload
+
+        for rec in records:
+            chunk.append(rec)
+            if len(chunk) == CHUNK:
+                rows.extend(_request_rows(chunk, False))
+                chunk = []
+        rows.extend(_request_rows(chunk, True))
+        return rows
 
     def report(self) -> Optional[dict]:
         """Processor status + validation rows from the daemon's host

@@ -162,11 +162,13 @@ def test_interleave_local4(sc, tmp_path):
 
 
 def test_python_api_train_then_test(sc, tmp_path):
-    """PythonApiTest analog: full train over Spark, then test() on the
-    rank-0 final snapshot — accuracy > 0.9 (PythonApiTest.py:45)."""
-    from caffeonspark_tpu.caffe_on_spark import CaffeOnSpark
+    """PythonApiTest analog: full train over Spark, then test() ALSO
+    over Spark — partition records ship to the executor's daemon
+    (EXTRACT op), predict runs on the executor-resident net loaded from
+    the rank-0 final snapshot; accuracy > 0.9 (PythonApiTest.py:45).
+    Mean-over-rows is the reference's own test() semantics (aggregated
+    outputs repeat per row, CaffeOnSpark.scala:499-507 + VectorMean)."""
     from caffeonspark_tpu.config import Config
-    from caffeonspark_tpu.data import get_source
     from caffeonspark_tpu.spark import SparkEngine
 
     conf = _lenet_conf(tmp_path, max_iter=400)
@@ -187,9 +189,15 @@ def test_python_api_train_then_test(sc, tmp_path):
     model = tmp_path / "out" / "lenet_iter_400.caffemodel"
     assert model.exists(), list((tmp_path / "out").iterdir())
 
-    test_conf = Config(["-conf", conf.protoFile, "-test",
-                        "-weights", str(model), "-devices", "1"])
-    src = get_source(test_conf.test_data_layer(), phase_train=False,
-                     seed=0)
-    res = CaffeOnSpark(sc).test(src, test_conf)
-    assert res["accuracy"][0] > 0.9, res
+    test_conf = Config(["-conf", conf.protoFile, "-features",
+                        "accuracy", "-weights", str(model),
+                        "-devices", "1", "-clusterSize", "1"])
+    engine2 = SparkEngine(sc, test_conf)
+    engine2.setup(start_training=False)
+    val = _lmdb_records(tmp_path / "mnist_test_lmdb")
+    rows = engine2.features_partitions(sc.parallelize(val, 2),
+                                       ["accuracy"])
+    engine2.shutdown()
+    assert len(rows) == len(val)
+    acc = sum(r["accuracy"][0] for r in rows) / len(rows)
+    assert acc > 0.9, acc

@@ -482,3 +482,59 @@ layer { name: "accuracy" type: "Accuracy" bottom: "ip" bottom: "label"
     while CaffeProcessor._instance is not None and time.time() < deadline:
         time.sleep(0.1)
     assert CaffeProcessor._instance is None
+
+
+def test_engine_features_extraction(conf, monkeypatch, tmp_path):
+    """features() over the engine: partition records ship to the
+    daemon's EXTRACT op, the executor-resident net runs predict, and
+    the rows match a direct in-process extraction bit-for-bit (the
+    featureRDD path, CaffeOnSpark.scala:483-505)."""
+    monkeypatch.setattr(
+        spark_mod, "_get_barrier_context",
+        lambda: _FakeBarrierContext._local.ctx)
+    monkeypatch.setenv("COS_FEED_DIR", str(tmp_path))
+
+    fconf = Config(["-conf", conf.protoFile, "-features", "ip"])
+    sc = _FakeSparkContext()
+    engine = SparkEngine(sc, fconf, require=False)
+    plan = engine.setup(start_training=False)
+    assert plan[0]["feed_port"] > 0
+    proc = CaffeProcessor.instance()
+    assert proc._thread is None          # no solver thread in this mode
+
+    recs = _records(40, seed=9)
+    rows = engine.features_partitions(
+        _FakeRDD([recs[:20], recs[20:]]), ["ip"])
+    assert len(rows) == 40
+    assert [r["SampleID"] for r in rows] == [r[0] for r in recs]
+    assert all(len(r["ip"]) == 10 for r in rows)
+
+    # bit-for-bit vs the direct in-process path on the same processor
+    direct = proc.extract_rows(recs, ["ip"])
+    for a, b in zip(rows, direct):
+        assert a["SampleID"] == b["SampleID"]
+        np.testing.assert_array_equal(np.asarray(a["ip"]),
+                                      np.asarray(b["ip"]))
+
+    # default blob names come from the net outputs when none given
+    rows2 = engine.features_partitions(_FakeRDD([recs[:16]]))
+    assert rows2 and "loss" in rows2[0]
+    engine.shutdown()
+
+
+def test_engine_features_bad_blob_surfaces_error(conf, monkeypatch,
+                                                 tmp_path):
+    """A bad blob name must come back as an actionable error, not an
+    opaque dropped connection."""
+    monkeypatch.setattr(
+        spark_mod, "_get_barrier_context",
+        lambda: _FakeBarrierContext._local.ctx)
+    monkeypatch.setenv("COS_FEED_DIR", str(tmp_path))
+    fconf = Config(["-conf", conf.protoFile, "-features", "ip"])
+    engine = SparkEngine(_FakeSparkContext(), fconf, require=False)
+    engine.setup(start_training=False)
+    with pytest.raises(RuntimeError,
+                       match="feature extraction failed"):
+        engine.features_partitions(_FakeRDD([_records(8, seed=1)]),
+                                   ["no_such_blob"])
+    engine.shutdown()
