@@ -25,6 +25,7 @@ Reference equivalents: caffe-public layer implementations consumed via
 from __future__ import annotations
 
 import math
+import os
 import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -1108,6 +1109,22 @@ def _mha_params(lp, shapes):
             ("W_o", (d_model, h * hd), wf)]
 
 
+def _attention_dispatch(q, k, v, *, causal: bool):
+    """Flash (Pallas, O(block·T) VMEM) on TPU when the shape tiles;
+    XLA einsum attention otherwise — numerically the same math
+    (tests/test_pallas.py flash parity)."""
+    from .pallas_kernels import flash_attention, pallas_enabled
+    t = q.shape[2]
+    # only 128-aligned sequence lengths take the kernel: Mosaic block
+    # shapes must tile (8, 128), and at small T the O(T²) XLA path is
+    # cheap anyway
+    if (pallas_enabled() and not os.environ.get("COS_DISABLE_FLASH")
+            and t % 128 == 0):
+        return flash_attention(q, k, v, causal, 128, 128)
+    from ..parallel.sp import attention as _plain_attention
+    return _plain_attention(q, k, v, causal=causal)
+
+
 @register("MultiHeadAttention", params=_mha_params)
 def _mha(ctx, lp, params, bottoms):
     """Multi-head self-attention on time-major (T, B, D) input —
@@ -1126,8 +1143,7 @@ def _mha(ctx, lp, params, bottoms):
     # (B, H, T, hd)
     q, k, v = (jnp.moveaxis(qkv[:, :, i], (0, 1, 2), (2, 0, 1))
                for i in range(3))
-    from ..parallel.sp import attention as _plain_attention
-    o = _plain_attention(q, k, v, causal=bool(ap.causal))
+    o = _attention_dispatch(q, k, v, causal=bool(ap.causal))
     # back to (T, B, H*hd)
     o = jnp.moveaxis(o, (0, 1, 2), (1, 2, 0)).reshape(t_steps, batch,
                                                       h * hd)

@@ -15,6 +15,7 @@ path — numerically identical, see tests/test_pallas.py).
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -167,3 +168,236 @@ def pallas_enabled() -> bool:
         return jax.default_backend() in ("tpu", "axon")
     except Exception:
         return False
+
+
+# ---------------------------------------------------------------------------
+# Flash attention (blockwise online-softmax), fwd + bwd kernels
+# ---------------------------------------------------------------------------
+# The MultiHeadAttention hot path: XLA materializes the (T, T) score
+# matrix in HBM for both passes; these kernels keep one (block_q, T)
+# strip of scores in VMEM and stream K/V blocks past it (the standard
+# flash decomposition: running max m, normalizer l, f32 accumulator).
+# Memory: O(block·T) VMEM instead of O(T²) HBM — within a device this
+# is the same trick ring attention plays across devices (parallel/sp.py
+# accumulate(), same m/l/corr algebra), so the two compose: ring over
+# device shards, flash within a shard.
+#
+# Layout: q,k,v (B, H, T, D) flattened to (B·H, T, D); grid =
+# (B·H, T/block).  K/V block specs expose the full (T, D) per head —
+# VMEM-bounded at T·D·4 bytes ≈ 4 MB at T=8k, D=128 f32 (longer
+# sequences belong to ring attention's shards anyway).
+
+BLOCK_Q = 128
+BLOCK_K = 128
+_NEG_INF = -1e30          # finite mask value: -inf NaNs the m-corr path
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      sm_scale: float, causal: bool, block_k: int):
+    q = q_ref[0].astype(jnp.float32)            # (block_q, D)
+    t = k_ref.shape[1]
+    block_q = q.shape[0]
+    qi = pl.program_id(1)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(i, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, kb.T,
+                    preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jnp.dot(
+            p, vb, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # K/V blocks starting past this q block's last row are fully
+        # masked — skipping them halves the causal pass's work
+        n_k = ((qi + 1) * block_q + block_k - 1) // block_k
+    else:
+        n_k = t // block_k
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    a0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, a0))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          delta_ref, dk_ref, dv_ref, *, sm_scale: float,
+                          causal: bool, block_q: int):
+    kb = k_ref[0].astype(jnp.float32)           # (block_k, D)
+    vb = v_ref[0].astype(jnp.float32)
+    t = q_ref.shape[1]
+    block_k = kb.shape[0]
+    ki = pl.program_id(1)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        dlt = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = jnp.dot(qb, kb.T,
+                    preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])            # exact probabilities
+        dv_new = dv + jnp.dot(p.T, dob,
+                              preferred_element_type=jnp.float32)
+        dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt[:, None]) * sm_scale
+        dk_new = dk + jnp.dot(ds.T, qb,
+                              preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    z = jnp.zeros((block_k, kb.shape[-1]), jnp.float32)
+    # causal: q blocks ending before this k block's first row see only
+    # masked scores — start at the diagonal
+    i0 = (ki * block_k) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(i0, t // block_q, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dq_ref, *, sm_scale: float,
+                         causal: bool, block_k: int):
+    qb = q_ref[0].astype(jnp.float32)            # (block_q, D)
+    dob = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    dlt = delta_ref[0]
+    t = k_ref.shape[1]
+    block_q = qb.shape[0]
+    qi = pl.program_id(1)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(i, dq):
+        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(qb, kb.T,
+                    preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt[:, None]) * sm_scale
+        return dq + jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+
+    if causal:
+        n_k = ((qi + 1) * block_q + block_k - 1) // block_k
+    else:
+        n_k = t // block_k
+    dq = jax.lax.fori_loop(0, n_k, body,
+                           jnp.zeros((block_q, qb.shape[-1]),
+                                     jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_specs(block, d, t):
+    qspec = pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0))
+    kvspec = pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0))
+    vec = pl.BlockSpec((1, block), lambda b, i: (b, i))
+    vec_full = pl.BlockSpec((1, t), lambda b, i: (b, 0))
+    return qspec, kvspec, vec, vec_full
+
+
+def _flash_fwd_call(q, k, v, sm_scale, causal, block_q, block_k,
+                    interpret):
+    bh, t, d = q.shape
+    kern = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
+                             causal=causal, block_k=block_k)
+    qspec, kvspec, vec, _ = _flash_specs(block_q, d, t)
+    return pl.pallas_call(
+        kern,
+        out_shape=(jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, t), jnp.float32)),
+        grid=(bh, t // block_q),
+        in_specs=[qspec, kvspec, kvspec],
+        out_specs=(qspec, vec),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, block_q: int = BLOCK_Q,
+                    block_k: int = BLOCK_K,
+                    interpret: bool = False) -> jax.Array:
+    """Fused blockwise attention, (B, H, T, D) → (B, H, T, D).
+
+    Same math as parallel.sp.attention (softmax(QKᵀ/√D)V, optional
+    causal mask); O(block·T) VMEM instead of an O(T²) HBM score
+    matrix, exact (not approximate) via online softmax.  Requires T
+    divisible by the block sizes — callers fall back to the XLA path
+    otherwise (ops.layers._mha)."""
+    b, h, t, d = q.shape
+    sm_scale = 1.0 / math.sqrt(d)
+    qf, kf, vf = (x.reshape(b * h, t, d) for x in (q, k, v))
+    out, _ = _flash_fwd_call(qf, kf, vf, sm_scale, causal, block_q,
+                             block_k, interpret)
+    return out.reshape(b, h, t, d)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    b, h, t, d = q.shape
+    sm_scale = 1.0 / math.sqrt(d)
+    qf, kf, vf = (x.reshape(b * h, t, d) for x in (q, k, v))
+    out, lse = _flash_fwd_call(qf, kf, vf, sm_scale, causal, block_q,
+                               block_k, interpret)
+    return out.reshape(b, h, t, d), (qf, kf, vf, out, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, do):
+    qf, kf, vf, out, lse = res
+    bh, t, d = qf.shape
+    dof = do.reshape(bh, t, d)
+    sm_scale = 1.0 / math.sqrt(d)
+    # delta = rowsum(dO ∘ O): cheap elementwise+reduce, XLA fuses it
+    delta = jnp.sum(dof.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+
+    qspec, kvspec, vec, vec_full = _flash_specs(block_q, d, t)
+    kspec_b, _, _, _ = _flash_specs(block_k, d, t)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
+                          causal=causal, block_k=block_k),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
+        grid=(bh, t // block_q),
+        in_specs=[qspec, kvspec, kvspec, qspec, vec, vec],
+        out_specs=qspec,
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q),
+        out_shape=(jax.ShapeDtypeStruct((bh, t, d), kf.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), vf.dtype)),
+        grid=(bh, t // block_k),
+        in_specs=[kvspec, kspec_b, kspec_b, kvspec, vec_full, vec_full],
+        out_specs=(kspec_b, kspec_b),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+    shape = do.shape
+    return (dq.reshape(shape), dk.reshape(shape), dv.reshape(shape))
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
