@@ -1,0 +1,86 @@
+"""Long-context training demo: sequence parallelism end to end.
+
+    python examples/long_context.py [seq_len]
+
+Trains the zoo's causal transformer LM on synthetic token streams with
+the TIME axis sharded over an `sp` mesh (ring attention semantics —
+the capability the reference lacks entirely, SURVEY §2.7/§5.7) and
+prints the loss curve plus a parity check against the unsharded step.
+Runs anywhere: on CPU it builds a virtual 8-device mesh
+(`XLA_FLAGS=--xla_force_host_platform_device_count=8`); on a TPU pod
+slice the same code shards over real chips, and 128-aligned sequence
+lengths dispatch MultiHeadAttention to the Pallas flash kernel
+(O(block·T) VMEM) automatically.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))      # run in-repo without install
+
+
+def main(seq_len: int = 32):
+    import jax
+    if "xla_force_host_platform_device_count" in os.environ.get(
+            "XLA_FLAGS", ""):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from caffeonspark_tpu.models import transformer_lm
+    from caffeonspark_tpu.parallel import ParallelSolver, build_mesh
+    from caffeonspark_tpu.proto import SolverParameter
+    from caffeonspark_tpu.solver import Solver
+
+    n_dev = len(jax.devices())
+    sp_n = max(s for s in (1, 2, 4) if n_dev % s == 0 and s <= seq_len)
+    dp_n = max(1, n_dev // sp_n)
+    batch = 2 * dp_n
+    print(f"devices={n_dev}  mesh dp={dp_n} x sp={sp_n}  "
+          f"seq={seq_len}  batch={batch}")
+
+    npm = transformer_lm(vocab=64, d_model=32, heads=2, layers=2,
+                         seq=seq_len, batch=batch)
+    sp_txt = ("base_lr: 0.01 momentum: 0.9 lr_policy: 'fixed' "
+              "type: 'ADAM' random_seed: 5")
+
+    rng = np.random.RandomState(0)
+    seqs = rng.randint(0, 60, (seq_len, batch)).astype(np.float32)
+    data = {"input_sentence": jnp.asarray(seqs),
+            "target_sentence": jnp.asarray((seqs + 1) % 60)}
+
+    # sequence-parallel step: T sharded over sp, batch over dp
+    mesh = build_mesh(dp=dp_n, sp=sp_n)
+    solver = Solver(SolverParameter.from_text(sp_txt), npm)
+    ps = ParallelSolver(solver, mesh)
+    sh = NamedSharding(mesh, P("sp", "dp"))
+    params, st = ps.init()
+    step = jax.jit(
+        solver.train_step_fn(), donate_argnums=(0, 1),
+        in_shardings=(ps.param_sharding,
+                      type(st)(iter=ps.repl, history=ps.param_sharding,
+                               history2=ps.param_sharding),
+                      {k: sh for k in data}, ps.repl))
+
+    # unsharded reference for the parity line
+    ref = Solver(SolverParameter.from_text(sp_txt), npm)
+    p_ref, st_ref = ref.init()
+    step_ref = ref.jit_train_step()
+
+    sharded = {k: jax.device_put(v, sh) for k, v in data.items()}
+    for i in range(10):
+        r = solver.step_rng(i)
+        params, st, out = step(params, st, sharded, r)
+        p_ref, st_ref, out_ref = step_ref(p_ref, st_ref, data, r)
+        loss = float(jax.device_get(out["loss"]))
+        delta = abs(loss - float(jax.device_get(out_ref["loss"])))
+        print(f"iter {i:2d}  loss {loss:.4f}  "
+              f"|sp - single-device| = {delta:.2e}")
+        assert delta < 1e-3 * max(1.0, abs(loss))
+    print("sequence-parallel training matches the single-device step")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 32)

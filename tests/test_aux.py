@@ -197,3 +197,19 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
     # untrained conv features of the synthetic gratings still beat
     # 10-class chance (0.1) by a wide margin
     assert acc > 0.25, acc
+
+
+def test_long_context_example(tmp_path):
+    """examples/long_context.py end-to-end on the virtual mesh:
+    sequence-parallel transformer training, parity line asserted
+    inside the script."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": "",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "PYTHONPATH": "/root/repo"}
+    r = subprocess.run(
+        [sys.executable, "examples/long_context.py", "16"],
+        capture_output=True, text=True, timeout=520, env=env,
+        cwd="/root/repo")
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-800:])
+    assert "matches the single-device step" in r.stdout
