@@ -24,6 +24,7 @@ Reference equivalents: caffe-public layer implementations consumed via
 
 from __future__ import annotations
 
+import contextlib
 import math
 import os
 import zlib
@@ -1109,16 +1110,34 @@ def _mha_params(lp, shapes):
             ("W_o", (d_model, h * hd), wf)]
 
 
+_FLASH_SUPPRESS = 0      # >0 while tracing a multi-device SPMD step
+
+
+@contextlib.contextmanager
+def suppress_flash():
+    """Disable the flash-attention dispatch for the duration (used by
+    ParallelSolver while tracing multi-device steps: a pallas_call is
+    opaque to the GSPMD partitioner, which would replicate it and
+    all-gather its sharded operands)."""
+    global _FLASH_SUPPRESS
+    _FLASH_SUPPRESS += 1
+    try:
+        yield
+    finally:
+        _FLASH_SUPPRESS -= 1
+
+
 def _attention_dispatch(q, k, v, *, causal: bool):
-    """Flash (Pallas, O(block·T) VMEM) on TPU when the shape tiles;
-    XLA einsum attention otherwise — numerically the same math
-    (tests/test_pallas.py flash parity)."""
+    """Flash (Pallas, O(block·T) VMEM) on TPU when the shape tiles and
+    the step isn't sharded over devices; XLA einsum attention otherwise
+    — numerically the same math (tests/test_pallas.py flash parity)."""
     from .pallas_kernels import flash_attention, pallas_enabled
     t = q.shape[2]
     # only 128-aligned sequence lengths take the kernel: Mosaic block
     # shapes must tile (8, 128), and at small T the O(T²) XLA path is
     # cheap anyway
-    if (pallas_enabled() and not os.environ.get("COS_DISABLE_FLASH")
+    if (pallas_enabled() and not _FLASH_SUPPRESS
+            and not os.environ.get("COS_DISABLE_FLASH")
             and t % 128 == 0):
         return flash_attention(q, k, v, causal, 128, 128)
     from ..parallel.sp import attention as _plain_attention

@@ -124,14 +124,19 @@ class ParallelSolver:
                         history=hist, history2=hist2)
 
     def input_shardings(self, net: Optional[Net] = None) -> Dict[str, NamedSharding]:
-        """Batch-sharded over dp; time-major tops shard their axis 1."""
+        """Batch-sharded over dp; time-major (T, B, ·) tops shard batch
+        on axis 1 and — when the mesh has an sp axis — their TIME axis
+        over sp (sequence parallelism: attention/scan math under GSPMD
+        partitions along T; see examples/long_context.py)."""
         net = net or self.solver.train_net
+        has_sp = dict(self.mesh.shape).get("sp", 1) > 1
         out = {}
         for name, shape, kind in net.input_specs:
-            ax = 1 if kind.endswith(":T") else 0
-            spec = [None] * (ax + 1)
-            spec[ax] = "dp"
-            out[name] = NamedSharding(self.mesh, P(*spec))
+            if kind.endswith(":T"):
+                spec = P("sp", "dp") if has_sp else P(None, "dp")
+            else:
+                spec = P("dp")
+            out[name] = NamedSharding(self.mesh, spec)
         return out
 
     def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Array]:
@@ -147,7 +152,7 @@ class ParallelSolver:
     def train_step(self):
         """Jitted SPMD step: donated params/opt, dp-sharded inputs."""
         if self._step is None:
-            base = self.solver.train_step_fn()
+            base = self._maybe_suppress_flash(self.solver.train_step_fn())
             in_sh = (
                 self.param_sharding,
                 OptState(iter=self.repl,
@@ -162,9 +167,24 @@ class ParallelSolver:
                                  out_shardings=out_sh)
         return self._step
 
+    def _maybe_suppress_flash(self, fn):
+        """An opaque pallas_call cannot be GSPMD-partitioned — under a
+        multi-device mesh XLA would replicate it (all-gathering the
+        sharded operands), so attention falls back to the partitionable
+        einsum path.  Flash stays on for single-device meshes (bench,
+        features, per-stage pipeline jits)."""
+        if self.mesh.devices.size <= 1:
+            return fn
+
+        def wrapped(*args, _f=fn):
+            from ..ops.layers import suppress_flash
+            with suppress_flash():   # active during jit TRACING
+                return _f(*args)
+        return wrapped
+
     def eval_step(self):
         if self._eval is None:
-            base = self.solver.eval_step_fn()
+            base = self._maybe_suppress_flash(self.solver.eval_step_fn())
             in_sh = (self.param_sharding,
                      self.input_shardings(self.solver.test_net))
             self._eval = jax.jit(base, in_shardings=in_sh,

@@ -3,14 +3,15 @@
     python examples/long_context.py [seq_len]
 
 Trains the zoo's causal transformer LM on synthetic token streams with
-the TIME axis sharded over an `sp` mesh (ring attention semantics —
-the capability the reference lacks entirely, SURVEY §2.7/§5.7) and
-prints the loss curve plus a parity check against the unsharded step.
-Runs anywhere: on CPU it builds a virtual 8-device mesh
-(`XLA_FLAGS=--xla_force_host_platform_device_count=8`); on a TPU pod
-slice the same code shards over real chips, and 128-aligned sequence
-lengths dispatch MultiHeadAttention to the Pallas flash kernel
-(O(block·T) VMEM) automatically.
+the TIME axis sharded over an `sp` mesh (the capability the reference
+lacks entirely, SURVEY §2.7/§5.7) and prints the loss curve plus a
+parity check against the unsharded step.  With no accelerator the
+script builds a virtual 8-device CPU mesh itself; on a TPU pod slice
+the same code shards over real chips.  The sharded step keeps
+attention on the GSPMD-partitionable einsum path (an opaque Pallas
+call can't be partitioned — ParallelSolver suppresses the flash
+dispatch on multi-device meshes); single-device runs with 128-aligned
+T use the Pallas flash kernel automatically.
 """
 
 import os
@@ -18,6 +19,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))      # run in-repo without install
+
+# no accelerator → virtual 8-device CPU mesh, BEFORE jax initializes
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", "") and not os.environ.get("COS_REAL_DEVICES"):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device"
+                                 "_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 
 def main(seq_len: int = 32):
@@ -27,7 +37,6 @@ def main(seq_len: int = 32):
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from caffeonspark_tpu.models import transformer_lm
     from caffeonspark_tpu.parallel import ParallelSolver, build_mesh
@@ -35,7 +44,8 @@ def main(seq_len: int = 32):
     from caffeonspark_tpu.solver import Solver
 
     n_dev = len(jax.devices())
-    sp_n = max(s for s in (1, 2, 4) if n_dev % s == 0 and s <= seq_len)
+    sp_n = max(s for s in (1, 2, 4)
+               if n_dev % s == 0 and seq_len % s == 0)
     dp_n = max(1, n_dev // sp_n)
     batch = 2 * dp_n
     print(f"devices={n_dev}  mesh dp={dp_n} x sp={sp_n}  "
@@ -51,28 +61,21 @@ def main(seq_len: int = 32):
     data = {"input_sentence": jnp.asarray(seqs),
             "target_sentence": jnp.asarray((seqs + 1) % 60)}
 
-    # sequence-parallel step: T sharded over sp, batch over dp
-    mesh = build_mesh(dp=dp_n, sp=sp_n)
+    # sequence-parallel step: ParallelSolver shards time-major inputs
+    # (T, B, ·) as P("sp", "dp") on an sp mesh — no hand-rolled jit
     solver = Solver(SolverParameter.from_text(sp_txt), npm)
-    ps = ParallelSolver(solver, mesh)
-    sh = NamedSharding(mesh, P("sp", "dp"))
+    ps = ParallelSolver(solver, build_mesh(dp=dp_n, sp=sp_n))
     params, st = ps.init()
-    step = jax.jit(
-        solver.train_step_fn(), donate_argnums=(0, 1),
-        in_shardings=(ps.param_sharding,
-                      type(st)(iter=ps.repl, history=ps.param_sharding,
-                               history2=ps.param_sharding),
-                      {k: sh for k in data}, ps.repl))
+    step = ps.train_step()
 
     # unsharded reference for the parity line
     ref = Solver(SolverParameter.from_text(sp_txt), npm)
     p_ref, st_ref = ref.init()
     step_ref = ref.jit_train_step()
 
-    sharded = {k: jax.device_put(v, sh) for k, v in data.items()}
     for i in range(10):
         r = solver.step_rng(i)
-        params, st, out = step(params, st, sharded, r)
+        params, st, out = step(params, st, ps.shard_batch(data), r)
         p_ref, st_ref, out_ref = step_ref(p_ref, st_ref, data, r)
         loss = float(jax.device_get(out["loss"]))
         delta = abs(loss - float(jax.device_get(out_ref["loss"])))

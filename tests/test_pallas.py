@@ -118,3 +118,41 @@ def test_flash_attention_bf16_inputs():
     assert out.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_flash_suppressed_under_multi_device_mesh(monkeypatch):
+    """The flash dispatch must stay off while tracing multi-device
+    steps (a pallas_call is opaque to the GSPMD partitioner) and on
+    for single-device ones."""
+    from caffeonspark_tpu.ops import layers as L
+    import caffeonspark_tpu.ops.pallas_kernels as pk
+    calls = []
+    monkeypatch.setattr(pk, "pallas_enabled", lambda: True)
+    monkeypatch.setattr(pk, "flash_attention",
+                        lambda q, *a, **k: calls.append(1) or q)
+    monkeypatch.delenv("COS_DISABLE_FLASH", raising=False)
+    q = jnp.zeros((1, 1, 128, 8), jnp.float32)
+    L._attention_dispatch(q, q, q, causal=True)
+    assert calls, "flash must engage when allowed"
+    calls.clear()
+    with L.suppress_flash():
+        L._attention_dispatch(q, q, q, causal=True)
+    assert not calls, "flash must be suppressed inside the guard"
+
+    # ParallelSolver wraps multi-device steps with the guard
+    from caffeonspark_tpu.parallel import ParallelSolver, build_mesh
+    from caffeonspark_tpu.proto import NetParameter, SolverParameter
+    from caffeonspark_tpu.solver import Solver
+    npm = NetParameter.from_text("""
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 8 channels: 1 height: 4 width: 4 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }""")
+    s = Solver(SolverParameter.from_text(
+        "base_lr: 0.01 random_seed: 1"), npm)
+    ps = ParallelSolver(s, build_mesh(dp=8))
+    probe = ps._maybe_suppress_flash(lambda: L._FLASH_SUPPRESS)
+    assert probe() == 1, "guard must be active inside wrapped steps"
+    assert L._FLASH_SUPPRESS == 0
