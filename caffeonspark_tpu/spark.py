@@ -63,6 +63,34 @@ def coordinator_port(app_id: str = "", base: int = 47770) -> int:
     return base + (zlib.crc32(app_id.encode()) % 199)
 
 
+def _discover_for_task(app_id: str, rank: int, partition_idx: int):
+    """Task-side resolution of where records go: the host-local daemon
+    for `rank` (strict pinning honored), else the same-process
+    CaffeProcessor fallback for local[*] worker reuse.  Returns
+    (client, None) or (None, processor); raises actionably otherwise.
+    Shared by the feed and features task closures."""
+    from .spark_daemon import FeedClient, strict_rank_enabled
+    client = FeedClient.discover(app_id, rank=rank)
+    if client is not None:
+        return client, None
+    if strict_rank_enabled():
+        raise RuntimeError(
+            f"strict rank pinning: no responsive feed daemon for rank "
+            f"{rank} on this host (UnionRDDWLocsSpecified contract). "
+            f"Either Spark placed partition {partition_idx} on the "
+            "wrong executor (relaunch with locality-pinned scheduling) "
+            "or that rank's daemon/processor died (check executor "
+            "logs); unset COS_FEED_STRICT_RANK to allow any-local "
+            "fallback")
+    from .processor import CaffeProcessor
+    try:
+        return None, CaffeProcessor.instance()
+    except Exception as e:
+        raise RuntimeError(
+            "no feed daemon port file and no in-process CaffeProcessor "
+            "— was setup() run?") from e
+
+
 def _get_barrier_context():
     """Indirection point: tests substitute a barrier-context double
     (pyspark doesn't exist in this image)."""
@@ -151,8 +179,7 @@ class SparkEngine:
         n = self.cluster_size
 
         def feed(idx, it):
-            from .spark_daemon import FeedClient, strict_rank_enabled
-            client = FeedClient.discover(app_id, rank=idx % n)
+            client, proc = _discover_for_task(app_id, idx % n, idx)
             if client is not None:
                 try:
                     fed = client.feed(queue_idx, it)
@@ -161,24 +188,6 @@ class SparkEngine:
                     client.close()
                 yield fed
                 return
-            if strict_rank_enabled():
-                raise RuntimeError(
-                    f"strict rank pinning: no responsive feed daemon "
-                    f"for rank {idx % n} on this host "
-                    "(UnionRDDWLocsSpecified contract). Either Spark "
-                    f"placed partition {idx} on the wrong executor "
-                    "(relaunch with locality-pinned scheduling) or "
-                    "that rank's daemon/processor died (check executor "
-                    "logs); unset COS_FEED_STRICT_RANK to allow "
-                    "any-local fallback")
-            # fallback: task shares the executor process
-            from .processor import CaffeProcessor
-            try:
-                proc = CaffeProcessor.instance()
-            except Exception as e:
-                raise RuntimeError(
-                    "no feed daemon port file and no in-process "
-                    "CaffeProcessor — was setup() run?") from e
             fed = 0
             for rec in it:
                 if not proc.feed_queue(queue_idx, rec):
@@ -200,20 +209,8 @@ class SparkEngine:
         names = list(blob_names) if blob_names else None
 
         def extract(idx, it):
-            from .spark_daemon import FeedClient, strict_rank_enabled
-            client = FeedClient.discover(app_id, rank=idx % n)
+            client, proc = _discover_for_task(app_id, idx % n, idx)
             if client is None:
-                if strict_rank_enabled():
-                    raise RuntimeError(
-                        f"strict rank pinning: no responsive feed "
-                        f"daemon for rank {idx % n} on this host")
-                from .processor import CaffeProcessor
-                try:
-                    proc = CaffeProcessor.instance()
-                except Exception as e:
-                    raise RuntimeError(
-                        "no feed daemon port file and no in-process "
-                        "CaffeProcessor — was setup() run?") from e
                 nm = names or proc.default_feature_blobs()
                 yield from proc.extract_rows(it, nm)
                 return
