@@ -192,6 +192,25 @@ BLOCK_K = 128
 _NEG_INF = -1e30          # finite mask value: -inf NaNs the m-corr path
 
 
+def _online_softmax_step(q, kb, vb, m, l, acc, *, sm_scale: float,
+                         causal: bool, q_pos, k_pos):
+    """One online-softmax accumulation (the flash/ring shared algebra):
+    scores for (q, kb) fold into the (m, l, acc) carry.  The m_safe
+    guard makes fully-masked-so-far rows accumulate exact zeros (a
+    no-op for rows that have seen the causal diagonal)."""
+    s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    m_safe = jnp.where(m_new <= _NEG_INF * 0.5, 0.0, m_new)
+    p = jnp.exp(s - m_safe[:, None])
+    corr = jnp.exp(m - m_safe)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[:, None] + jnp.dot(
+        p, vb, preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                       sm_scale: float, causal: bool, block_k: int):
     q = q_ref[0].astype(jnp.float32)            # (block_q, D)
@@ -205,19 +224,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         m, l, acc = carry
         kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
         vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, kb.T,
-                    preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            k_pos = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jnp.dot(
-            p, vb, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        k_pos = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        return _online_softmax_step(q, kb, vb, m, l, acc,
+                                    sm_scale=sm_scale, causal=causal,
+                                    q_pos=q_pos, k_pos=k_pos)
 
     if causal:
         # K/V blocks starting past this q block's last row are fully
@@ -313,10 +324,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _flash_specs(block, d, t):
-    qspec = pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0))
-    kvspec = pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0))
-    vec = pl.BlockSpec((1, block), lambda b, i: (b, i))
-    vec_full = pl.BlockSpec((1, t), lambda b, i: (b, 0))
+    # `*_` absorbs the scalar-prefetch refs appended to index-map args
+    # when these specs are used under a PrefetchScalarGridSpec
+    qspec = pl.BlockSpec((1, block, d), lambda b, i, *_: (b, i, 0))
+    kvspec = pl.BlockSpec((1, t, d), lambda b, i, *_: (b, 0, 0))
+    vec = pl.BlockSpec((1, block), lambda b, i, *_: (b, i))
+    vec_full = pl.BlockSpec((1, t), lambda b, i, *_: (b, 0))
     return qspec, kvspec, vec, vec_full
 
 
@@ -401,3 +414,87 @@ def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, do):
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Flash block-update: the ring-attention inner step as a fused kernel
+# ---------------------------------------------------------------------------
+# parallel/sp.py's ring rotates K/V shards around the ICI ring and
+# accumulates each incoming block with the same online-softmax algebra
+# the flash kernels use (m/l/corr).  Inside shard_map the code is
+# per-device, so a pallas_call is legal (no GSPMD partitioning of an
+# opaque call) — this kernel fuses one accumulate() step: VMEM-resident
+# score strip instead of a (T_local, T_local) HBM matrix per ring hop.
+# Forward-only (no custom VJP): callers opt in for inference/serving
+# paths (ring_attention(flash=...)); training keeps the einsum path.
+
+def _flash_carry_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
+                        m_ref, l_ref, a_ref, mo_ref, lo_ref, ao_ref, *,
+                        sm_scale: float, causal: bool, block_k: int):
+    q = q_ref[0].astype(jnp.float32)             # (block_q, D)
+    m = m_ref[0]
+    l = l_ref[0]
+    acc = a_ref[0].astype(jnp.float32)
+    t_k = k_ref.shape[1]
+    block_q = q.shape[0]
+    qi = pl.program_id(1)
+    q_pos = (qoff_ref[0] + qi * block_q
+             + jax.lax.broadcasted_iota(jnp.int32,
+                                        (block_q, block_k), 0))
+
+    def body(i, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        k_pos = (koff_ref[0] + i * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 1))
+        return _online_softmax_step(q, kb, vb, m, l, acc,
+                                    sm_scale=sm_scale, causal=causal,
+                                    q_pos=q_pos, k_pos=k_pos)
+
+    m, l, acc = jax.lax.fori_loop(0, t_k // block_k, body, (m, l, acc))
+    mo_ref[0] = m
+    lo_ref[0] = l
+    ao_ref[0] = acc.astype(ao_ref.dtype)
+
+
+def flash_block_update(q: jax.Array, k_blk: jax.Array,
+                       v_blk: jax.Array, m: jax.Array, l: jax.Array,
+                       acc: jax.Array, q_off, k_off, *, causal: bool,
+                       block_q: int = BLOCK_Q, block_k: int = BLOCK_K,
+                       interpret: bool = False):
+    """One ring-attention accumulate step, fused.
+
+    q (BH, Tq, D) stays fixed; (k_blk, v_blk) (BH, Tk, D) is the block
+    rotating past; (m, l, acc) is the online-softmax carry, updated and
+    returned.  q_off/k_off are the blocks' global time offsets (traced
+    int32 scalars — ring step index math), used for causal masking.
+    Same algebra as parallel/sp.py accumulate()."""
+    bh, t_q, d = q.shape
+    t_k = k_blk.shape[1]
+    if t_q % block_q or t_k % block_k:
+        # a truncated grid would return partly-uninitialized carries
+        raise ValueError(
+            f"flash_block_update needs T divisible by the blocks: "
+            f"t_q={t_q} % {block_q}, t_k={t_k} % {block_k}")
+    sm_scale = 1.0 / math.sqrt(d)
+    kern = functools.partial(_flash_carry_kernel, sm_scale=sm_scale,
+                             causal=causal, block_k=block_k)
+    qspec, kvspec, vec, _ = _flash_specs(block_q, d, t_k)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, t_q // block_q),
+        in_specs=[qspec, kvspec, kvspec, vec, vec, qspec],
+        out_specs=(vec, vec, qspec),
+    )
+    offs = (jnp.asarray([q_off], jnp.int32),
+            jnp.asarray([k_off], jnp.int32))
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct((bh, t_q), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, t_q), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, t_q, d), acc.dtype)),
+        interpret=interpret,
+    )(*offs, q, k_blk, v_blk, m, l, acc)

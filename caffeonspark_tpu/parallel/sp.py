@@ -47,7 +47,7 @@ def attention(q: Array, k: Array, v: Array, *, causal: bool = False,
 
 
 def _ring_attention_local(q: Array, k: Array, v: Array, *, axis_name: str,
-                          causal: bool) -> Array:
+                          causal: bool, flash=False) -> Array:
     """Per-shard body (inside shard_map): q,k,v are the LOCAL time blocks
     (B, H, T_local, D)."""
     n = lax.psum(1, axis_name)
@@ -56,8 +56,36 @@ def _ring_attention_local(q: Array, k: Array, v: Array, *, axis_name: str,
     t_k = k.shape[2]
     scale = 1.0 / math.sqrt(d)
     qpos = idx * t_q + jnp.arange(t_q)           # global query positions
+    def _flash_block(t):
+        """128 when it tiles; whole-shard for small shards; None =
+        shard shape unsuited (a whole-shard block would blow VMEM) —
+        fall back to the einsum accumulate, matching the MHA dispatch
+        convention."""
+        if t % 128 == 0:
+            return 128
+        if t <= 256 and t % 8 == 0:
+            return t
+        return None
+
+    bq, bk = _flash_block(t_q), _flash_block(t_k)
+    use_flash = bool(flash) and bq is not None and bk is not None
 
     def accumulate(m, l, o, k_blk, v_blk, src):
+        if use_flash:
+            # fused accumulate: shard_map bodies are per-device, so the
+            # pallas_call needs no GSPMD partitioning (unlike the MHA
+            # dispatch, which must suppress flash under SPMD meshes)
+            from ..ops.pallas_kernels import flash_block_update
+            bh = b * h
+            mf, lf, of = flash_block_update(
+                q.reshape(bh, t_q, d), k_blk.reshape(bh, t_k, d),
+                v_blk.reshape(bh, t_k, d), m.reshape(bh, t_q),
+                l.reshape(bh, t_q), o.reshape(bh, t_q, d),
+                idx * t_q, src * t_k, causal=causal,
+                block_q=bq, block_k=bk,
+                interpret=(flash == "interpret"))
+            return (mf.reshape(b, h, t_q), lf.reshape(b, h, t_q),
+                    of.reshape(b, h, t_q, d))
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
         if causal:
             kpos = src * t_k + jnp.arange(t_k)
@@ -85,23 +113,37 @@ def _ring_attention_local(q: Array, k: Array, v: Array, *, axis_name: str,
         return m, l, o, k_blk, v_blk
 
     # derive from q so the carry is device-varying like the loop outputs
-    # (shard_map VMA typing requires carry in/out types to match)
-    m0 = jnp.full_like(q[..., 0], -jnp.inf)
-    l0 = jnp.zeros_like(q[..., 0])
-    o0 = jnp.zeros_like(q)
+    # (shard_map VMA typing requires carry in/out types to match);
+    # the flash kernel carries m/l/acc in f32 regardless of input dtype
+    cdt = jnp.float32 if use_flash else q.dtype
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, cdt) + q[..., 0] * 0
+    l0 = jnp.zeros(q.shape[:-1], cdt) + q[..., 0] * 0
+    o0 = jnp.zeros(q.shape, cdt) + q * 0
     m, l, o = accumulate(m0, l0, o0, k, v, idx)
     m, l, o, _, _ = lax.fori_loop(1, n, body, (m, l, o, k, v))
-    return o / jnp.maximum(l, 1e-30)[..., None]
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
 
 
 def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh, *,
-                   causal: bool = False, axis_name: str = "sp") -> Array:
+                   causal: bool = False, axis_name: str = "sp",
+                   flash=False) -> Array:
     """Sequence-parallel attention: (B, H, T, D) with T sharded on
-    `axis_name`.  Returns output with the same sharding."""
+    `axis_name`.  Returns output with the same sharding.
+
+    flash: False (default, differentiable einsum accumulate) | True
+    (fused Pallas accumulate per ring hop — forward-only, for
+    long-context inference/serving) | "interpret" (tests on CPU)."""
     spec = P(None, None, axis_name, None)
+    kw = {}
+    if flash:
+        # a pallas_call's outputs carry no varying-mesh-axes metadata,
+        # which the default shard_map VMA checker rejects
+        kw["check_vma"] = False
     fn = shard_map(
-        partial(_ring_attention_local, axis_name=axis_name, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        partial(_ring_attention_local, axis_name=axis_name,
+                causal=causal, flash=flash),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kw)
     return fn(q, k, v)
 
 
