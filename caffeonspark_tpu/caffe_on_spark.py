@@ -18,6 +18,7 @@ pyspark, so that path is import-gated)."""
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import sys
@@ -86,12 +87,70 @@ class CaffeOnSpark:
         self.sc = sc
 
     # ------------------------------------------------------------------
+    def _engine(self, conf: Config):
+        """SparkEngine when `sc` is a usable SparkContext, else None
+        (local engine).  The reference has no such fork — Spark IS its
+        runtime; here local mode is first-class (TPU pods don't need a
+        JVM) and a real `sc` upgrades train/trainWithValidation/features
+        to the barrier-stage executor choreography transparently."""
+        from . import spark as spark_mod
+        if self.sc is None or not hasattr(self.sc, "parallelize") \
+                or not spark_mod.spark_available():
+            return None
+        return spark_mod.SparkEngine(self.sc, conf, require=False)
+
+    def _engine_rdd(self, engine, source: DataSource):
+        recs = list(source.records())
+        return self.sc.parallelize(
+            recs, max(1, engine.cluster_size * 2))
+
+    def _engine_run(self, engine, make_feed) -> dict:
+        """The driver re-feed loop (:204-227): feed, poll, repeat until
+        the executor solvers reach max_iter; then join + shutdown.
+        `make_feed` builds the per-round feed closure INSIDE the
+        try/finally so a failure materializing sources still tears the
+        executors down (orphaned daemons would hijack the app_id's next
+        run).  Raises unless training verifiably completed."""
+        rep = None
+        try:
+            feed_rounds = make_feed()
+            for _ in range(1000):
+                feed_rounds()
+                rep = engine.collect_report()
+                if rep is not None and not rep["alive"]:
+                    break
+            rep = engine.wait_done()
+        finally:
+            engine.shutdown()
+        if rep is not None and rep.get("error"):
+            raise RuntimeError(
+                f"executor solver failed: {rep['error']}")
+        if rep is None or rep.get("alive"):
+            raise RuntimeError(
+                "training did not complete: executor solver still "
+                "running (or unreachable) after the re-feed loop — "
+                "check executor logs / max_iter vs records fed")
+        return rep
+
+    # ------------------------------------------------------------------
     def train(self, source: DataSource, conf: Optional[Config] = None
               ) -> None:
         """Synchronous training over the mesh (CaffeOnSpark.train).
         The re-feed loop of the reference (:204-227, feeding the RDD
-        until max_iter) is the processor's looping source feed."""
+        until max_iter) is the processor's looping source feed; with a
+        real SparkContext the records stream through the barrier-stage
+        executors instead."""
         conf = conf or source_conf(source)
+        engine = self._engine(conf)
+        if engine is not None:
+            engine.setup()
+
+            def make_feed():
+                rdd = self._engine_rdd(engine, source)
+                return lambda: engine.feed_partitions(rdd, 0)
+
+            self._engine_run(engine, make_feed)
+            return
         proc = CaffeProcessor.instance(conf, rank=conf.rank)
         proc.start()
         try:
@@ -113,6 +172,32 @@ class CaffeOnSpark:
         if not test_interval or not test_iter:
             raise ValueError("trainWithValidation needs test_interval "
                              "and test_iter in the solver prototxt")
+        engine = self._engine(conf)
+        if engine is not None:
+            engine.setup(interleave_validation=True)
+
+            def make_feed():
+                train_rdd = self._engine_rdd(engine, source_train)
+                # one validation ROUND per feed round, sized exactly
+                # test_iter x batch (the fixed-size validation
+                # partition, CaffeOnSpark.scala:266,279-282): feeding
+                # the whole validation set each round would outrun the
+                # solver's per-interval drain and deadlock on queue-1
+                # backpressure
+                need = test_iter * source_validation.batch_size
+                val_round = list(itertools.islice(
+                    _record_loop(source_validation), need))
+                val_rdd = self.sc.parallelize(val_round, 1)
+
+                def rounds():
+                    engine.feed_partitions(train_rdd, 0)
+                    engine.feed_partitions(val_rdd, 1)
+                return rounds
+
+            rep = self._engine_run(engine, make_feed)
+            val = (rep or {}).get("validation") or {}
+            return DataFrame(val.get("rounds", []),
+                             val.get("names", []))
         proc = CaffeProcessor.instance(conf, rank=conf.rank)
         proc.interleave_validation = True
         proc.start()
@@ -163,15 +248,29 @@ class CaffeOnSpark:
     def features2(self, source: DataSource,
                   conf: Optional[Config] = None) -> DataFrame:
         conf = conf or source_conf(source)
-        proc = CaffeProcessor.instance(conf, rank=conf.rank)
-        if conf.features:
-            blob_names = [b.strip() for b in conf.features.split(",")
-                          if b.strip()]
-        else:
-            net = proc.solver.test_net or proc.solver.train_net
-            blob_names = list(net.output_blobs)
-        if conf.label:
+        blob_names = [b.strip() for b in conf.features.split(",")
+                      if b.strip()] if conf.features else None
+        if blob_names and conf.label and conf.label not in blob_names:
             blob_names.append(conf.label)
+        engine = self._engine(conf)
+        if engine is not None:
+            # executor-resident extraction (featureRDD, :483-505):
+            # params come from -weights/-snapshot, no solver thread;
+            # blob_names=None resolves daemon-side (net outputs +
+            # -label, default_feature_blobs)
+            engine.setup(start_training=False)
+            try:
+                rdd = self._engine_rdd(engine, source)
+                rows = engine.features_partitions(rdd, blob_names)
+            finally:
+                engine.shutdown()
+            names = (blob_names if blob_names else
+                     [c for c in (rows[0] if rows else {})
+                      if c != "SampleID"])
+            return DataFrame(rows, ["SampleID"] + list(names))
+        proc = CaffeProcessor.instance(conf, rank=conf.rank)
+        if blob_names is None:
+            blob_names = proc.default_feature_blobs()
         rows = proc.extract_features(source, blob_names)
         return DataFrame(rows, ["SampleID"] + blob_names)
 

@@ -363,7 +363,11 @@ class CaffeProcessor:
 
     def default_feature_blobs(self) -> List[str]:
         net = self.solver.test_net or self.solver.train_net
-        return list(net.output_blobs)
+        names = list(net.output_blobs)
+        label = getattr(self.conf, "label", "")
+        if label and label not in names:
+            names.append(label)     # -label column rides along
+        return names
 
     def feature_source(self) -> Optional[DataSource]:
         """Record decoder for feature extraction, ALWAYS test-phase:

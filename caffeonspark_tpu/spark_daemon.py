@@ -298,9 +298,19 @@ class FeedClient:
         return stopped
 
     def _request(self, op: int, payload) -> bool:
-        blob = pickle.dumps(payload) if payload is not None else b""
-        self._sock.sendall(_HDR.pack(op, len(blob)) + blob)
-        return _recv_exact(self._sock, 1) == b"\x01"
+        """False = refused OR the daemon hung up — a daemon that NAKs a
+        feed closes the connection right after, so the follow-up
+        epoch_end racing that close must degrade to False, not raise
+        (the processor stopping mid-feed is an ordinary end-of-run).
+        ONLY connection teardown degrades: a socket timeout during
+        ordinary backpressure must still raise, or a slow solver would
+        silently drop the rest of the partition."""
+        try:
+            blob = pickle.dumps(payload) if payload is not None else b""
+            self._sock.sendall(_HDR.pack(op, len(blob)) + blob)
+            return _recv_exact(self._sock, 1) == b"\x01"
+        except ConnectionError:
+            return False
 
     def feed(self, queue_idx: int, records: Iterable) -> int:
         """Stream records in chunks; returns count accepted before the

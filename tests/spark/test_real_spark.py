@@ -125,40 +125,26 @@ def _lmdb_records(path):
 
 
 def test_interleave_local4(sc, tmp_path):
-    """InterleaveTest analog: trainWithValidation through the real
-    barrier stage + feed daemon; final validation accuracy > 0.8 and
-    loss < 0.5 (the reference's own CI gates,
-    InterleaveTest.scala:53-55)."""
-    from caffeonspark_tpu.spark import SparkEngine
+    """InterleaveTest analog, through the same single-entry API the
+    reference test uses (cos.trainWithValidation; the facade detects
+    the real SparkContext and runs the barrier stage + feed daemon
+    choreography): final validation accuracy > 0.8 and loss < 0.5
+    (InterleaveTest.scala:53-55)."""
+    from caffeonspark_tpu.caffe_on_spark import CaffeOnSpark
+    from caffeonspark_tpu.data import get_source
 
     conf = _lenet_conf(tmp_path, max_iter=400, test_interval=200,
                        test_iter=10)
-    engine = SparkEngine(sc, conf)
-    plan = engine.setup(interleave_validation=True)
-    assert [p["rank"] for p in plan] == [0]
-
-    train = _lmdb_records(tmp_path / "mnist_train_lmdb")
-    val = _lmdb_records(tmp_path / "mnist_test_lmdb")
-    train_rdd = sc.parallelize(train, 4)
-    val_rdd = sc.parallelize(val[:10 * 100], 1)
-
-    rep = None
-    for _ in range(40):                 # driver re-feed loop (:204-227)
-        engine.feed_partitions(train_rdd, 0)
-        engine.feed_partitions(val_rdd, 1)
-        rep = engine.collect_report()
-        if rep is not None and not rep["alive"]:
-            break
-    rep = engine.wait_done(timeout=300)
-    engine.shutdown()
-
-    assert rep is not None and rep["alive"] is False
-    assert rep["validation"], "no validation rounds returned"
-    names = rep["validation"]["names"]
-    assert "accuracy" in names and "loss" in names
-    last = rep["validation"]["rounds"][-1]
-    assert last["accuracy"] > 0.8, rep["validation"]["rounds"]
-    assert last["loss"] < 0.5, rep["validation"]["rounds"]
+    train_src = get_source(conf.train_data_layer(), phase_train=True,
+                           seed=0)
+    val_src = get_source(conf.test_data_layer(), phase_train=False,
+                         seed=0)
+    df = CaffeOnSpark(sc).trainWithValidation(train_src, val_src, conf)
+    assert {"accuracy", "loss"} <= set(df.columns)
+    assert df.rows, "no validation rounds returned"
+    last = df.rows[-1]
+    assert last["accuracy"] > 0.8, df.rows
+    assert last["loss"] < 0.5, df.rows
 
 
 def test_python_api_train_then_test(sc, tmp_path):

@@ -538,3 +538,77 @@ def test_engine_features_bad_blob_surfaces_error(conf, monkeypatch,
         engine.features_partitions(_FakeRDD([_records(8, seed=1)]),
                                    ["no_such_blob"])
     engine.shutdown()
+
+
+def test_facade_dispatches_to_spark_engine(conf, monkeypatch, tmp_path):
+    """CaffeOnSpark(sc) with a usable SparkContext routes train /
+    trainWithValidation / features through SparkEngine transparently —
+    the reference's single-entry API (train(source) does everything),
+    no manual engine wiring."""
+    from caffeonspark_tpu import caffe_on_spark as cos_mod
+    from caffeonspark_tpu import spark as spark_mod2
+    from caffeonspark_tpu.data import get_source
+
+    monkeypatch.setattr(
+        spark_mod, "_get_barrier_context",
+        lambda: _FakeBarrierContext._local.ctx)
+    monkeypatch.setattr(spark_mod2, "spark_available", lambda: True)
+    monkeypatch.setenv("COS_FEED_DIR", str(tmp_path))
+
+    net = tmp_path / "net2.prototxt"
+    net.write_text("""
+name: "tiny"
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  include { phase: TRAIN }
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param { source: "%s" batch_size: 16
+    channels: 1 height: 28 width: 28 }
+  transform_param { scale: 0.00390625 } }
+layer { name: "tdata" type: "MemoryData" top: "data" top: "label"
+  include { phase: TEST }
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param { source: "%s" batch_size: 16
+    channels: 1 height: 28 width: 28 }
+  transform_param { scale: 0.00390625 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 10
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }
+layer { name: "accuracy" type: "Accuracy" bottom: "ip" bottom: "label"
+  top: "accuracy" include { phase: TEST } }
+""" % (tmp_path / "lmdb", tmp_path / "lmdb"))
+    solver = tmp_path / "solver2.prototxt"
+    solver.write_text(SOLVER.format(net=net, max_iter=8).replace(
+        "max_iter: 8", "max_iter: 8\ntest_interval: 4\ntest_iter: 2"))
+    tconf = Config(["-conf", str(solver), "-train"])
+
+    sc = _FakeSparkContext()
+    cos = cos_mod.CaffeOnSpark(sc)
+    train_src = get_source(tconf.train_data_layer(), phase_train=True,
+                           seed=0)
+    val_src = get_source(tconf.test_data_layer(), phase_train=False,
+                         seed=0)
+    df = cos.trainWithValidation(train_src, val_src, tconf)
+    assert set(df.columns) >= {"accuracy", "loss"}
+    assert len(df) == 2                       # validation at iters 4, 8
+
+    def _wait_teardown():
+        deadline = time.time() + 30
+        while CaffeProcessor._instance is not None \
+                and time.time() < deadline:
+            time.sleep(0.1)
+        assert CaffeProcessor._instance is None
+
+    # the engine path tears the processor down on completion (the
+    # daemon STOP acks first, teardown lands asynchronously)
+    _wait_teardown()
+
+    # features through the engine path (no solver thread)
+    fconf = Config(["-conf", str(solver), "-features", "ip",
+                    "-label", "label"])
+    fdf = cos_mod.CaffeOnSpark(sc).features(val_src, fconf)
+    assert fdf.columns == ["SampleID", "ip", "label"]
+    assert len(fdf) == 64
+    assert len(fdf.rows[0]["ip"]) == 10
+    _wait_teardown()
