@@ -345,10 +345,24 @@ def validation_source(conf: Config) -> Optional[DataSource]:
 # CLI (CaffeOnSpark.main, :27-84)
 # ---------------------------------------------------------------------------
 
+def _cli_spark_context(conf: Config):
+    """Under spark-submit the reference's main always runs with a
+    SparkContext; mirror that when a cluster is requested AND pyspark
+    exists.  Local/TPU-pod runs (clusterSize <= 1, or no pyspark) stay
+    on the first-class local engine — no JVM required."""
+    if conf.clusterSize <= 1:
+        return None
+    from . import spark as spark_mod
+    if not spark_mod.spark_available():
+        return None
+    from pyspark import SparkContext
+    return SparkContext.getOrCreate()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     conf = Config(argv if argv is not None else sys.argv[1:])
     conf.validate()
-    cos = CaffeOnSpark()
+    cos = CaffeOnSpark(_cli_spark_context(conf))
 
     if conf.isTraining:
         # the trained model is handed to a later -test/-features phase
