@@ -480,3 +480,21 @@ def test_ring_attention_flash_accumulate_matches(causal):
                          flash="interpret")
     np.testing.assert_allclose(np.asarray(jax.device_get(out)),
                                np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_flash_bf16(monkeypatch):
+    """bf16 activations through the fused ring accumulate: the f32
+    m/l/acc carry keeps error at bf16 resolution."""
+    mesh = build_mesh(dp=2, sp=4)
+    rng = np.random.RandomState(4)
+    b, h, t, d = 1, 2, 64, 16
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+    ref = attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                    v.astype(jnp.float32), causal=True)
+    out = ring_attention(q, k, v, mesh, causal=True, flash="interpret")
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(out), np.float32),
+        np.asarray(ref), rtol=3e-2, atol=3e-2)
