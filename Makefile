@@ -55,6 +55,7 @@ onchip-artifacts:
 	-BENCH_BATCH=64 BENCH_DTYPE=float32 $(PY) bench.py
 	-BENCH_FORWARD=1 $(PY) bench.py
 	-COS_STATE_DTYPE=bfloat16 $(PY) bench.py
+	-COS_CONV_LAYOUT=NHWC $(PY) bench.py
 	-mkdir -p bench_evidence && $(PY) scripts/profile_segments.py 256 \
 	  | tee bench_evidence/profile_segments_b256.txt
 	-BENCH_MODEL=resnet50 $(PY) bench.py

@@ -132,18 +132,28 @@ def combine_batches(batches: Iterator[Dict[str, np.ndarray]], k: int,
 
 
 def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], *,
-                    depth: int = 2, sharding=None
+                    depth: int = 2, sharding=None,
+                    device_transforms=None
                     ) -> Iterator[Dict[str, jax.Array]]:
     """Asynchronously stage `depth` batches onto the device (the
     double-buffered QueuePair analog). jax transfers are async: calling
     device_put for batch N+1 while N computes overlaps H2D with compute.
 
+    `device_transforms` ({top: fn(u8, aux) -> float}, from
+    Source.enable_device_transform) finishes the transform split: the
+    uint8 pixels + aux offsets cross the host->device link (4x fewer
+    bytes than float32) and the jitted mean/scale stage runs on device,
+    dispatched right behind the transfer so it overlaps like the
+    transfer itself.  Tops without an aux key pass through untouched.
+
     Multi-host: when the mesh spans processes, each process's batch is
     its LOCAL shard of the global batch (per-device batch semantics —
     'batch sizes in prototxt files are per device'); the global array is
     assembled with make_array_from_process_local_data."""
+    from .transformer import DEVICE_AUX_SUFFIX
     buf = collections.deque()
     multiproc = jax.process_count() > 1
+    jitted = {k: jax.jit(fn) for k, fn in (device_transforms or {}).items()}
 
     def put_one(v, sh):
         if sh is None:
@@ -152,9 +162,27 @@ def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], *,
             return jax.make_array_from_process_local_data(sh, v)
         return jax.device_put(v, sh)
 
+    def sh_for(k):
+        if not isinstance(sharding, dict):
+            return sharding
+        if k.endswith(DEVICE_AUX_SUFFIX):
+            # aux rides its top's batch-dim sharding (specs are P("dp"))
+            return sharding.get(k[:-len(DEVICE_AUX_SUFFIX)])
+        return sharding[k]  # unknown top = config error: fail fast
+
     def put(b):
-        return {k: put_one(v, sharding[k] if isinstance(sharding, dict)
-                           else sharding) for k, v in b.items()}
+        staged = {k: put_one(v, sh_for(k)) for k, v in b.items()}
+        if not jitted:
+            return staged
+        out = {}
+        for k, v in staged.items():
+            if k.endswith(DEVICE_AUX_SUFFIX):
+                continue
+            aux = staged.get(k + DEVICE_AUX_SUFFIX)
+            fn = jitted.get(k)
+            out[k] = fn(v, aux) if (fn is not None
+                                    and aux is not None) else v
+        return out
 
     for b in batches:
         buf.append(put(b))

@@ -24,7 +24,7 @@ import numpy as np
 from ..proto.caffe import Datum, LayerParameter
 from .lmdb_io import LmdbReader
 from .sequencefile import SequenceFileReader
-from .transformer import Transformer
+from .transformer import DEVICE_AUX_SUFFIX, Transformer
 
 ImageRecord = Tuple[str, float, int, int, int, bool, bytes]
 
@@ -91,6 +91,7 @@ class DataSource:
             layer.transform_param if layer.has("transform_param") else None,
             phase_train=phase_train, seed=seed + rank,
             mean_dir=os.path.dirname(self.source_uri()) or None)
+        self._device_transform = False
 
     # -- config ------------------------------------------------------------
     def _batch_size(self) -> int:
@@ -151,10 +152,56 @@ class DataSource:
                         data[i] = np.frombuffer(payload, np.uint8).astype(
                             np.float32).reshape(rc, rh, rw)
         out_names = list(self.layer.top)
-        batch = {out_names[0]: self.transformer(data)}
+        # device-transform split: ships uint8 + per-sample crop/flip aux.
+        # Requires pixel payloads (encoded image or uint8 buffer) — a
+        # float payload can't be losslessly narrowed, and a silent
+        # per-batch fallback would emit inconsistent key sets that
+        # combine_batches/iter_size would mis-merge, so fail fast.
+        if self._device_transform:
+            bad = next((r for r in records
+                        if not r[5] and isinstance(r[6], np.ndarray)
+                        and r[6].dtype != np.uint8), None)
+            if bad is not None:
+                raise ValueError(
+                    f"COS_DEVICE_TRANSFORM=1 needs uint8/encoded pixel "
+                    f"payloads, but record {bad[0]!r} carries "
+                    f"{bad[6].dtype} data — unset COS_DEVICE_TRANSFORM "
+                    "for float-valued sources")
+            u8, aux = self.transformer.host_stage(data)
+            batch = {out_names[0]: u8,
+                     out_names[0] + DEVICE_AUX_SUFFIX: aux}
+        else:
+            batch = {out_names[0]: self.transformer(data)}
         if len(out_names) > 1:
             batch[out_names[1]] = labels
         return batch
+
+    def enable_device_transform(self, net_dtype=None):
+        """Opt in to the uint8-infeed transform split: when
+        COS_DEVICE_TRANSFORM=1 and this source supports it, next_batch
+        emits uint8 pixels + aux offsets and the returned {top: jit-able
+        fn} runs mean/scale on the device (Transformer.device_stage_fn).
+        The whole policy lives here — env gate, out-dtype rule (bf16
+        nets get device-side cast, f32 nets stay f32), and the
+        host-path fallbacks: returns None for sources that override
+        next_batch with their own blob packing (HDF5/DataFrame), have
+        no image geometry, or use an unsupported mean shape."""
+        import os
+        if os.environ.get("COS_DEVICE_TRANSFORM") != "1":
+            return None
+        if type(self).next_batch is not DataSource.next_batch:
+            return None
+        try:
+            c, h, w = self.image_dims()
+        except (NotImplementedError, ValueError):
+            return None
+        if not self.transformer.device_eligible(h, w):
+            return None
+        import jax.numpy as jnp
+        out_dtype = None if net_dtype in (None, jnp.float32) else net_dtype
+        self._device_transform = True
+        return {self.layer.top[0]:
+                self.transformer.device_stage_fn(out_dtype)}
 
     def _decode_encoded_batch(self, records, c, h, w) -> np.ndarray:
         from .. import native

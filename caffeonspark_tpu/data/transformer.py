@@ -23,6 +23,10 @@ import numpy as np
 
 from ..proto.caffe import BlobProto, TransformationParameter
 
+# batch-dict key suffix carrying the (N, 3) int32 [h_off, w_off, flip]
+# aux array of the device-transform split (see Transformer.host_stage)
+DEVICE_AUX_SUFFIX = "__devxf"
+
 
 def load_mean_file(path: str) -> np.ndarray:
     """mean.binaryproto → (C, H, W) float32 (BlobProto wire format)."""
@@ -59,6 +63,32 @@ class Transformer:
             raise ValueError("specify either mean_file or mean_value, "
                              "not both")
 
+    # -- the RNG-bearing draws, shared verbatim by the host-only path and
+    # the device-transform split so both consume self.rng identically
+    # (trajectory parity between the two pipelines depends on it) -------
+
+    def _draw_crop(self, n: int, h: int, w: int):
+        """Per-sample crop offsets, or None when no crop applies.
+        Draws from self.rng ONLY at TRAIN with an active crop."""
+        crop = int(self.tp.crop_size)
+        if not (crop and (crop != h or crop != w)):
+            return None
+        if crop > h or crop > w:
+            raise ValueError(f"crop_size {crop} exceeds input {h}x{w}")
+        if self.train:
+            hs = self.rng.randint(0, h - crop + 1, size=n)
+            ws = self.rng.randint(0, w - crop + 1, size=n)
+        else:
+            hs = np.full(n, (h - crop) // 2)
+            ws = np.full(n, (w - crop) // 2)
+        return hs, ws
+
+    def _draw_flip(self, n: int):
+        """Per-sample mirror flags (TRAIN with mirror), else all-False."""
+        if self.tp.mirror and self.train:
+            return self.rng.randint(0, 2, size=n).astype(bool)
+        return np.zeros(n, bool)
+
     def __call__(self, batch: np.ndarray) -> np.ndarray:
         """batch: (N, C, H, W) float32 (raw 0..255 pixel scale)."""
         tp = self.tp
@@ -79,21 +109,16 @@ class Transformer:
         else:
             mean_done = True
 
-        if crop and (crop != h or crop != w):
-            if crop > h or crop > w:
-                raise ValueError(f"crop_size {crop} exceeds input {h}x{w}")
+        offs = self._draw_crop(n, h, w)
+        if offs is not None:
+            hs, ws = offs
+            crop = int(tp.crop_size)
             if self.train:
-                hs = self.rng.randint(0, h - crop + 1, size=n)
-                ws = self.rng.randint(0, w - crop + 1, size=n)
                 out = np.stack([out[i, :, hs[i]:hs[i] + crop,
                                     ws[i]:ws[i] + crop]
                                 for i in range(n)])
-            else:
-                hs0 = (h - crop) // 2
-                ws0 = (w - crop) // 2
-                out = out[:, :, hs0:hs0 + crop, ws0:ws0 + crop]
-        elif crop:
-            out = out.copy()
+            else:  # center crop: one slice for the whole batch
+                out = out[:, :, hs[0]:hs[0] + crop, ws[0]:ws[0] + crop]
         else:
             out = out.copy()
 
@@ -106,8 +131,8 @@ class Transformer:
                 m = m[:, hs0:hs0 + out.shape[2], ws0:ws0 + out.shape[3]]
             out = out - m[None]
 
-        if tp.mirror and self.train:
-            flip = self.rng.randint(0, 2, size=n).astype(bool)
+        flip = self._draw_flip(n)
+        if flip.any():
             out[flip] = out[flip, :, :, ::-1]
 
         # mean_file and mean_value are mutually exclusive (checked in
@@ -129,3 +154,100 @@ class Transformer:
     def output_hw(self, h: int, w: int) -> Tuple[int, int]:
         crop = int(self.tp.crop_size)
         return (crop, crop) if crop else (h, w)
+
+    # -- device-side transform (COS_DEVICE_TRANSFORM) ----------------------
+    # TPU-first split of the Caffe transform: the host keeps only the
+    # RNG-bearing byte moves (crop + mirror, on uint8), and the float
+    # work (mean subtraction, scale, dtype) runs inside a jitted stage on
+    # the device.  The infeed then carries 1 byte/pixel instead of 4 —
+    # 4x less host->device traffic (158 MB -> 40 MB per CaffeNet b256
+    # step), which is the dominant feed cost over PCIe or the axon
+    # tunnel.  The reference instead transforms to float on CPU and
+    # ships float blobs to the GPU (FloatDataTransformer.java:9-40).
+    #
+    # RNG discipline: host_stage draws crop offsets then mirror flips
+    # from self.rng in the SAME order as __call__, so a run with the
+    # split enabled consumes the stream identically and the (host crop/
+    # mirror, device mean/scale) pipeline reproduces the host-only
+    # trajectory exactly (test_device_transform_parity).
+
+    def device_eligible(self, in_h: int, in_w: int) -> bool:
+        """The split supports the two mean geometries Caffe produces:
+        full-size (subtract-then-crop == per-sample window) and
+        output-size (plain broadcast).  Any other mean shape keeps the
+        host path (center-crop-the-mean semantics need the pre-crop
+        size the device stage doesn't see)."""
+        if self.mean is None:
+            return True
+        oh, ow = self.output_hw(in_h, in_w)
+        return tuple(self.mean.shape[1:]) in {(in_h, in_w), (oh, ow)}
+
+    def host_stage(self, batch: np.ndarray):
+        """(N,C,H,W) integral-valued pixels -> (uint8 batch cropped +
+        mirrored, aux int32 (N,3) of [h_off, w_off, flip]).  Crop and
+        flip come from the same _draw_crop/_draw_flip the host-only
+        path uses, so the two pipelines consume self.rng identically."""
+        n, c, h, w = batch.shape
+        crop = int(self.tp.crop_size)
+        u8 = batch.astype(np.uint8) if batch.dtype != np.uint8 else batch
+        offs = self._draw_crop(n, h, w)
+        if offs is not None:
+            hs, ws = offs
+            u8 = np.stack([u8[i, :, hs[i]:hs[i] + crop,
+                              ws[i]:ws[i] + crop] for i in range(n)])
+        else:
+            hs = np.zeros(n, np.int64)
+            ws = np.zeros(n, np.int64)
+            u8 = u8.copy()
+        flip = self._draw_flip(n)
+        if flip.any():
+            u8[flip] = u8[flip, :, :, ::-1]
+        aux = np.stack([hs, ws, flip.astype(np.int64)],
+                       axis=1).astype(np.int32)
+        return np.ascontiguousarray(u8), aux
+
+    def device_stage_fn(self, out_dtype=None):
+        """Jittable (x_uint8, aux) -> transformed float batch, closing
+        over the mean/scale constants.  Subtracting the per-sample
+        (h_off, w_off) window of the full-size mean, flipped where the
+        image was flipped, is algebraically identical to Caffe's
+        subtract-at-source-pixel-then-crop-and-mirror order
+        (data_transformer.cpp; see __call__'s comments)."""
+        import jax
+        import jax.numpy as jnp
+
+        tp = self.tp
+        mean = self.mean
+        mv = np.asarray(list(tp.mean_value), np.float32) \
+            if tp.mean_value else None
+        scale = float(tp.scale)
+
+        def apply(x, aux):
+            out = x.astype(jnp.float32)
+            n, c, ch, cw = x.shape
+            if mean is not None:
+                m = jnp.asarray(mean, jnp.float32)
+                if m.shape[1] == ch and m.shape[2] == cw:
+                    win = jnp.broadcast_to(m[None], (n,) + m.shape)
+                else:
+                    # full-size mean (device_eligible guarantees it):
+                    # per-sample window at the image's own crop offset
+                    def window(a):
+                        return jax.lax.dynamic_slice(
+                            m, (0, a[0], a[1]), (m.shape[0], ch, cw))
+                    win = jax.vmap(window)(aux)
+                flip = aux[:, 2].astype(bool)[:, None, None, None]
+                win = jnp.where(flip, win[..., ::-1], win)
+                out = out - win
+            if mv is not None:
+                if len(mv) == 1:
+                    out = out - mv[0]
+                else:
+                    out = out - mv.reshape(1, c, 1, 1)
+            if scale != 1.0:
+                out = out * scale
+            if out_dtype is not None:
+                out = out.astype(out_dtype)
+            return out
+
+        return apply

@@ -204,19 +204,25 @@ class MiniCluster:
         tmajor = frozenset(
             n for n, _, kind in solver.train_net.input_specs
             if kind.endswith(":T"))
+        dxf = src.enable_device_transform(solver.train_net.dtype)
         batches_it = combine_batches(src.batches(loop=True),
                                      max(1, self.sp.iter_size), tmajor)
         if solver.train_net.dtype != jnp.float32:
             import ml_dtypes
+            import numpy as np
             np_dtype = ml_dtypes.bfloat16
 
             def _cast(bs):
+                # uint8 pixels / int32 aux of the device-transform split
+                # keep their wire dtype; the device stage emits bf16
                 for b in bs:
-                    yield {k: v.astype(np_dtype) for k, v in b.items()}
+                    yield {k: v if v.dtype in (np.uint8, np.int32)
+                           else v.astype(np_dtype) for k, v in b.items()}
 
             batches_it = _cast(batches_it)
         gen = device_prefetch(batches_it, depth=2,
-                              sharding=ps.input_shardings())
+                              sharding=ps.input_shardings(),
+                              device_transforms=dxf)
         # each step consumes exactly one source batch (device_prefetch
         # shards it across dp; it does not multiply the record count)
         timer = StepTimer(batch_size=src.batch_size)

@@ -217,6 +217,29 @@ def _s2d_eligible(x, cp, kh, kw, sh, sw, dh, dw) -> bool:
             and dh == dw == 1 and max(1, cp.group) == 1)
 
 
+def _conv_layout() -> str:
+    """COS_CONV_LAYOUT=NHWC requests NHWC-internal convolutions: the
+    logical NCHW operands are transposed around an NHWC/HWIO conv.  XLA's
+    transpose-folding absorbs the wrappers into the conv's dimension
+    numbers, so the net effect is a layout *hint* — channels land on the
+    minormost (lane) dimension without a layout-assignment round trip.
+    A/B lever for the roofline experiments (docs/benchmarks.md); numerics
+    are identical to float rounding.  Default NCHW."""
+    import os
+    return os.environ.get("COS_CONV_LAYOUT", "NCHW").upper()
+
+
+def _nhwc_conv(x, w, strides, padding, rhs_dilation, groups):
+    """x (N,C,H,W), w (O,I/g,kh,kw) → NHWC-internal conv → (N,O,oh,ow)."""
+    xt = x.transpose(0, 2, 3, 1)
+    wt = w.transpose(2, 3, 1, 0)  # OIHW → HWIO
+    out = lax.conv_general_dilated(
+        xt, wt, window_strides=strides, padding=padding,
+        rhs_dilation=rhs_dilation, feature_group_count=groups,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return out.transpose(0, 3, 1, 2)
+
+
 def _s2d_conv(x, w, s, kh, kw, ph, pw):
     """stride-s conv as a stride-1 conv over s x s space-to-depth blocks.
 
@@ -256,7 +279,12 @@ def _conv(ctx, lp, params, bottoms):
     # no preferred_element_type: the TPU MXU accumulates in f32
     # internally either way, and forcing an f32 output breaks the
     # conv transpose (backward) for bf16 nets with a dtype mismatch
-    if _s2d_eligible(x, cp, kh, kw, sh, sw, dh, dw):
+    if _conv_layout() == "NHWC":
+        # NHWC experiment measures the plain conv, not the s2d rewrite —
+        # one variable at a time (s2d is itself a layout transform).
+        out = _nhwc_conv(x, w, (sh, sw), [(ph, ph), (pw, pw)],
+                         (dh, dw), max(1, cp.group))
+    elif _s2d_eligible(x, cp, kh, kw, sh, sw, dh, dw):
         out = _s2d_conv(x, w, sh, kh, kw, ph, pw)
     else:
         out = lax.conv_general_dilated(

@@ -270,10 +270,14 @@ class CaffeProcessor:
             tmajor = frozenset(
                 n for n, _, kind in solver.train_net.input_specs
                 if kind.endswith(":T"))
+            dxf = (self.train_source.enable_device_transform(
+                       solver.train_net.dtype)
+                   if self.train_source is not None else None)
             gen = device_prefetch(
                 combine_batches(self._train_batches(),
                                 max(1, sp.iter_size), tmajor),
-                depth=2, sharding=ps.input_shardings())
+                depth=2, sharding=ps.input_shardings(),
+                device_transforms=dxf)
             params, st = self.params, self.opt_state
             for batch in gen:
                 params, st, out = step(params, st, batch,

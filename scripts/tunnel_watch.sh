@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Poll the axon TPU tunnel; the moment jax.devices() answers, capture the
+# full on-chip artifact set (bench + tpu_tests + evidence bundles).
+# Usage: scripts/tunnel_watch.sh [interval_s] [probe_timeout_s]
+set -u
+INTERVAL=${1:-600}
+PROBE_TIMEOUT=${2:-120}
+LOG=${TUNNEL_WATCH_LOG:-/tmp/tunnel_watch_r4.log}
+cd "$(dirname "$0")/.."
+n=0
+while true; do
+  n=$((n + 1))
+  echo "probe $n $(date -u +%H:%M:%S)" >> "$LOG"
+  if timeout "$PROBE_TIMEOUT" python -c "
+import jax
+ds = jax.devices()
+assert ds and ds[0].platform == 'tpu', ds
+print('TPU alive:', ds)
+" >> "$LOG" 2>&1; then
+    echo "TUNNEL ALIVE at $(date -u +%H:%M:%S) — capturing artifacts" >> "$LOG"
+    make onchip-artifacts >> "$LOG" 2>&1
+    echo "artifact capture finished rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
+    exit 0
+  fi
+  sleep "$INTERVAL"
+done

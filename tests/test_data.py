@@ -204,6 +204,142 @@ def test_transformer_mirror_deterministic_by_seed():
     assert flipped.any() and not flipped.all()
 
 
+def test_device_transform_parity(tmp_path):
+    """The COS_DEVICE_TRANSFORM split (host uint8 crop/mirror + device
+    mean/scale) reproduces the host-only transform exactly for every
+    supported config: full-size mean_file, crop-size mean_file,
+    mean_value, crop, mirror, scale, both phases."""
+    import jax
+    from caffeonspark_tpu.data.transformer import Transformer
+
+    rs = np.random.RandomState(3)
+    mean_full = rs.rand(3, 12, 12).astype(np.float32) * 20
+    mean_crop = rs.rand(3, 8, 8).astype(np.float32) * 20
+
+    def mean_path(arr, name):
+        bp = BlobProto(shape=BlobShape(dim=[1] + list(arr.shape)),
+                       data=[float(v) for v in arr.ravel()])
+        p = tmp_path / name
+        p.write_bytes(bp.to_binary())
+        return str(p)
+
+    mf_full = mean_path(mean_full, "full.binaryproto")
+    mf_crop = mean_path(mean_crop, "crop.binaryproto")
+
+    cases = [
+        TransformationParameter(scale=0.00390625,
+                                mean_value=[104.0, 117.0, 123.0]),
+        TransformationParameter(crop_size=8, mirror=True, scale=0.5),
+        TransformationParameter(crop_size=8, mirror=True,
+                                mean_file=mf_full),
+        TransformationParameter(crop_size=8, mean_file=mf_crop),
+        TransformationParameter(mean_file=mf_full, mirror=True),
+        TransformationParameter(),
+    ]
+    x = rs.randint(0, 256, size=(6, 3, 12, 12)).astype(np.float32)
+    for tp in cases:
+        for train in (True, False):
+            host = Transformer(tp, phase_train=train, seed=11)
+            split = Transformer(tp, phase_train=train, seed=11)
+            assert split.device_eligible(12, 12)
+            want = host(x)
+            u8, aux = split.host_stage(x)
+            assert u8.dtype == np.uint8 and aux.shape == (6, 3)
+            got = np.asarray(jax.jit(split.device_stage_fn())(
+                u8, aux))
+            np.testing.assert_allclose(
+                got, want, rtol=0, atol=1e-5,
+                err_msg=f"case={tp.to_text()!r} train={train}")
+
+
+def test_device_transform_source_fallbacks(tmp_path, monkeypatch):
+    """Eligibility and the fail-fast: an odd-sized mean keeps the host
+    path entirely (enable returns None); a float payload under an
+    enabled split is a config error, not a silent fallback."""
+    monkeypatch.setenv("COS_DEVICE_TRANSFORM", "1")
+    from caffeonspark_tpu.data.source import get_source
+    from caffeonspark_tpu.data.transformer import Transformer
+
+    # odd-sized mean (neither input- nor output-sized) -> not eligible
+    mean = np.zeros((1, 10, 10), np.float32)
+    bp = BlobProto(shape=BlobShape(dim=[1, 1, 10, 10]),
+                   data=[0.0] * 100)
+    mp = tmp_path / "odd.binaryproto"
+    mp.write_bytes(bp.to_binary())
+    t = Transformer(TransformationParameter(crop_size=8,
+                                            mean_file=str(mp)),
+                    phase_train=True, seed=0)
+    assert not t.device_eligible(12, 12)
+    assert t.device_eligible(10, 10)  # input-sized is fine
+
+    # float ndarray payload with the split enabled -> ValueError
+    _mnist_style_lmdb(str(tmp_path), n=10)
+    lp = LayerParameter.from_text(f'''
+        name: "data" type: "MemoryData" top: "data" top: "label"
+        source_class: "com.yahoo.ml.caffe.LMDB"
+        memory_data_param {{
+          source: "file:{tmp_path}"
+          batch_size: 4 channels: 1 height: 28 width: 28 }}''')
+    src = get_source(lp, phase_train=True, seed=0)
+    assert src.enable_device_transform() is not None
+    recs = [list(r) for r in list(src.records())[:4]]
+    recs[2][5] = False
+    recs[2][6] = np.zeros((1, 28, 28), np.float32)  # float payload
+    with pytest.raises(ValueError, match="COS_DEVICE_TRANSFORM"):
+        src.next_batch([tuple(r) for r in recs])
+
+    # a subclass that packs its own blobs (HDF5/DataFrame style) is
+    # excluded — the split only understands the base next_batch
+    src2 = get_source(lp, phase_train=True, seed=0)
+
+    class _OwnPacking(src2.__class__):
+        def next_batch(self, records):
+            return super().next_batch(records)
+
+    src2.__class__ = _OwnPacking
+    assert src2.enable_device_transform() is None
+
+    # without the env gate the split never engages
+    monkeypatch.delenv("COS_DEVICE_TRANSFORM")
+    src3 = get_source(lp, phase_train=True, seed=0)
+    assert src3.enable_device_transform() is None
+    assert not src3._device_transform
+
+
+def test_device_transform_end_to_end_feed(tmp_path, monkeypatch):
+    """uint8 batches flow source -> device_prefetch -> transformed
+    device arrays identical to the host-transform feed."""
+    monkeypatch.setenv("COS_DEVICE_TRANSFORM", "1")
+    import jax
+    from caffeonspark_tpu.data.source import get_source
+    from caffeonspark_tpu.data.queue_runner import device_prefetch
+
+    _mnist_style_lmdb(str(tmp_path), n=40)
+    txt = f'''
+        name: "data" type: "MemoryData" top: "data" top: "label"
+        source_class: "com.yahoo.ml.caffe.LMDB"
+        transform_param {{ scale: 0.00390625 }}
+        memory_data_param {{
+          source: "file:{tmp_path}"
+          batch_size: 10 channels: 1 height: 28 width: 28 }}'''
+    lp = LayerParameter.from_text(txt)
+
+    ref_src = get_source(lp, phase_train=True, seed=5)
+    ref = next(ref_src.batches(loop=False, shuffle=False))
+
+    src = get_source(lp, phase_train=True, seed=5)
+    dxf = src.enable_device_transform()
+    assert dxf is not None
+    raw = next(src.batches(loop=False, shuffle=False))
+    assert raw["data"].dtype == np.uint8
+    [dev] = list(device_prefetch(iter([raw]), depth=1,
+                                 device_transforms=dxf))
+    assert set(dev) == {"data", "label"}
+    np.testing.assert_allclose(np.asarray(dev["data"]), ref["data"],
+                               rtol=0, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(dev["label"]), ref["label"])
+
+
 def test_lmdb_source_spi(tmp_path):
     _mnist_style_lmdb(str(tmp_path), n=40)
     lp = LayerParameter.from_text(f'''

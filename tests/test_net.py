@@ -491,6 +491,44 @@ def test_s2d_conv_layer_path(monkeypatch):
                                rtol=2e-5, atol=2e-4)
 
 
+def test_nhwc_conv_layout_parity(monkeypatch):
+    """COS_CONV_LAYOUT=NHWC (layout A/B lever) matches the default NCHW
+    path — forward and grads — across plain/strided/grouped/dilated
+    convs.  The NHWC wrapper only re-expresses the conv's dimension
+    numbers; XLA folds the boundary transposes."""
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx
+    cases = [
+        ("num_output: 12 kernel_size: 5 stride: 3", (2, 3, 31, 31),
+         (12, 3, 5, 5)),
+        ("num_output: 8 kernel_size: 3 pad: 1 group: 2", (2, 4, 9, 9),
+         (8, 2, 3, 3)),
+        ("num_output: 6 kernel_size: 3 dilation: 2", (1, 5, 13, 13),
+         (6, 5, 3, 3)),
+    ]
+    op = get_op("Convolution")
+    for txt, xs, ws in cases:
+        lp = LayerParameter.from_text(
+            'name: "c" type: "Convolution" bottom: "d" top: "c" '
+            "convolution_param { %s }" % txt)
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.rand(*xs).astype(np.float32))
+        w = jnp.asarray(rs.randn(*ws).astype(np.float32) * 0.1)
+        b = jnp.asarray(rs.randn(ws[0]).astype(np.float32))
+
+        def loss(a, p):
+            return jnp.sum(op.apply(Ctx(), lp, [p, b], [a])[0] ** 2)
+
+        monkeypatch.setenv("COS_CONV_LAYOUT", "NCHW")
+        y0, g0 = jax.value_and_grad(loss, argnums=(0, 1))(x, w)
+        monkeypatch.setenv("COS_CONV_LAYOUT", "NHWC")
+        y1, g1 = jax.value_and_grad(loss, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(float(y1), float(y0), rtol=1e-4)
+        for a, bb in zip(g0, g1):
+            np.testing.assert_allclose(np.asarray(bb), np.asarray(a),
+                                       rtol=2e-4, atol=2e-3)
+
+
 def test_stochastic_pooling():
     """Caffe PoolForward{Test,Train}: test = sum(a^2)/sum(a); train samples
     one in-window activation with probability proportional to its value."""
