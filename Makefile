@@ -56,6 +56,8 @@ onchip-artifacts:
 	-BENCH_FORWARD=1 $(PY) bench.py
 	-COS_STATE_DTYPE=bfloat16 $(PY) bench.py
 	-COS_CONV_LAYOUT=NHWC $(PY) bench.py
+	-BENCH_PIPELINE=1 $(PY) bench.py
+	-BENCH_PIPELINE=1 COS_DEVICE_TRANSFORM=1 $(PY) bench.py
 	-mkdir -p bench_evidence && $(PY) scripts/profile_segments.py 256 \
 	  | tee bench_evidence/profile_segments_b256.txt
 	-BENCH_MODEL=resnet50 $(PY) bench.py

@@ -69,7 +69,9 @@ Env knobs:
   BENCH_PIPELINE=1   feed through the REAL data pipeline (JPEG LMDB ->
                      native decode -> transform -> device prefetch),
                      host-dispatched per step; also reports host
-                     decode+transform scaling vs thread count
+                     decode+transform scaling vs thread count.
+                     + COS_DEVICE_TRANSFORM=1 ships uint8 + on-device
+                     mean/scale (4x smaller host->device transfers)
   BENCH_FORWARD=1    forward-only throughput (the features/test
                      extraction path) instead of the train step
   BENCH_SMOKE=1      tiny-shape backend liveness probe only: separates
@@ -110,7 +112,10 @@ def _metric_name():
     if os.environ.get("BENCH_FORWARD") == "1":
         return f"{model}_imagenet_forward_images_per_sec_per_chip"
     if os.environ.get("BENCH_PIPELINE") == "1":
-        return f"{model}_imagenet_train_images_per_sec_per_chip_pipeline"
+        sfx = ("_devxf" if os.environ.get("COS_DEVICE_TRANSFORM") == "1"
+               else "")
+        return (f"{model}_imagenet_train_images_per_sec_per_chip_pipeline"
+                + sfx)
     return f"{model}_imagenet_train_images_per_sec_per_chip"
 
 
@@ -404,7 +409,12 @@ def _pipeline_inputs(batch, dshape, tmpdir):
     from caffeonspark_tpu.data.queue_runner import device_prefetch
     lp = _pipeline_layer(batch, dshape, tmpdir)
     src = get_source(lp, phase_train=True, seed=0, resize=True)
-    return device_prefetch(src.batches(loop=True), depth=2)
+    # COS_DEVICE_TRANSFORM=1 engages the uint8-infeed split here too,
+    # so the pipeline bench measures the 4x-smaller host->device feed.
+    # Returns the engaged flag so the record can say which mode ran.
+    dxf = src.enable_device_transform()
+    return device_prefetch(src.batches(loop=True), depth=2,
+                           device_transforms=dxf), dxf is not None
 
 
 def _pipeline_layer(batch, dshape, tmpdir):
@@ -451,6 +461,9 @@ def _host_pipeline_scaling(batch, dshape, tmpdir, threads_list,
             break
         src = get_source(lp, phase_train=True, seed=0, resize=True,
                          num_threads=nt)
+        # under COS_DEVICE_TRANSFORM the sweep must measure the same
+        # (lighter: uint8 crop/mirror only) host path the bench feeds
+        src.enable_device_transform()
         gen = src.batches(loop=True)
         next(gen)                       # warm caches/threads
         t0 = time.perf_counter()
@@ -651,7 +664,7 @@ def worker(mode):
         import tempfile
         step = solver.jit_train_step()
         with tempfile.TemporaryDirectory(prefix="cos_bench_") as td:
-            gen = _pipeline_inputs(batch, dshape, td)
+            gen, devxf = _pipeline_inputs(batch, dshape, td)
             for i in range(5):
                 params, st, out = step(params, st, next(gen),
                                        solver.step_rng(i))
@@ -664,7 +677,9 @@ def worker(mode):
             dt = time.perf_counter() - t0
             ips = batch * iters / dt
             metric = (f"{model}_imagenet_train_images_per_sec"
-                      "_per_chip_pipeline")
+                      "_per_chip_pipeline"
+                      + ("_devxf" if devxf else ""))
+            extra["device_transform"] = devxf
             # print the throughput record BEFORE the host-scaling sweep:
             # if the sweep overruns the worker's hard timeout, the
             # completed measurement must survive.  Marked preliminary so

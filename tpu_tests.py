@@ -1,8 +1,9 @@
 """On-chip test runner: make the TPU-gated test leg driver-capturable.
 
-The 5 gated tests (tests/test_pallas_tpu.py — Pallas LRN fwd+VJP parity
-on the real compiler; tests/test_tpu_train.py — LSTM + transformer
-train steps on chip) skip silently without COS_TPU_TESTS=1 and used to
+The gated tests (tests/test_pallas_tpu.py — Pallas LRN + flash
+attention parity on the real compiler; tests/test_tpu_train.py — LSTM /
+transformer / flash-MHA / NHWC-layout / uint8-infeed train steps on
+chip) skip silently without COS_TPU_TESTS=1 and used to
 leave no artifact when they did run.  This runner applies the same
 contract as bench.py (round 3/4): every backend-touching phase runs in
 a SIGKILL-bounded subprocess, attempts escalate until the deadline is
