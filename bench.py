@@ -402,7 +402,7 @@ def _sync(x):
     return np.asarray(jax.device_get(x))
 
 
-def _pipeline_inputs(batch, dshape, tmpdir):
+def _pipeline_inputs(batch, dshape, tmpdir, net_dtype=None):
     """Build a JPEG LMDB once and stream it through the full source
     pipeline (decode -> transform -> prefetch)."""
     from caffeonspark_tpu.data import get_source
@@ -410,9 +410,10 @@ def _pipeline_inputs(batch, dshape, tmpdir):
     lp = _pipeline_layer(batch, dshape, tmpdir)
     src = get_source(lp, phase_train=True, seed=0, resize=True)
     # COS_DEVICE_TRANSFORM=1 engages the uint8-infeed split here too,
-    # so the pipeline bench measures the 4x-smaller host->device feed.
-    # Returns the engaged flag so the record can say which mode ran.
-    dxf = src.enable_device_transform()
+    # so the pipeline bench measures the 4x-smaller host->device feed
+    # with the same out-dtype rule production uses (bf16 nets get the
+    # device-side cast).  Returns the engaged flag for the record.
+    dxf = src.enable_device_transform(net_dtype)
     return device_prefetch(src.batches(loop=True), depth=2,
                            device_transforms=dxf), dxf is not None
 
@@ -664,7 +665,8 @@ def worker(mode):
         import tempfile
         step = solver.jit_train_step()
         with tempfile.TemporaryDirectory(prefix="cos_bench_") as td:
-            gen, devxf = _pipeline_inputs(batch, dshape, td)
+            gen, devxf = _pipeline_inputs(batch, dshape, td,
+                                          solver.train_net.dtype)
             for i in range(5):
                 params, st, out = step(params, st, next(gen),
                                        solver.step_rng(i))

@@ -1139,12 +1139,14 @@ def _mha_params(lp, shapes):
 
 
 _FLASH_SUPPRESS = 0      # >0 while tracing a multi-device SPMD step
+_FLASH_MESH: list = []   # (mesh, batch_axes, head_axes) stack
 
 
 @contextlib.contextmanager
 def suppress_flash():
     """Disable the flash-attention dispatch for the duration (used by
-    ParallelSolver while tracing multi-device steps: a pallas_call is
+    ParallelSolver while tracing steps on meshes the shard_map route
+    can't serve, e.g. sequence-parallel ones: a bare pallas_call is
     opaque to the GSPMD partitioner, which would replicate it and
     all-gather its sharded operands)."""
     global _FLASH_SUPPRESS
@@ -1155,19 +1157,63 @@ def suppress_flash():
         _FLASH_SUPPRESS -= 1
 
 
+@contextlib.contextmanager
+def flash_mesh(mesh, batch_axes=("dp",), head_axes=("tp",)):
+    """Route the flash dispatch through shard_map over `mesh` for the
+    duration of a trace.  Attention is embarrassingly parallel over
+    batch x heads, so each device runs the kernel on its (B/dp, H/tp)
+    local block — the GSPMD-compatible way to keep Pallas flash in
+    multi-device steps instead of falling back to the einsum path."""
+    _FLASH_MESH.append((mesh, tuple(batch_axes), tuple(head_axes)))
+    try:
+        yield
+    finally:
+        _FLASH_MESH.pop()
+
+
+def _flash_interpret() -> bool:
+    """COS_FLASH_INTERPRET=1 forces the Pallas kernels in interpret
+    mode on any backend — how the CPU suite exercises the shard_map
+    flash route on virtual meshes."""
+    return os.environ.get("COS_FLASH_INTERPRET") == "1"
+
+
 def _attention_dispatch(q, k, v, *, causal: bool):
-    """Flash (Pallas, O(block·T) VMEM) on TPU when the shape tiles and
-    the step isn't sharded over devices; XLA einsum attention otherwise
-    — numerically the same math (tests/test_pallas.py flash parity)."""
+    """Flash (Pallas, O(block·T) VMEM) on TPU when the shape tiles;
+    under a multi-device mesh the kernel runs per-device via shard_map
+    over (batch, heads); XLA einsum attention otherwise — numerically
+    the same math (tests/test_pallas.py flash parity)."""
     from .pallas_kernels import flash_attention, pallas_enabled
     t = q.shape[2]
+    interpret = _flash_interpret()
     # only 128-aligned sequence lengths take the kernel: Mosaic block
     # shapes must tile (8, 128), and at small T the O(T²) XLA path is
     # cheap anyway
-    if (pallas_enabled() and not _FLASH_SUPPRESS
+    if ((pallas_enabled() or interpret) and not _FLASH_SUPPRESS
             and not os.environ.get("COS_DISABLE_FLASH")
             and t % 128 == 0):
-        return flash_attention(q, k, v, causal, 128, 128)
+        if _FLASH_MESH:
+            mesh, b_axes, h_axes = _FLASH_MESH[-1]
+            shape = dict(mesh.shape)
+            b_axes = tuple(a for a in b_axes if shape.get(a, 1) > 1)
+            h_axes = tuple(a for a in h_axes if shape.get(a, 1) > 1)
+            nb = math.prod(shape[a] for a in b_axes) if b_axes else 1
+            nh = math.prod(shape[a] for a in h_axes) if h_axes else 1
+            if q.shape[0] % nb == 0 and q.shape[1] % nh == 0:
+                import functools
+                from jax.sharding import PartitionSpec as P
+                from ..parallel.sp import shard_map_nocheck
+                spec = P(b_axes or None, h_axes or None, None, None)
+                fl = shard_map_nocheck(
+                    functools.partial(flash_attention, causal=causal,
+                                      block_q=128, block_k=128,
+                                      interpret=interpret),
+                    mesh, (spec, spec, spec), spec)
+                return fl(q, k, v)
+            # batch/heads don't tile the mesh: einsum path below
+        else:
+            return flash_attention(q, k, v, causal, 128, 128,
+                                   interpret=interpret)
     from ..parallel.sp import attention as _plain_attention
     return _plain_attention(q, k, v, causal=causal)
 

@@ -168,13 +168,22 @@ class ParallelSolver:
         return self._step
 
     def _maybe_suppress_flash(self, fn):
-        """An opaque pallas_call cannot be GSPMD-partitioned — under a
-        multi-device mesh XLA would replicate it (all-gathering the
-        sharded operands), so attention falls back to the partitionable
-        einsum path.  Flash stays on for single-device meshes (bench,
-        features, per-stage pipeline jits)."""
+        """A bare pallas_call cannot be GSPMD-partitioned, but attention
+        is embarrassingly parallel over batch x heads — so on dp/tp
+        (and ep) meshes the dispatch is routed through shard_map
+        (ops.layers.flash_mesh) and each device runs the kernel on its
+        local block.  Sequence-parallel meshes shard the TIME axis the
+        kernel would need whole, so there flash is suppressed and the
+        partitionable einsum path (or explicit ring attention) runs.
+        Single-device meshes call the kernel directly."""
         if self.mesh.devices.size <= 1:
             return fn
+        if dict(self.mesh.shape).get("sp", 1) == 1:
+            def wrapped(*args, _f=fn):
+                from ..ops.layers import flash_mesh
+                with flash_mesh(self.mesh):  # active during TRACING
+                    return _f(*args)
+            return wrapped
 
         def wrapped(*args, _f=fn):
             from ..ops.layers import suppress_flash

@@ -33,6 +33,19 @@ except ImportError:  # older jax
 Array = jax.Array
 
 
+def shard_map_nocheck(fn, mesh, in_specs, out_specs):
+    """shard_map with the replication checker disabled: a pallas_call's
+    outputs carry no varying-mesh-axes metadata, which the default
+    checker rejects.  Tolerates the check_rep -> check_vma rename
+    across jax versions — the ONE place that knows the kwarg (used by
+    ring attention's flash mode and ops.layers' multi-device flash)."""
+    import inspect
+    sig = inspect.signature(shard_map).parameters
+    kw = {k: False for k in ("check_rep", "check_vma") if k in sig}
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, **kw)
+
+
 def attention(q: Array, k: Array, v: Array, *, causal: bool = False,
               q_offset: int = 0, k_offset: int = 0) -> Array:
     """Reference softmax attention. q,k,v: (B, H, T, D)."""
@@ -135,15 +148,13 @@ def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh, *,
     (fused Pallas accumulate per ring hop — forward-only, for
     long-context inference/serving) | "interpret" (tests on CPU)."""
     spec = P(None, None, axis_name, None)
-    kw = {}
+    local = partial(_ring_attention_local, axis_name=axis_name,
+                    causal=causal, flash=flash)
     if flash:
-        # a pallas_call's outputs carry no varying-mesh-axes metadata,
-        # which the default shard_map VMA checker rejects
-        kw["check_vma"] = False
-    fn = shard_map(
-        partial(_ring_attention_local, axis_name=axis_name,
-                causal=causal, flash=flash),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kw)
+        fn = shard_map_nocheck(local, mesh, (spec, spec, spec), spec)
+    else:
+        fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
     return fn(q, k, v)
 
 

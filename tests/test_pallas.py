@@ -139,7 +139,9 @@ def test_flash_suppressed_under_multi_device_mesh(monkeypatch):
         L._attention_dispatch(q, q, q, causal=True)
     assert not calls, "flash must be suppressed inside the guard"
 
-    # ParallelSolver wraps multi-device steps with the guard
+    # ParallelSolver routes dp/tp meshes through flash_mesh (the
+    # shard_map path) and suppresses only on sp meshes, where the time
+    # axis the kernel needs whole is sharded
     from caffeonspark_tpu.parallel import ParallelSolver, build_mesh
     from caffeonspark_tpu.proto import NetParameter, SolverParameter
     from caffeonspark_tpu.solver import Solver
@@ -153,6 +155,13 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
     s = Solver(SolverParameter.from_text(
         "base_lr: 0.01 random_seed: 1"), npm)
     ps = ParallelSolver(s, build_mesh(dp=8))
-    probe = ps._maybe_suppress_flash(lambda: L._FLASH_SUPPRESS)
-    assert probe() == 1, "guard must be active inside wrapped steps"
-    assert L._FLASH_SUPPRESS == 0
+    probe = ps._maybe_suppress_flash(
+        lambda: (L._FLASH_SUPPRESS, len(L._FLASH_MESH)))
+    assert probe() == (0, 1), "dp mesh must install the shard_map route"
+    s2 = Solver(SolverParameter.from_text(
+        "base_lr: 0.01 random_seed: 1"), npm)
+    ps2 = ParallelSolver(s2, build_mesh(dp=2, sp=4))
+    probe2 = ps2._maybe_suppress_flash(
+        lambda: (L._FLASH_SUPPRESS, len(L._FLASH_MESH)))
+    assert probe2() == (1, 0), "sp mesh must suppress flash"
+    assert L._FLASH_SUPPRESS == 0 and not L._FLASH_MESH
