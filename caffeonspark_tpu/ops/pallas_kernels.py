@@ -379,21 +379,26 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
     return out.reshape(b, h, t, d), (qf, kf, vf, out, lse)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, do):
-    qf, kf, vf, out, lse = res
+def flash_bwd_block(qf, kf, vf, dof, lse, delta, *, causal: bool,
+                    block_q: int, block_k: int, interpret: bool,
+                    out_dtype=None):
+    """dq, dk, dv for one (q-group, kv-block) attention pair from the
+    saved stats — the flash backward building block.  All operands
+    flattened (B·H, T, D) / (B·H, T); `causal` masks with LOCAL
+    positions, so callers composing cross-shard pairs (ring backward,
+    parallel/sp.py) pass causal=True only for the diagonal pair and
+    causal=False for fully-visible ones.  `out_dtype` overrides the
+    gradient dtype — accumulating callers pass float32 so bf16 inputs
+    don't round each per-hop partial before the sum."""
     bh, t, d = qf.shape
-    dof = do.reshape(bh, t, d)
     sm_scale = 1.0 / math.sqrt(d)
-    # delta = rowsum(dO ∘ O): cheap elementwise+reduce, XLA fuses it
-    delta = jnp.sum(dof.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)
-
     qspec, kvspec, vec, vec_full = _flash_specs(block_q, d, t)
     kspec_b, _, _, _ = _flash_specs(block_k, d, t)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
                           causal=causal, block_k=block_k),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d),
+                                       out_dtype or qf.dtype),
         grid=(bh, t // block_q),
         in_specs=[qspec, kvspec, kvspec, qspec, vec, vec],
         out_specs=qspec,
@@ -402,13 +407,28 @@ def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, do):
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q),
-        out_shape=(jax.ShapeDtypeStruct((bh, t, d), kf.dtype),
-                   jax.ShapeDtypeStruct((bh, t, d), vf.dtype)),
+        out_shape=(jax.ShapeDtypeStruct((bh, t, d),
+                                        out_dtype or kf.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d),
+                                        out_dtype or vf.dtype)),
         grid=(bh, t // block_k),
         in_specs=[kvspec, kspec_b, kspec_b, kvspec, vec_full, vec_full],
         out_specs=(kspec_b, kspec_b),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
+    return dq, dk, dv
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, do):
+    qf, kf, vf, out, lse = res
+    bh, t, d = qf.shape
+    dof = do.reshape(bh, t, d)
+    # delta = rowsum(dO ∘ O): cheap elementwise+reduce, XLA fuses it
+    delta = jnp.sum(dof.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    dq, dk, dv = flash_bwd_block(qf, kf, vf, dof, lse, delta,
+                                 causal=causal, block_q=block_q,
+                                 block_k=block_k, interpret=interpret)
     shape = do.shape
     return (dq.reshape(shape), dk.reshape(shape), dv.reshape(shape))
 
@@ -425,8 +445,9 @@ flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 # per-device, so a pallas_call is legal (no GSPMD partitioning of an
 # opaque call) — this kernel fuses one accumulate() step: VMEM-resident
 # score strip instead of a (T_local, T_local) HBM matrix per ring hop.
-# Forward-only (no custom VJP): callers opt in for inference/serving
-# paths (ring_attention(flash=...)); training keeps the einsum path.
+# The ring is differentiable end to end: parallel/sp.py's
+# _make_ring_flash wraps this forward with a custom VJP whose backward
+# is a second ring pass over flash_bwd_block.
 
 def _flash_carry_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
                         m_ref, l_ref, a_ref, mo_ref, lo_ref, ao_ref, *,

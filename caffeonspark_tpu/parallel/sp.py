@@ -82,23 +82,19 @@ def _ring_attention_local(q: Array, k: Array, v: Array, *, axis_name: str,
 
     bq, bk = _flash_block(t_q), _flash_block(t_k)
     use_flash = bool(flash) and bq is not None and bk is not None
+    if use_flash:
+        interp = flash == "interpret"
+        if t_q == t_k:
+            # fused + differentiable custom-VJP ring
+            return _make_ring_flash(axis_name, causal, bq, bk,
+                                    interp)(q, k, v)
+        # unequal shard extents (cross-attention): fused forward only
+        out, _ = _flash_ring_forward(q, k, v, axis_name=axis_name,
+                                     causal=causal, bq=bq, bk=bk,
+                                     interpret=interp)
+        return out
 
     def accumulate(m, l, o, k_blk, v_blk, src):
-        if use_flash:
-            # fused accumulate: shard_map bodies are per-device, so the
-            # pallas_call needs no GSPMD partitioning (unlike the MHA
-            # dispatch, which must suppress flash under SPMD meshes)
-            from ..ops.pallas_kernels import flash_block_update
-            bh = b * h
-            mf, lf, of = flash_block_update(
-                q.reshape(bh, t_q, d), k_blk.reshape(bh, t_k, d),
-                v_blk.reshape(bh, t_k, d), m.reshape(bh, t_q),
-                l.reshape(bh, t_q), o.reshape(bh, t_q, d),
-                idx * t_q, src * t_k, causal=causal,
-                block_q=bq, block_k=bk,
-                interpret=(flash == "interpret"))
-            return (mf.reshape(b, h, t_q), lf.reshape(b, h, t_q),
-                    of.reshape(b, h, t_q, d))
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
         if causal:
             kpos = src * t_k + jnp.arange(t_k)
@@ -126,16 +122,168 @@ def _ring_attention_local(q: Array, k: Array, v: Array, *, axis_name: str,
         return m, l, o, k_blk, v_blk
 
     # derive from q so the carry is device-varying like the loop outputs
-    # (shard_map VMA typing requires carry in/out types to match);
-    # the flash kernel carries m/l/acc in f32 regardless of input dtype
-    cdt = jnp.float32 if use_flash else q.dtype
-    m0 = jnp.full(q.shape[:-1], -jnp.inf, cdt) + q[..., 0] * 0
-    l0 = jnp.zeros(q.shape[:-1], cdt) + q[..., 0] * 0
-    o0 = jnp.zeros(q.shape, cdt) + q * 0
+    # (shard_map VMA typing requires carry in/out types to match)
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, q.dtype) + q[..., 0] * 0
+    l0 = jnp.zeros(q.shape[:-1], q.dtype) + q[..., 0] * 0
+    o0 = jnp.zeros(q.shape, q.dtype) + q * 0
     m, l, o = accumulate(m0, l0, o0, k, v, idx)
     m, l, o, _, _ = lax.fori_loop(1, n, body, (m, l, o, k, v))
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
+
+
+def _flash_ring_forward(q: Array, k: Array, v: Array, *, axis_name: str,
+                        causal: bool, bq: int, bk: int, interpret: bool):
+    """Fused flash ring forward (the ONE copy of the ring loop): K/V
+    shards rotate on ICI ppermute, each hop folds into the
+    online-softmax (m, l, acc) carry via flash_block_update.  Returns
+    (out, lse); lse = m + log(l) is the VJP residual for the
+    differentiable wrapper (unused by the forward-only caller).
+    Causal runs skip fully-masked hops (K entirely after Q) — on
+    average (n-1)/2 kernel launches saved per device per pass."""
+    from ..ops.pallas_kernels import flash_block_update
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    bh = b * h
+
+    def hop(m, l, o, k_blk, v_blk, src):
+        mf, lf, of = flash_block_update(
+            q.reshape(bh, t_q, d), k_blk.reshape(bh, t_k, d),
+            v_blk.reshape(bh, t_k, d), m.reshape(bh, t_q),
+            l.reshape(bh, t_q), o.reshape(bh, t_q, d),
+            idx * t_q, src * t_k, causal=causal, block_q=bq,
+            block_k=bk, interpret=interpret)
+        return (mf.reshape(b, h, t_q), lf.reshape(b, h, t_q),
+                of.reshape(b, h, t_q, d))
+
+    def maybe_hop(m, l, o, k_blk, v_blk, src):
+        if not causal:
+            return hop(m, l, o, k_blk, v_blk, src)
+        # contributes iff the last q row can see the first k row
+        return lax.cond((idx + 1) * t_q > src * t_k,
+                        lambda m_, l_, o_: hop(m_, l_, o_, k_blk,
+                                               v_blk, src),
+                        lambda m_, l_, o_: (m_, l_, o_),
+                        m, l, o)
+
+    def body(step, carry):
+        m, l, o, k_blk, v_blk = carry
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        m, l, o = maybe_hop(m, l, o, k_blk, v_blk, (idx - step) % n)
+        return m, l, o, k_blk, v_blk
+
+    # device-varying carry init (shard_map VMA typing), f32 stats
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32) \
+        + q[..., 0].astype(jnp.float32) * 0
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32) \
+        + q[..., 0].astype(jnp.float32) * 0
+    o0 = jnp.zeros(q.shape, jnp.float32) + q.astype(jnp.float32) * 0
+    m, l, o = maybe_hop(m0, l0, o0, k, v, idx)
+    m, l, o, _, _ = lax.fori_loop(1, n, body, (m, l, o, k, v))
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (o / l_safe[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l_safe)                        # (B, H, t_q) f32
+    return out, lse
+
+
+def _make_ring_flash(axis_name: str, causal: bool, bq: int, bk: int,
+                     interpret: bool):
+    """Differentiable fused ring attention (equal shard extents).
+
+    Forward: _flash_ring_forward, keeping the log-sum-exp residual.
+
+    Backward: a second ring pass.  Each device keeps its K/V shard
+    resident and the (q, dO, lse, delta, dq-accumulator) tuple rotates;
+    at each hop the resident shard contributes via the flash backward
+    kernels (flash_bwd_block) — causal kernels for the diagonal pair,
+    unmasked for fully-visible pairs (visitor origin j > idx), skipped
+    when fully masked (j < idx).  dk/dv accumulate at home in f32; dq
+    co-rotates with its q-group and one final ppermute returns it.
+    This is the standard ring-attention backward (the memory-efficient
+    counterpart of differentiating the einsum accumulate, which would
+    rematerialize (T_local, T_local) score blocks per hop)."""
+    from ..ops.pallas_kernels import flash_bwd_block
+
+    def _fwd_pass(q, k, v):
+        return _flash_ring_forward(q, k, v, axis_name=axis_name,
+                                   causal=causal, bq=bq, bk=bk,
+                                   interpret=interpret)
+
+    @jax.custom_vjp
+    def rf(q, k, v):
+        return _fwd_pass(q, k, v)[0]
+
+    def fwd(q, k, v):
+        out, lse = _fwd_pass(q, k, v)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, do):
+        q, k, v, out, lse = res
+        n = lax.psum(1, axis_name)
+        idx = lax.axis_index(axis_name)
+        b, h, t, d = q.shape
+        bh = b * h
+        qf = q.reshape(bh, t, d)
+        kf = k.reshape(bh, t, d)
+        vf = v.reshape(bh, t, d)
+        dof = do.reshape(bh, t, d).astype(qf.dtype)
+        lsef = lse.reshape(bh, t)
+        delta = jnp.sum(dof.astype(jnp.float32)
+                        * out.reshape(bh, t, d).astype(jnp.float32),
+                        axis=-1)                      # (bh, t) f32
+
+        def block(vq, vdo, vlse, vdelta, diag):
+            # f32 outputs straight from the kernels: per-hop partials
+            # must not round to bf16 before the ring accumulation
+            return flash_bwd_block(
+                vq, kf, vf, vdo, vlse, vdelta, causal=diag,
+                block_q=bq, block_k=bk, interpret=interpret,
+                out_dtype=jnp.float32)
+
+        # s = 0: the diagonal pair (visitor == home shard)
+        dq0, dk0, dv0 = block(qf, dof, lsef, delta, diag=causal)
+
+        def body(s, carry):
+            vq, vdo, vlse, vdelta, dqv, dk, dv = carry
+            prm = [(i, (i + 1) % n) for i in range(n)]
+            vq, vdo, vlse, vdelta, dqv = (
+                lax.ppermute(x, axis_name, prm)
+                for x in (vq, vdo, vlse, vdelta, dqv))
+            j = (idx - s) % n          # visiting q-group's home shard
+
+            def contribute(_):
+                return block(vq, vdo, vlse, vdelta, diag=False)
+
+            def skip(_):
+                return (jnp.zeros((bh, t, d), jnp.float32),
+                        jnp.zeros((bh, t, d), jnp.float32),
+                        jnp.zeros((bh, t, d), jnp.float32))
+
+            if causal:
+                # visitor attends this shard's K/V iff it sits later in
+                # the global sequence (diagonal already done at s=0)
+                dqh, dkh, dvh = lax.cond(j > idx, contribute, skip,
+                                         None)
+            else:
+                dqh, dkh, dvh = contribute(None)
+            return (vq, vdo, vlse, vdelta, dqv + dqh, dk + dkh,
+                    dv + dvh)
+
+        carry = (qf, dof, lsef, delta, dq0, dk0, dv0)
+        _, _, _, _, dqv, dk32, dv32 = lax.fori_loop(1, n, body, carry)
+        # dq co-rotated n-1 times with its q-group: one more hop home
+        prm = [(i, (i + 1) % n) for i in range(n)]
+        dqv = lax.ppermute(dqv, axis_name, prm)
+        return (dqv.reshape(b, h, t, d).astype(q.dtype),
+                dk32.reshape(b, h, t, d).astype(k.dtype),
+                dv32.reshape(b, h, t, d).astype(v.dtype))
+
+    rf.defvjp(fwd, bwd)
+    return rf
 
 
 def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh, *,
@@ -144,9 +292,10 @@ def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh, *,
     """Sequence-parallel attention: (B, H, T, D) with T sharded on
     `axis_name`.  Returns output with the same sharding.
 
-    flash: False (default, differentiable einsum accumulate) | True
-    (fused Pallas accumulate per ring hop — forward-only, for
-    long-context inference/serving) | "interpret" (tests on CPU)."""
+    flash: False (default, einsum accumulate) | True (fused Pallas
+    ring, now DIFFERENTIABLE for equal shard extents — custom-VJP
+    second ring pass with the flash backward kernels, see
+    _make_ring_flash) | "interpret" (same, on CPU for tests)."""
     spec = P(None, None, axis_name, None)
     local = partial(_ring_attention_local, axis_name=axis_name,
                     causal=causal, flash=flash)

@@ -7,11 +7,13 @@ the TIME axis sharded over an `sp` mesh (the capability the reference
 lacks entirely, SURVEY §2.7/§5.7) and prints the loss curve plus a
 parity check against the unsharded step.  With no accelerator the
 script builds a virtual 8-device CPU mesh itself; on a TPU pod slice
-the same code shards over real chips.  The sharded step keeps
-attention on the GSPMD-partitionable einsum path (an opaque Pallas
-call can't be partitioned — ParallelSolver suppresses the flash
-dispatch on multi-device meshes); single-device runs with 128-aligned
-T use the Pallas flash kernel automatically.
+the same code shards over real chips.  On sp meshes the sharded step
+keeps attention on the GSPMD-partitionable einsum path (T itself is
+sharded, which the single-shard kernel can't mask); dp/tp meshes and
+single-device runs with 128-aligned T dispatch the Pallas flash
+kernel (via shard_map over batch x heads on meshes).  For hand-rolled
+long-context steps, `parallel.sp.ring_attention(flash=True)` runs the
+fused ring — now differentiable — per hop.
 """
 
 import os

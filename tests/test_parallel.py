@@ -559,3 +559,87 @@ def test_ring_attention_flash_bf16(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(jax.device_get(out), np.float32),
         np.asarray(ref), rtol=3e-2, atol=3e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_flash_grads_match(causal):
+    """The fused ring is now DIFFERENTIABLE: grads through the
+    custom-VJP second ring pass (flash backward kernels, dq co-rotating
+    with its q-group) must match autodiff of the reference attention."""
+    mesh = build_mesh(dp=2, sp=4)
+    rng = np.random.RandomState(5)
+    b, h, t, d = 2, 2, 64, 16
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention(q, k, v, causal=causal) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=causal,
+                                      flash="interpret") ** 2)
+
+    ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b_ in zip("qkv", ref, got):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(b_)), np.asarray(a),
+            rtol=5e-4, atol=5e-5, err_msg=f"d{name} causal={causal}")
+
+
+def test_ring_attention_flash_trains_sequence_parallel():
+    """End to end: a toy attention 'layer' trained with the fused
+    differentiable ring on a dp2×sp4 mesh tracks the einsum-ring
+    trajectory step for step."""
+    mesh = build_mesh(dp=2, sp=4)
+    rng = np.random.RandomState(6)
+    b, h, t, d = 2, 2, 64, 8
+    x = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    tgt = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    w0 = jnp.asarray(rng.randn(d, d) * 0.3, jnp.float32)
+
+    def make_step(flash):
+        def loss(w, x, tgt):
+            qkv = jnp.einsum("bhtd,de->bhte", x, w)
+            out = ring_attention(qkv, qkv, qkv, mesh, causal=True,
+                                 flash=flash)
+            return jnp.mean((out - tgt) ** 2)
+
+        def step(w, x, tgt):
+            l, g = jax.value_and_grad(loss)(w, x, tgt)
+            return w - 0.5 * g, l
+        return jax.jit(step)
+
+    s_ein = make_step(False)
+    s_fl = make_step("interpret")
+    w_e, w_f = w0, w0
+    for i in range(3):
+        w_e, l_e = s_ein(w_e, x, tgt)
+        w_f, l_f = s_fl(w_f, x, tgt)
+        assert float(l_f) == pytest.approx(float(l_e), rel=2e-4), i
+    np.testing.assert_allclose(np.asarray(w_f), np.asarray(w_e),
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_ring_attention_flash_grads_bf16():
+    """bf16 grads through the fused ring: per-hop partials come out of
+    the backward kernels in f32 (out_dtype) and accumulate in f32, so
+    error stays at bf16 input resolution."""
+    mesh = build_mesh(dp=2, sp=4)
+    rng = np.random.RandomState(7)
+    b, h, t, d = 1, 2, 64, 16
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+
+    def loss(fn):
+        return lambda a: jnp.sum(fn(a).astype(jnp.float32) ** 2)
+
+    g_ref = jax.grad(loss(lambda a: attention(
+        a.astype(jnp.float32), a.astype(jnp.float32),
+        a.astype(jnp.float32), causal=True)))(q.astype(jnp.float32))
+    g_fl = jax.grad(loss(lambda a: ring_attention(
+        a, a, a, mesh, causal=True, flash="interpret")))(q)
+    assert g_fl.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(g_fl), np.float32),
+        np.asarray(g_ref), rtol=6e-2, atol=6e-2)
