@@ -205,11 +205,15 @@ class DataSource:
 
     def _decode_encoded_batch(self, records, c, h, w) -> np.ndarray:
         from .. import native
+        # under the device-transform split the native decoder writes
+        # uint8 planes directly — no float buffer, no host cast pass
+        dt = np.uint8 if self._device_transform else np.float32
         if native.available():
             try:
                 return native.decode_batch(
                     [r[6] for r in records], channels=c, out_h=h,
-                    out_w=w, num_threads=self.num_threads)
+                    out_w=w, num_threads=self.num_threads,
+                    out_dtype=dt)
             except ValueError:
                 pass  # corrupt image somewhere: per-image path reports it
         n = len(records)

@@ -31,11 +31,18 @@ _build_failed = False
 
 
 def build(force: bool = False) -> bool:
-    """Compile the shared library; returns True on success."""
+    """Compile the shared library; returns True on success.  A shipped
+    .so without the source (pruned deployment) is accepted as-is."""
     global _build_failed
-    if os.path.exists(_SO) and not force \
-            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return True
+    if os.path.exists(_SO) and not force:
+        try:
+            if (not os.path.exists(_SRC)
+                    or os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+                return True
+        except OSError:
+            return True       # can't stat: trust the shipped .so
+    if not os.path.exists(_SRC):
+        return os.path.exists(_SO)
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
            _SRC, "-o", _SO, "-ljpeg"]
     try:
@@ -60,30 +67,55 @@ def get_lib() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO) and not build():
+        # build() is a no-op when the .so is current; a source edit
+        # (newer mtime) triggers a rebuild so new symbols exist
+        if not build():
             return None
         try:
             lib = ctypes.CDLL(_SO)
+            _bind(lib)
         except OSError:
             _build_failed = True
             return None
-        lib.cos_decode_batch.restype = ctypes.c_int
-        lib.cos_decode_batch.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
-            ctypes.POINTER(ctypes.c_long), ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int]
-        lib.cos_transform_batch.restype = None
-        lib.cos_transform_batch.argtypes = [
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(ctypes.c_ubyte),
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int,
-            ctypes.c_float, ctypes.POINTER(ctypes.c_float), ctypes.c_int]
-        lib.cos_native_version.restype = ctypes.c_int
+        except AttributeError:
+            # stale .so lacking newer symbols (mtime-preserving copy):
+            # one forced rebuild if the source is around, else give up
+            # and let callers fall back to the cv2 path
+            if not (os.path.exists(_SRC) and build(force=True)):
+                _build_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(_SO)
+                _bind(lib)
+            except (OSError, AttributeError):
+                _build_failed = True
+                return None
         _lib = lib
         return _lib
+
+
+def _bind(lib) -> None:
+    lib.cos_decode_batch.restype = ctypes.c_int
+    lib.cos_decode_batch.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+        ctypes.POINTER(ctypes.c_long), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+    lib.cos_decode_batch_u8.restype = ctypes.c_int
+    lib.cos_decode_batch_u8.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+        ctypes.POINTER(ctypes.c_long), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.c_int]
+    lib.cos_transform_batch.restype = None
+    lib.cos_transform_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_ubyte),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+        ctypes.c_float, ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+    lib.cos_native_version.restype = ctypes.c_int
 
 
 def available() -> bool:
@@ -91,8 +123,14 @@ def available() -> bool:
 
 
 def decode_batch(images: Sequence[bytes], *, channels: int, out_h: int,
-                 out_w: int, num_threads: int = 0) -> np.ndarray:
-    """JPEG bytes → (N, C, out_h, out_w) float32 BGR planes."""
+                 out_w: int, num_threads: int = 0,
+                 out_dtype=np.float32) -> np.ndarray:
+    """JPEG bytes → (N, C, out_h, out_w) BGR planes.
+
+    out_dtype float32 (default) or uint8 — the uint8 path decodes
+    straight into byte planes for the device-transform split
+    (COS_DEVICE_TRANSFORM): no float buffer, no host cast pass, and
+    its truncating store equals `float_output.astype(uint8)` exactly."""
     lib = get_lib()
     if lib is None:
         raise RuntimeError("native library unavailable")
@@ -101,12 +139,17 @@ def decode_batch(images: Sequence[bytes], *, channels: int, out_h: int,
     offsets = np.zeros(n, np.int64)
     sizes = np.asarray([len(b) for b in images], np.int64)
     np.cumsum(sizes[:-1], out=offsets[1:]) if n > 1 else None
-    out = np.empty((n, channels, out_h, out_w), np.float32)
-    ok = lib.cos_decode_batch(
+    if np.dtype(out_dtype) == np.uint8:
+        out = np.empty((n, channels, out_h, out_w), np.uint8)
+        fn, ptr = lib.cos_decode_batch_u8, ctypes.c_ubyte
+    else:
+        out = np.empty((n, channels, out_h, out_w), np.float32)
+        fn, ptr = lib.cos_decode_batch, ctypes.c_float
+    ok = fn(
         blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
         sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
         n, channels, out_h, out_w,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), num_threads)
+        out.ctypes.data_as(ctypes.POINTER(ptr)), num_threads)
     if ok != n:
         raise ValueError(f"{n - ok}/{n} images failed to decode")
     return out

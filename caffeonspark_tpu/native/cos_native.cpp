@@ -71,9 +71,12 @@ bool decode_jpeg_raw(const unsigned char* data, long size, int channels,
   return true;
 }
 
-// bilinear resize + HWC(RGB) → CHW(BGR) float
+// bilinear resize + HWC(RGB) → CHW(BGR).  Dst is float or uint8; the
+// uint8 store TRUNCATES (matches numpy astype(uint8) on the float
+// output, so the uint8-infeed path equals cast(float path) exactly).
+template <typename T>
 void resize_to_chw(const unsigned char* src, int sh, int sw, int channels,
-                   int dh, int dw, float* dst) {
+                   int dh, int dw, T* dst) {
   const float ys = dh > 1 ? static_cast<float>(sh - 1) / (dh - 1) : 0.0f;
   const float xs = dw > 1 ? static_cast<float>(sw - 1) / (dw - 1) : 0.0f;
   for (int y = 0; y < dh; ++y) {
@@ -95,10 +98,41 @@ void resize_to_chw(const unsigned char* src, int sh, int sw, int channels,
                   p10 * wy * (1 - wx) + p11 * wy * wx;
         // BGR plane order: plane (channels-1-c) receives RGB channel c
         int plane = channels == 3 ? 2 - c : c;
-        dst[(static_cast<size_t>(plane) * dh + y) * dw + x] = v;
+        dst[(static_cast<size_t>(plane) * dh + y) * dw + x] =
+            static_cast<T>(v);
       }
     }
   }
+}
+
+template <typename T>
+int decode_batch_impl(const unsigned char* blob, const long* offsets,
+                      const long* sizes, int n, int channels, int out_h,
+                      int out_w, T* out, int num_threads) {
+  std::atomic<int> ok(0);
+  std::atomic<int> next(0);
+  int nthreads = num_threads > 0
+                     ? num_threads
+                     : static_cast<int>(std::thread::hardware_concurrency());
+  nthreads = std::max(1, std::min(nthreads, n));
+  auto worker = [&]() {
+    std::vector<unsigned char> pixels;
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
+      T* dst = out + static_cast<size_t>(i) * channels * out_h * out_w;
+      int h = 0, w = 0;
+      if (decode_jpeg_raw(blob + offsets[i], sizes[i], channels, &pixels,
+                          &h, &w)) {
+        resize_to_chw(pixels.data(), h, w, channels, out_h, out_w, dst);
+        ok.fetch_add(1);
+      } else {
+        std::memset(dst, 0, sizeof(T) * channels * out_h * out_w);
+      }
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 0; t < nthreads; ++t) pool.emplace_back(worker);
+  for (auto& t : pool) t.join();
+  return ok.load();
 }
 
 }  // namespace
@@ -112,31 +146,19 @@ extern "C" {
 int cos_decode_batch(const unsigned char* blob, const long* offsets,
                      const long* sizes, int n, int channels, int out_h,
                      int out_w, float* out, int num_threads) {
-  std::atomic<int> ok(0);
-  std::atomic<int> next(0);
-  int nthreads = num_threads > 0
-                     ? num_threads
-                     : static_cast<int>(std::thread::hardware_concurrency());
-  nthreads = std::max(1, std::min(nthreads, n));
-  auto worker = [&]() {
-    std::vector<unsigned char> pixels;
-    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
-      float* dst = out + static_cast<size_t>(i) * channels * out_h * out_w;
-      int h = 0, w = 0;
-      if (decode_jpeg_raw(blob + offsets[i], sizes[i], channels, &pixels,
-                          &h, &w)) {
-        resize_to_chw(pixels.data(), h, w, channels, out_h, out_w, dst);
-        ok.fetch_add(1);
-      } else {
-        std::memset(dst, 0,
-                    sizeof(float) * channels * out_h * out_w);
-      }
-    }
-  };
-  std::vector<std::thread> pool;
-  for (int t = 0; t < nthreads; ++t) pool.emplace_back(worker);
-  for (auto& t : pool) t.join();
-  return ok.load();
+  return decode_batch_impl(blob, offsets, sizes, n, channels, out_h,
+                           out_w, out, num_threads);
+}
+
+// uint8 output variant for the device-transform split
+// (COS_DEVICE_TRANSFORM): the feed ships 1 byte/pixel, so decode
+// straight into uint8 planes — no float buffer, no host cast pass.
+int cos_decode_batch_u8(const unsigned char* blob, const long* offsets,
+                        const long* sizes, int n, int channels,
+                        int out_h, int out_w, unsigned char* out,
+                        int num_threads) {
+  return decode_batch_impl(blob, offsets, sizes, n, channels, out_h,
+                           out_w, out, num_threads);
 }
 
 // Caffe transform_param semantics on an NCHW float batch:

@@ -86,6 +86,50 @@ def main(seq_len: int = 32):
         assert delta < 1e-3 * max(1.0, abs(loss))
     print("sequence-parallel training matches the single-device step")
 
+    if sp_n > 1:
+        _fused_ring_demo(sp_n, dp_n, seq_len)
+
+
+def _fused_ring_demo(sp_n: int, dp_n: int, seq_len: int):
+    """Hand-rolled long-context step on the DIFFERENTIABLE fused ring:
+    ring_attention(flash=...) trains with per-hop Pallas kernels (the
+    custom-VJP second ring pass) — interpret-mode on CPU meshes, the
+    compiled Mosaic kernels on a real pod."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from caffeonspark_tpu.parallel import build_mesh
+    from caffeonspark_tpu.parallel.sp import ring_attention
+
+    mesh = build_mesh(dp=dp_n, sp=sp_n)
+    flash = "interpret" if jax.default_backend() == "cpu" else True
+    rng = np.random.RandomState(1)
+    b, h, d = 2, 2, 16
+    t = max(seq_len, 8 * sp_n)
+    x = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    tgt = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    w = jnp.asarray(rng.randn(d, d) * 0.3, jnp.float32)
+
+    @jax.jit
+    def ring_step(w):
+        def loss(w):
+            qkv = jnp.einsum("bhtd,de->bhte", x, w)
+            out = ring_attention(qkv, qkv, qkv, mesh, causal=True,
+                                 flash=flash)
+            return jnp.mean((out - tgt) ** 2)
+        l, g = jax.value_and_grad(loss)(w)
+        return w - 0.5 * g, l
+
+    losses = []
+    for _ in range(5):
+        w, l = ring_step(w)
+        losses.append(float(jax.device_get(l)))
+    print("fused-ring (differentiable flash) losses: "
+          + "  ".join(f"{l:.4f}" for l in losses))
+    assert losses[-1] < losses[0] and np.isfinite(losses).all()
+    print("fused ring attention trains end to end")
+
 
 if __name__ == "__main__":
     main(int(sys.argv[1]) if len(sys.argv) > 1 else 32)

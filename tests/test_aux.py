@@ -213,3 +213,4 @@ def test_long_context_example(tmp_path):
         cwd="/root/repo")
     assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-800:])
     assert "matches the single-device step" in r.stdout
+    assert "fused ring attention trains end to end" in r.stdout

@@ -83,3 +83,49 @@ def test_transform_mean_plane(lib):
     got = native.transform_batch(batch, mean=meanp, scale=2.0)
     np.testing.assert_allclose(got, (batch - meanp[None]) * 2.0,
                                rtol=1e-6)
+
+
+def test_decode_batch_uint8_equals_float_cast(lib):
+    """The uint8 decode path (device-transform split) must equal the
+    float path truncated to uint8 — same pixels on the wire, no float
+    buffer in between.  Resized output exercises the fractional
+    bilinear values where truncation actually matters."""
+    jpegs = _jpegs()
+    f32 = native.decode_batch(jpegs, channels=3, out_h=24, out_w=24)
+    u8 = native.decode_batch(jpegs, channels=3, out_h=24, out_w=24,
+                             out_dtype=np.uint8)
+    assert u8.dtype == np.uint8
+    np.testing.assert_array_equal(u8, f32.astype(np.uint8))
+
+
+def test_source_ships_uint8_from_native_decode(lib, tmp_path, monkeypatch):
+    """Encoded-image sources under COS_DEVICE_TRANSFORM pack uint8
+    straight from the native decoder (no float round trip)."""
+    monkeypatch.setenv("COS_DEVICE_TRANSFORM", "1")
+    import cv2
+    from caffeonspark_tpu.data.lmdb_io import LmdbWriter
+    from caffeonspark_tpu.data.source import get_source
+    from caffeonspark_tpu.proto.caffe import Datum, LayerParameter
+
+    rng = np.random.RandomState(0)
+    recs = []
+    for i in range(8):
+        img = rng.randint(0, 255, (20, 20, 3), np.uint8)
+        ok, buf = cv2.imencode(".jpg", img)
+        assert ok
+        d = Datum(channels=3, height=20, width=20, label=i % 3,
+                  data=bytes(buf.tobytes()), encoded=True)
+        recs.append((b"%08d" % i, d.to_binary()))
+    LmdbWriter(str(tmp_path / "data.mdb")).write(recs)
+    lp = LayerParameter.from_text(f'''
+        name: "data" type: "MemoryData" top: "data" top: "label"
+        source_class: "com.yahoo.ml.caffe.LMDB"
+        transform_param {{ scale: 0.00390625 }}
+        memory_data_param {{
+          source: "file:{tmp_path}"
+          batch_size: 4 channels: 3 height: 16 width: 16 }}''')
+    src = get_source(lp, phase_train=True, seed=0, resize=True)
+    assert src.enable_device_transform() is not None
+    batch = next(src.batches(loop=False, shuffle=False))
+    assert batch["data"].dtype == np.uint8
+    assert batch["data"].shape == (4, 3, 16, 16)
