@@ -92,6 +92,7 @@ class DataSource:
             phase_train=phase_train, seed=seed + rank,
             mean_dir=os.path.dirname(self.source_uri()) or None)
         self._device_transform = False
+        self._device_fns = None
 
     # -- config ------------------------------------------------------------
     def _batch_size(self) -> int:
@@ -197,11 +198,39 @@ class DataSource:
             return None
         if not self.transformer.device_eligible(h, w):
             return None
+        import jax
         import jax.numpy as jnp
         out_dtype = None if net_dtype in (None, jnp.float32) else net_dtype
         self._device_transform = True
-        return {self.layer.top[0]:
-                self.transformer.device_stage_fn(out_dtype)}
+        fns = {self.layer.top[0]:
+               self.transformer.device_stage_fn(out_dtype)}
+        # jitted copies for direct consumers (apply_device_stage);
+        # device_prefetch jits the raw fns itself
+        self._device_fns = {k: jax.jit(f) for k, f in fns.items()}
+        return fns
+
+    def apply_device_stage(self, batch, shardings=None):
+        """Finish the split for consumers that call next_batch directly
+        (validation rounds, feature extraction) instead of feeding
+        through device_prefetch: run the jitted device stage on any
+        uint8+aux tops.  `shardings` ({top: NamedSharding}) places the
+        uint8/aux arrays BEFORE the stage so the output matches a
+        sharded step's in_shardings.  No-op when the split is off."""
+        if not self._device_transform \
+                or not getattr(self, "_device_fns", None):
+            return batch
+        import jax
+        out = dict(batch)
+        for k, f in self._device_fns.items():
+            aux = out.pop(k + DEVICE_AUX_SUFFIX, None)
+            if aux is None:
+                continue
+            v = out[k]
+            if shardings is not None and k in shardings:
+                v = jax.device_put(v, shardings[k])
+                aux = jax.device_put(aux, shardings[k])
+            out[k] = f(v, aux)
+        return out
 
     def _decode_encoded_batch(self, records, c, h, w) -> np.ndarray:
         from .. import native

@@ -116,6 +116,7 @@ class CaffeProcessor:
         self.dropped_val_batches = 0  # informational (round shrinks)
         self._consecutive_drops = 0
         self._snapshotter = None      # lazy AsyncSnapshotter (-async_snapshot)
+        self._val_shardings = None    # set when the val feed splits
         self.params = None
         self.opt_state = None
 
@@ -273,6 +274,15 @@ class CaffeProcessor:
             dxf = (self.train_source.enable_device_transform(
                        solver.train_net.dtype)
                    if self.train_source is not None else None)
+            # validation feed takes the same split (center crop on
+            # uint8 host-side, mean/scale on device before eval_step);
+            # the stage output must carry eval_step's input shardings
+            self._val_shardings = None
+            if self.val_source is not None and solver.test_net is not None:
+                if self.val_source.enable_device_transform(
+                        solver.test_net.dtype):
+                    self._val_shardings = ps.input_shardings(
+                        solver.test_net)
             gen = device_prefetch(
                 combine_batches(self._train_batches(),
                                 max(1, sp.iter_size), tmajor),
@@ -328,6 +338,8 @@ class CaffeProcessor:
             if len(buf) == src.batch_size:
                 batch = self._pack_or_drop(src, buf, val=True)
                 if batch is not None:
+                    batch = src.apply_device_stage(
+                        batch, self._val_shardings)
                     out = eval_step(params, batch)
                     self.validation.add_batch(out)
                 buf = []
@@ -431,7 +443,10 @@ class CaffeProcessor:
             repeat per row, CaffeOnSpark.scala:499-507)."""
             nonlocal buf, ids
             bs = len(buf)
-            out = fwd(self.params, source.next_batch(buf))
+            # a split-enabled source (train-then-features on the same
+            # processor) emits uint8+aux: finish the transform here
+            out = fwd(self.params,
+                      source.apply_device_stage(source.next_batch(buf)))
             fetched = {bn: np.asarray(jax.device_get(out[bn]))
                        for bn in blob_names}
             for i in range(real):

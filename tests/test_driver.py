@@ -130,6 +130,40 @@ def test_train_with_validation_interleave(setup):
     assert final["loss"] < 0.5, df.rows
 
 
+def test_train_with_validation_interleave_device_transform(
+        setup, monkeypatch):
+    """The full trainWithValidation choreography under the uint8-infeed
+    split — BOTH feeds (train batches through device_prefetch, the
+    validation round through eval_step) run the device-side mean/scale
+    stage — and clears the same InterleaveTest quality bars."""
+    monkeypatch.setenv("COS_DEVICE_TRANSFORM", "1")
+    # the processor packs with ITS OWN source objects — spy on the
+    # split's host stage to prove BOTH feeds engaged: the train feed
+    # (TRAIN-phase transformer) and the validation feed (TEST-phase)
+    from caffeonspark_tpu.data.transformer import Transformer
+    phases = set()
+    orig = Transformer.host_stage
+
+    def spy(self, batch):
+        phases.add(self.train)
+        return orig(self, batch)
+
+    monkeypatch.setattr(Transformer, "host_stage", spy)
+    tmp, solver = setup
+    conf = Config(["-conf", str(solver), "-train"])
+    cos = CaffeOnSpark()
+    train_src = get_source(conf.train_data_layer(), phase_train=True,
+                           seed=1)
+    val_src = get_source(conf.test_data_layer(), phase_train=False,
+                         seed=1)
+    df = cos.trainWithValidation(train_src, val_src, conf)
+    assert phases == {True, False}, (
+        f"both feeds must take the split, saw phases={phases}")
+    final = df.rows[-1]
+    assert final["accuracy"] > 0.8, df.rows
+    assert final["loss"] < 0.5, df.rows
+
+
 def test_validation_source_identical_across_ranks(setup):
     """The reference feeds every rank the SAME validation data in
     lockstep (CaffeOnSpark.scala:293-302: the one validation partition
