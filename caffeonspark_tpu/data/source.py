@@ -227,8 +227,17 @@ class DataSource:
                 continue
             v = out[k]
             if shardings is not None and k in shardings:
-                v = jax.device_put(v, shardings[k])
-                aux = jax.device_put(aux, shardings[k])
+                sh = shardings[k]
+                if jax.process_count() > 1:
+                    # multi-host: assemble the global array from this
+                    # process's local shard (device_put can't target
+                    # non-addressable devices) — same rule as
+                    # queue_runner.device_prefetch's put_one
+                    v = jax.make_array_from_process_local_data(sh, v)
+                    aux = jax.make_array_from_process_local_data(sh, aux)
+                else:
+                    v = jax.device_put(v, sh)
+                    aux = jax.device_put(aux, sh)
             out[k] = f(v, aux)
         return out
 

@@ -433,6 +433,11 @@ class CaffeProcessor:
         source = source or self.feature_source()
         assert source is not None, "no data layer to decode records with"
         fwd = self._feature_fwd(tuple(blob_names))
+        feat_shardings = None
+        if getattr(source, "_device_transform", False) \
+                and self.psolver is not None:
+            feat_shardings = self.psolver.input_shardings(
+                self.solver.test_net or self.solver.train_net)
         rows: List[Dict[str, Any]] = []
         buf: List = []
         ids: List[str] = []
@@ -444,9 +449,12 @@ class CaffeProcessor:
             nonlocal buf, ids
             bs = len(buf)
             # a split-enabled source (train-then-features on the same
-            # processor) emits uint8+aux: finish the transform here
+            # processor) emits uint8+aux: finish the transform here,
+            # placed on the mesh so mesh-sharded params and the input
+            # agree on devices
             out = fwd(self.params,
-                      source.apply_device_stage(source.next_batch(buf)))
+                      source.apply_device_stage(source.next_batch(buf),
+                                                feat_shardings))
             fetched = {bn: np.asarray(jax.device_get(out[bn]))
                        for bn in blob_names}
             for i in range(real):

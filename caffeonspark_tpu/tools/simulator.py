@@ -59,20 +59,31 @@ def run(args) -> dict:
     transformer = Transformer(tp, phase_train=True, seed=0)
     results = {}
 
-    paths = (["native", "python"] if args.path == "both"
+    paths = (["native", "python", "devxf"] if args.path == "both"
              else [args.path])
     for path in paths:
-        if path == "native":
+        xform = transformer
+        if path in ("native", "devxf"):
             from .. import native
             if not native.available():
                 print("native library unavailable; skipping",
                       file=sys.stderr)
                 continue
+            u8 = path == "devxf"
 
-            def decode(batch_bytes):
+            def decode(batch_bytes, _u8=u8):
                 return native.decode_batch(
                     batch_bytes, channels=args.channels,
-                    out_h=args.height, out_w=args.width)
+                    out_h=args.height, out_w=args.width,
+                    out_dtype=np.uint8 if _u8 else np.float32)
+
+            if u8:
+                # the device-transform split's host half: uint8 decode
+                # + crop/mirror only (mean/scale run on-device)
+                split = Transformer(tp, phase_train=True, seed=0)
+
+                def xform(arr, _s=split):
+                    return _s.host_stage(arr)[0]
         else:
             from ..data.source import decode_image
 
@@ -82,25 +93,31 @@ def run(args) -> dict:
                                  resize_hw=(args.height, args.width))
                     for b in batch_bytes])
 
-        # warmup
+        # warmup (also binds `out` for -iterations 0 runs)
         batch_bytes = [jpegs[i % len(jpegs)] for i in range(n)]
-        transformer(decode(batch_bytes))
+        out = xform(decode(batch_bytes))
         t0 = time.perf_counter()
         for it in range(args.iterations):
             batch_bytes = [jpegs[(it * n + i) % len(jpegs)]
                            for i in range(n)]
             arr = decode(batch_bytes)
-            out = transformer(arr)
+            out = xform(arr)
         dt = time.perf_counter() - t0
         ips = n * args.iterations / dt
         results[path] = ips
+        wire = out.nbytes // n
         print(f"{path:7s}: {args.iterations} x batch {n} "
               f"({args.height}x{args.width}x{args.channels}) in "
               f"{dt:.2f}s = {ips:.1f} images/sec  "
-              f"out={tuple(out.shape)}")
-    if len(results) == 2:
+              f"out={tuple(out.shape)} {out.dtype} "
+              f"({wire} B/img to device)")
+    if "native" in results and "python" in results:
         print(f"native speedup: "
               f"{results['native'] / results['python']:.2f}x")
+    if "devxf" in results and "native" in results:
+        print(f"devxf host-side speedup vs native+f32-transform: "
+              f"{results['devxf'] / results['native']:.2f}x "
+              f"(and 4x fewer bytes to the device)")
     return results
 
 
@@ -117,7 +134,8 @@ def main(argv=None) -> int:
     p.add_argument("-channels", type=int, default=3)
     p.add_argument("-crop", action="store_true",
                    help="apply random crop in the transform")
-    p.add_argument("-path", choices=["native", "python", "both"],
+    p.add_argument("-path",
+                   choices=["native", "python", "devxf", "both"],
                    default="both")
     run(p.parse_args(argv))
     return 0

@@ -125,6 +125,9 @@ def test_simulator_cli():
         capture_output=True, text=True, timeout=120, env=env)
     assert r.returncode == 0, r.stderr[-600:]
     assert "images/sec" in r.stdout
+    # the uint8 split's host half reports its wire size (1 B/px)
+    if "devxf" in r.stdout:
+        assert "uint8" in r.stdout
 
 
 def test_display_utils(tmp_path):
