@@ -111,10 +111,10 @@ def run(args) -> dict:
               f"{dt:.2f}s = {ips:.1f} images/sec  "
               f"out={tuple(out.shape)} {out.dtype} "
               f"({wire} B/img to device)")
-    if "native" in results and "python" in results:
+    if results.get("python") and results.get("native"):
         print(f"native speedup: "
               f"{results['native'] / results['python']:.2f}x")
-    if "devxf" in results and "native" in results:
+    if results.get("native") and results.get("devxf"):
         print(f"devxf host-side speedup vs native+f32-transform: "
               f"{results['devxf'] / results['native']:.2f}x "
               f"(and 4x fewer bytes to the device)")

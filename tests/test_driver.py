@@ -231,6 +231,28 @@ def test_features_and_test(setup):
             os.unlink(p)
 
 
+def test_features_with_device_transform(setup, monkeypatch):
+    """features2 over a split-enabled source: extract_rows finishes
+    the device stage (apply_device_stage), producing features equal to
+    the host-transform run."""
+    import numpy as np
+    tmp, solver = setup
+    fconf = Config(["-conf", str(solver),
+                    "-features", "ip2", "-label", "label"])
+    cos = CaffeOnSpark()
+    src = get_source(fconf.test_data_layer(), phase_train=False, seed=1)
+    df_ref = cos.features2(src, fconf)
+
+    monkeypatch.setenv("COS_DEVICE_TRANSFORM", "1")
+    src2 = get_source(fconf.test_data_layer(), phase_train=False, seed=1)
+    assert src2.enable_device_transform() is not None
+    df = cos.features2(src2, fconf)   # same singleton => same params
+    assert len(df) == len(df_ref) and len(df) > 0
+    for a, b in zip(df_ref.rows, df.rows):
+        assert a["SampleID"] == b["SampleID"]
+        np.testing.assert_allclose(b["ip2"], a["ip2"], rtol=1e-6)
+
+
 def test_vector_mean():
     df = DataFrame([{"v": [1.0, 2.0]}, {"v": [3.0, 4.0]}])
     assert vector_mean(df, "v") == [2.0, 3.0]

@@ -406,12 +406,18 @@ def test_feed_client_rejects_after_stop(conf, tmp_path):
             pass
 
 
-def test_engine_interleave_validation_and_report(tmp_path, monkeypatch):
+@pytest.mark.parametrize("devxf", [False, True])
+def test_engine_interleave_validation_and_report(tmp_path, monkeypatch,
+                                                 devxf):
     """trainWithValidation through the ENGINE: setup() propagates the
     interleave flag to the executor-resident processor, validation rows
     come back over the daemon's REPORT op, and wait_done() observes the
     solver finishing — the driver-side choreography of
-    CaffeOnSpark.scala:239-358 under the barrier double."""
+    CaffeOnSpark.scala:239-358 under the barrier double.  devxf=True
+    repeats the whole choreography with the uint8-infeed split engaged
+    in the executor-resident processor."""
+    if devxf:
+        monkeypatch.setenv("COS_DEVICE_TRANSFORM", "1")
     monkeypatch.setattr(
         spark_mod, "_get_barrier_context",
         lambda: _FakeBarrierContext._local.ctx)
