@@ -240,6 +240,7 @@ def test_features_with_device_transform(setup, monkeypatch):
     fconf = Config(["-conf", str(solver),
                     "-features", "ip2", "-label", "label"])
     cos = CaffeOnSpark()
+    monkeypatch.delenv("COS_DEVICE_TRANSFORM", raising=False)
     src = get_source(fconf.test_data_layer(), phase_train=False, seed=1)
     df_ref = cos.features2(src, fconf)
 

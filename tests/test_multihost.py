@@ -53,30 +53,51 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
                       'lr_policy: "fixed"\ndisplay: 5\nmax_iter: 10\n'
                       'snapshot_prefix: "mh"\nrandom_seed: 9\n')
 
-    port = _free_port()
-    env = {**os.environ, "JAX_PLATFORMS": "cpu",
-           "PALLAS_AXON_POOL_IPS": "",
-           "PYTHONPATH": "/root/repo" + os.pathsep
-           + os.environ.get("PYTHONPATH", "")}
-    procs = []
-    for rank in range(2):
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
-             "-solver", str(solver), "-train", str(tmp_path / "lmdb"),
-             "-output", str(tmp_path / "out"),
-             "-server", f"127.0.0.1:{port}",
-             "-cluster", "2", "-rank", str(rank)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True, env=env, cwd="/root/repo"))
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=520)
-        outs.append(out)
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {rank}:\n{out[-2000:]}"
+    def run_cluster(outdir, extra_env):
+        port = _free_port()
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PALLAS_AXON_POOL_IPS": "",
+               # baseline runs must NOT inherit the split from the
+               # outer shell — parity would compare split vs split
+               "COS_DEVICE_TRANSFORM": "",
+               "PYTHONPATH": "/root/repo" + os.pathsep
+               + os.environ.get("PYTHONPATH", ""), **extra_env}
+        procs = []
+        for rank in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
+                 "-solver", str(solver), "-train", str(tmp_path / "lmdb"),
+                 "-output", str(outdir),
+                 "-server", f"127.0.0.1:{port}",
+                 "-cluster", "2", "-rank", str(rank)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd="/root/repo"))
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=520)
+            outs.append(out)
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank}:\n{out[-2000:]}"
+        return outs
+
+    outs = run_cluster(tmp_path / "out", {})
     # rank 0 wrote the final model; rank 1 did not
     assert "final model" in outs[0]
     assert "final model" not in outs[1]
     assert os.path.exists(tmp_path / "out" / "mh_iter_10.caffemodel")
     # both ranks trained in lockstep to max_iter
     assert "iter 10/10" in outs[0] and "iter 10/10" in outs[1]
+
+    # same cluster under the uint8-infeed split: the multi-process
+    # make_array_from_process_local_data branch carries uint8+aux and
+    # the trained model must match the host-transform run
+    outs2 = run_cluster(tmp_path / "out2",
+                        {"COS_DEVICE_TRANSFORM": "1"})
+    assert "iter 10/10" in outs2[0] and "iter 10/10" in outs2[1]
+    from caffeonspark_tpu.checkpoint import load_caffemodel_blobs
+    a = load_caffemodel_blobs(str(tmp_path / "out" / "mh_iter_10.caffemodel"))
+    b = load_caffemodel_blobs(str(tmp_path / "out2" / "mh_iter_10.caffemodel"))
+    for k in a:
+        for pa, pb in zip(a[k], b[k]):
+            np.testing.assert_allclose(np.asarray(pb), np.asarray(pa),
+                                       rtol=1e-5, atol=1e-6)

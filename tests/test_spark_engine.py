@@ -418,6 +418,8 @@ def test_engine_interleave_validation_and_report(tmp_path, monkeypatch,
     in the executor-resident processor."""
     if devxf:
         monkeypatch.setenv("COS_DEVICE_TRANSFORM", "1")
+    else:
+        monkeypatch.delenv("COS_DEVICE_TRANSFORM", raising=False)
     monkeypatch.setattr(
         spark_mod, "_get_barrier_context",
         lambda: _FakeBarrierContext._local.ctx)
