@@ -1139,16 +1139,15 @@ def _mha_params(lp, shapes):
 
 
 _FLASH_SUPPRESS = 0      # >0 while tracing a multi-device SPMD step
-_FLASH_MESH: list = []   # (mesh, batch_axes, head_axes) stack
+_FLASH_MESH: list = []   # (mesh, batch_axes, head_axes, time_axes)
 
 
 @contextlib.contextmanager
 def suppress_flash():
-    """Disable the flash-attention dispatch for the duration (used by
-    ParallelSolver while tracing steps on meshes the shard_map route
-    can't serve, e.g. sequence-parallel ones: a bare pallas_call is
-    opaque to the GSPMD partitioner, which would replicate it and
-    all-gather its sharded operands)."""
+    """Disable the flash-attention dispatch for the duration — an
+    explicit opt-out for callers (and tests) that need the einsum
+    path regardless of backend; ParallelSolver itself now always
+    installs the flash_mesh route on multi-device meshes."""
     global _FLASH_SUPPRESS
     _FLASH_SUPPRESS += 1
     try:
@@ -1158,13 +1157,18 @@ def suppress_flash():
 
 
 @contextlib.contextmanager
-def flash_mesh(mesh, batch_axes=("dp",), head_axes=("tp",)):
+def flash_mesh(mesh, batch_axes=("dp",), head_axes=("tp",),
+               time_axes=("sp",)):
     """Route the flash dispatch through shard_map over `mesh` for the
     duration of a trace.  Attention is embarrassingly parallel over
     batch x heads, so each device runs the kernel on its (B/dp, H/tp)
-    local block — the GSPMD-compatible way to keep Pallas flash in
-    multi-device steps instead of falling back to the einsum path."""
-    _FLASH_MESH.append((mesh, tuple(batch_axes), tuple(head_axes)))
+    local block; when the mesh also shards TIME (sp axis), the body is
+    the differentiable fused RING (parallel.sp._ring_attention_local)
+    — K/V shards rotate on ppermute while flash kernels accumulate —
+    so prototxt-driven sequence-parallel training gets ring+flash
+    without hand-rolled steps."""
+    _FLASH_MESH.append((mesh, tuple(batch_axes), tuple(head_axes),
+                        tuple(time_axes)))
     try:
         yield
     finally:
@@ -1189,31 +1193,47 @@ def _attention_dispatch(q, k, v, *, causal: bool):
     # only 128-aligned sequence lengths take the kernel: Mosaic block
     # shapes must tile (8, 128), and at small T the O(T²) XLA path is
     # cheap anyway
-    if ((pallas_enabled() or interpret) and not _FLASH_SUPPRESS
-            and not os.environ.get("COS_DISABLE_FLASH")
-            and t % 128 == 0):
-        if _FLASH_MESH:
-            mesh, b_axes, h_axes = _FLASH_MESH[-1]
-            shape = dict(mesh.shape)
-            b_axes = tuple(a for a in b_axes if shape.get(a, 1) > 1)
-            h_axes = tuple(a for a in h_axes if shape.get(a, 1) > 1)
-            nb = math.prod(shape[a] for a in b_axes) if b_axes else 1
-            nh = math.prod(shape[a] for a in h_axes) if h_axes else 1
-            if q.shape[0] % nb == 0 and q.shape[1] % nh == 0:
-                import functools
-                from jax.sharding import PartitionSpec as P
-                from ..parallel.sp import shard_map_nocheck
-                spec = P(b_axes or None, h_axes or None, None, None)
+    enabled = ((pallas_enabled() or interpret) and not _FLASH_SUPPRESS
+               and not os.environ.get("COS_DISABLE_FLASH"))
+    if enabled and _FLASH_MESH:
+        import functools
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.sp import shard_map_nocheck
+        mesh, b_axes, h_axes, t_axes = _FLASH_MESH[-1]
+        shape = dict(mesh.shape)
+        b_axes = tuple(a for a in b_axes if shape.get(a, 1) > 1)
+        h_axes = tuple(a for a in h_axes if shape.get(a, 1) > 1)
+        t_axes = tuple(a for a in t_axes if shape.get(a, 1) > 1)
+        nb = math.prod(shape[a] for a in b_axes) if b_axes else 1
+        nh = math.prod(shape[a] for a in h_axes) if h_axes else 1
+        tiles = q.shape[0] % nb == 0 and q.shape[1] % nh == 0
+        if t_axes and len(t_axes) == 1 and tiles and t % shape[t_axes[0]] == 0:
+            # TIME sharded: differentiable fused ring per (b, h) block
+            nt = shape[t_axes[0]]
+            from ..parallel.sp import flash_block_size
+            if flash_block_size(t // nt) is not None:
+                from ..parallel.sp import _ring_attention_local
+                spec = P(b_axes or None, h_axes or None, t_axes, None)
                 fl = shard_map_nocheck(
-                    functools.partial(flash_attention, causal=causal,
-                                      block_q=128, block_k=128,
-                                      interpret=interpret),
+                    functools.partial(
+                        _ring_attention_local, axis_name=t_axes[0],
+                        causal=causal,
+                        flash="interpret" if interpret else True),
                     mesh, (spec, spec, spec), spec)
                 return fl(q, k, v)
-            # batch/heads don't tile the mesh: einsum path below
-        else:
-            return flash_attention(q, k, v, causal, 128, 128,
-                                   interpret=interpret)
+            # local T unsuited to the kernel: einsum path below
+        elif not t_axes and tiles and t % 128 == 0:
+            spec = P(b_axes or None, h_axes or None, None, None)
+            fl = shard_map_nocheck(
+                functools.partial(flash_attention, causal=causal,
+                                  block_q=128, block_k=128,
+                                  interpret=interpret),
+                mesh, (spec, spec, spec), spec)
+            return fl(q, k, v)
+        # shapes don't tile the mesh: einsum path below
+    elif enabled and not _FLASH_MESH and t % 128 == 0:
+        return flash_attention(q, k, v, causal, 128, 128,
+                               interpret=interpret)
     from ..parallel.sp import attention as _plain_attention
     return _plain_attention(q, k, v, causal=causal)
 

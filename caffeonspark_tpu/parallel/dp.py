@@ -152,7 +152,7 @@ class ParallelSolver:
     def train_step(self):
         """Jitted SPMD step: donated params/opt, dp-sharded inputs."""
         if self._step is None:
-            base = self._maybe_suppress_flash(self.solver.train_step_fn())
+            base = self._install_flash_mesh(self.solver.train_step_fn())
             in_sh = (
                 self.param_sharding,
                 OptState(iter=self.repl,
@@ -167,33 +167,27 @@ class ParallelSolver:
                                  out_shardings=out_sh)
         return self._step
 
-    def _maybe_suppress_flash(self, fn):
+    def _install_flash_mesh(self, fn):
         """A bare pallas_call cannot be GSPMD-partitioned, but attention
-        is embarrassingly parallel over batch x heads — so on dp/tp
-        (and ep) meshes the dispatch is routed through shard_map
-        (ops.layers.flash_mesh) and each device runs the kernel on its
-        local block.  Sequence-parallel meshes shard the TIME axis the
-        kernel would need whole, so there flash is suppressed and the
-        partitionable einsum path (or explicit ring attention) runs.
-        Single-device meshes call the kernel directly."""
+        is embarrassingly parallel over batch x heads — so on meshes
+        the dispatch is routed through shard_map (ops.layers.flash_mesh)
+        and each device runs the kernel on its local block; when the
+        mesh also shards TIME (sp), the shard_map body is the
+        differentiable fused ring.  Single-device meshes call the
+        kernel directly; ineligible shapes fall back to the
+        GSPMD-partitionable einsum inside the dispatch."""
         if self.mesh.devices.size <= 1:
             return fn
-        if dict(self.mesh.shape).get("sp", 1) == 1:
-            def wrapped(*args, _f=fn):
-                from ..ops.layers import flash_mesh
-                with flash_mesh(self.mesh):  # active during TRACING
-                    return _f(*args)
-            return wrapped
 
         def wrapped(*args, _f=fn):
-            from ..ops.layers import suppress_flash
-            with suppress_flash():   # active during jit TRACING
+            from ..ops.layers import flash_mesh
+            with flash_mesh(self.mesh):  # active during TRACING
                 return _f(*args)
         return wrapped
 
     def eval_step(self):
         if self._eval is None:
-            base = self._maybe_suppress_flash(self.solver.eval_step_fn())
+            base = self._install_flash_mesh(self.solver.eval_step_fn())
             in_sh = (self.param_sharding,
                      self.input_shardings(self.solver.test_net))
             self._eval = jax.jit(base, in_shardings=in_sh,

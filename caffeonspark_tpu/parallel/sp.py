@@ -59,6 +59,19 @@ def attention(q: Array, k: Array, v: Array, *, causal: bool = False,
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
+def flash_block_size(t: int):
+    """Flash kernel block for a local sequence extent t: 128 when it
+    tiles; whole-shard for small shards; None = shape unsuited (a
+    whole-shard block would blow VMEM) — callers fall back to the
+    einsum accumulate.  The ONE place that knows the eligibility rule
+    (used by the ring body here and ops.layers' mesh dispatch)."""
+    if t % 128 == 0:
+        return 128
+    if t <= 256 and t % 8 == 0:
+        return t
+    return None
+
+
 def _ring_attention_local(q: Array, k: Array, v: Array, *, axis_name: str,
                           causal: bool, flash=False) -> Array:
     """Per-shard body (inside shard_map): q,k,v are the LOCAL time blocks
@@ -69,18 +82,7 @@ def _ring_attention_local(q: Array, k: Array, v: Array, *, axis_name: str,
     t_k = k.shape[2]
     scale = 1.0 / math.sqrt(d)
     qpos = idx * t_q + jnp.arange(t_q)           # global query positions
-    def _flash_block(t):
-        """128 when it tiles; whole-shard for small shards; None =
-        shard shape unsuited (a whole-shard block would blow VMEM) —
-        fall back to the einsum accumulate, matching the MHA dispatch
-        convention."""
-        if t % 128 == 0:
-            return 128
-        if t <= 256 and t % 8 == 0:
-            return t
-        return None
-
-    bq, bk = _flash_block(t_q), _flash_block(t_k)
+    bq, bk = flash_block_size(t_q), flash_block_size(t_k)
     use_flash = bool(flash) and bq is not None and bk is not None
     if use_flash:
         interp = flash == "interpret"

@@ -7,13 +7,13 @@ the TIME axis sharded over an `sp` mesh (the capability the reference
 lacks entirely, SURVEY §2.7/§5.7) and prints the loss curve plus a
 parity check against the unsharded step.  With no accelerator the
 script builds a virtual 8-device CPU mesh itself; on a TPU pod slice
-the same code shards over real chips.  On sp meshes the sharded step
-keeps attention on the GSPMD-partitionable einsum path (T itself is
-sharded, which the single-shard kernel can't mask); dp/tp meshes and
-single-device runs with 128-aligned T dispatch the Pallas flash
-kernel (via shard_map over batch x heads on meshes).  For hand-rolled
-long-context steps, `parallel.sp.ring_attention(flash=True)` runs the
-fused ring — now differentiable — per hop.
+the same code shards over real chips.  On TPU meshes the attention
+dispatch routes through shard_map automatically: dp/tp meshes run the
+Pallas flash kernel per (batch, heads) block, and sp meshes run the
+DIFFERENTIABLE fused ring (K/V rotating on ICI, flash kernels per
+hop) when the local sequence extent is kernel-eligible — einsum
+otherwise.  `parallel.sp.ring_attention(flash=True)` exposes the same
+fused ring for hand-rolled steps (demoed below).
 """
 
 import os

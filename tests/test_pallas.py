@@ -139,9 +139,8 @@ def test_flash_suppressed_under_multi_device_mesh(monkeypatch):
         L._attention_dispatch(q, q, q, causal=True)
     assert not calls, "flash must be suppressed inside the guard"
 
-    # ParallelSolver routes dp/tp meshes through flash_mesh (the
-    # shard_map path) and suppresses only on sp meshes, where the time
-    # axis the kernel needs whole is sharded
+    # ParallelSolver routes every multi-device mesh through flash_mesh:
+    # dp/tp meshes get the per-block kernel, sp meshes the fused ring
     from caffeonspark_tpu.parallel import ParallelSolver, build_mesh
     from caffeonspark_tpu.proto import NetParameter, SolverParameter
     from caffeonspark_tpu.solver import Solver
@@ -152,16 +151,12 @@ layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
   inner_product_param { num_output: 2 } }
 layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
   bottom: "label" top: "loss" }""")
-    s = Solver(SolverParameter.from_text(
-        "base_lr: 0.01 random_seed: 1"), npm)
-    ps = ParallelSolver(s, build_mesh(dp=8))
-    probe = ps._maybe_suppress_flash(
-        lambda: (L._FLASH_SUPPRESS, len(L._FLASH_MESH)))
-    assert probe() == (0, 1), "dp mesh must install the shard_map route"
-    s2 = Solver(SolverParameter.from_text(
-        "base_lr: 0.01 random_seed: 1"), npm)
-    ps2 = ParallelSolver(s2, build_mesh(dp=2, sp=4))
-    probe2 = ps2._maybe_suppress_flash(
-        lambda: (L._FLASH_SUPPRESS, len(L._FLASH_MESH)))
-    assert probe2() == (1, 0), "sp mesh must suppress flash"
+    for mesh_kw in ({"dp": 8}, {"dp": 2, "sp": 4}):
+        s = Solver(SolverParameter.from_text(
+            "base_lr: 0.01 random_seed: 1"), npm)
+        ps = ParallelSolver(s, build_mesh(**mesh_kw))
+        probe = ps._install_flash_mesh(
+            lambda: (L._FLASH_SUPPRESS, len(L._FLASH_MESH)))
+        assert probe() == (0, 1), (
+            f"{mesh_kw}: mesh must install the shard_map route")
     assert L._FLASH_SUPPRESS == 0 and not L._FLASH_MESH
