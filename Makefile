@@ -61,6 +61,7 @@ onchip-artifacts:
 	-mkdir -p bench_evidence && $(PY) scripts/profile_segments.py 256 \
 	  | tee bench_evidence/profile_segments_b256.txt
 	-BENCH_MODEL=resnet50 $(PY) bench.py
+	-BENCH_MODEL=lstm $(PY) bench.py
 
 docs:
 	$(PY) docs/gen_html.py

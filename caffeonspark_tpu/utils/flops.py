@@ -28,7 +28,13 @@ def forward_flops(net) -> int:
     Deconvolution scatters from the bottom instead: weight
     (C, K/g, kh, kw) applied per bottom element.
     """
-    total = 0
+    return sum(layer_forward_flops(net).values())
+
+
+def layer_forward_flops(net) -> dict:
+    """{layer name: forward FLOPs} — the one copy of the per-layer
+    accounting (scripts/roofline.py consumes this too)."""
+    out: dict = {}
     for lp in net.compute_layers:
         specs = net.param_layout.get(lp.name)
         if not specs:
@@ -37,6 +43,22 @@ def forward_flops(net) -> int:
         if not tops:
             continue
         first_top = next(iter(tops.values()))
+        total = 0
+        if lp.type == "Embed":
+            out[lp.name] = 0     # gather, not a matmul: ~0 FLOPs
+            continue
+        if lp.type == "MultiHeadAttention":
+            # projections apply the FULL weight per (t, b) position
+            # (top is (T, B, D), not (T, B, 3D)), plus the two
+            # attention einsums (QK^T and PV: 2 * 2*B*H*T^2*hd)
+            t_s, b_s = first_top[0], first_top[1]
+            for (pname, pshape, _) in specs:
+                total += 2 * t_s * b_s * prod(pshape)
+            ap = lp.attention_param
+            total += 4 * b_s * int(ap.num_heads) * t_s * t_s \
+                * int(ap.head_dim)
+            out[lp.name] = total
+            continue
         for (pname, pshape, _) in specs:
             if len(pshape) < 2 or "bias" in pname:
                 continue
@@ -47,10 +69,16 @@ def forward_flops(net) -> int:
                 # from blob_shapes via the bottom name when available
                 bshape = net.blob_shapes.get(lp.bottom[0])
                 ref = prod(bshape) if bshape else prod(first_top)
+                total += 2 * ref * prod(pshape[1:])
+            elif lp.type in ("LSTM", "RNN"):
+                # gate weights (4H, I)/(4H, H) apply FULLY per
+                # (t, b) step — the top (T, B, H) only exposes H, so
+                # the generic rule would undercount 4x
+                total += 2 * prod(first_top[:2]) * prod(pshape)
             else:
-                ref = prod(first_top)
-            total += 2 * ref * prod(pshape[1:])
-    return total
+                total += 2 * prod(first_top) * prod(pshape[1:])
+        out[lp.name] = total
+    return out
 
 
 def train_step_flops(net) -> int:

@@ -44,6 +44,8 @@ MEMBOUND = {"Pooling", "LRN", "Softmax", "SoftmaxWithLoss", "Concat",
 
 
 def analyze(net, *, act_bytes: int, param_bytes: int, fused: bool):
+    from caffeonspark_tpu.utils.flops import layer_forward_flops
+    per_layer = layer_forward_flops(net)
     rows = []
     for lp in net.compute_layers:
         tops = net._top_shapes.get(lp.name, {})
@@ -52,12 +54,7 @@ def analyze(net, *, act_bytes: int, param_bytes: int, fused: bool):
                        if b in net.blob_shapes)
         p_elems = sum(prod(s) for _, s, _ in
                       net.param_layout.get(lp.name, []))
-        flops = 0
-        for pname, pshape, _ in net.param_layout.get(lp.name, []):
-            if len(pshape) < 2 or "bias" in pname:
-                continue
-            first_top = next(iter(tops.values())) if tops else ()
-            flops += 2 * prod(first_top) * prod(pshape[1:])
+        flops = per_layer.get(lp.name, 0)
         fwd_bytes = ((in_elems + out_elems) * act_bytes
                      + p_elems * param_bytes)
         if fused and lp.type in ELEMENTWISE:
