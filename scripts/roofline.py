@@ -85,14 +85,28 @@ def main():
 
     from caffeonspark_tpu.net import Net
     from caffeonspark_tpu.proto import NetState, Phase, read_net
-    if os.path.exists(args.net):
+    from caffeonspark_tpu.models import zoo
+    # explicit family allowlist (constructor, batch kwarg) — a typo'd
+    # name must be an error, not a silent caffenet with a wrong header
+    families = {"lstm": ("lstm_lm", "batch_size"),
+                "caffenet": ("caffenet", "batch_size"),
+                "lenet": ("lenet", "batch_size"),
+                "resnet50": ("resnet50", "batch_size"),
+                "vgg16": ("vgg16", "batch_size"),
+                "googlenet": ("googlenet", "batch_size"),
+                "transformer": ("transformer_lm", "batch")}
+    if args.net in families and not os.path.exists(args.net):
+        fn, bkw = families[args.net]
+        npm = getattr(zoo, fn)(**{bkw: args.batch})
+    elif os.path.exists(args.net):
         npm = read_net(args.net)
         for lp in npm.layer:
             if lp.type == "MemoryData":
                 lp.memory_data_param.batch_size = args.batch
     else:
-        from caffeonspark_tpu.models.zoo import caffenet
-        npm = caffenet(batch_size=args.batch)
+        raise SystemExit(
+            f"--net {args.net!r}: not a prototxt path or a zoo family "
+            f"({', '.join(sorted(families))})")
     net = Net(npm, NetState(phase=Phase.TRAIN))
 
     act_bytes = 2 if args.dtype == "mixed" else 4
