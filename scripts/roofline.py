@@ -71,9 +71,10 @@ def analyze(net, *, act_bytes: int, param_bytes: int, fused: bool):
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
+    _ref = "/root/reference/data/bvlc_reference_net.prototxt"
     ap.add_argument("--net",
-                    default="/root/reference/data/bvlc_reference_net"
-                            ".prototxt")
+                    default=_ref if os.path.exists(_ref) else "caffenet",
+                    help="prototxt path or zoo family name")
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--dtype", default="mixed",
                     choices=["mixed", "float32"])

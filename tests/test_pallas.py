@@ -160,3 +160,40 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
         assert probe() == (0, 1), (
             f"{mesh_kw}: mesh must install the shard_map route")
     assert L._FLASH_SUPPRESS == 0 and not L._FLASH_MESH
+
+
+def test_flash_mesh_dispatch_fallbacks(monkeypatch):
+    """Mesh-route tiling guards: shapes that don't tile the mesh
+    (heads % tp != 0, batch % dp != 0, T % sp != 0, or an ineligible
+    local extent) fall back to einsum attention — no crash, no kernel
+    dispatch, same values."""
+    from caffeonspark_tpu.ops import layers as L
+    from caffeonspark_tpu.parallel import build_mesh
+    from caffeonspark_tpu.parallel.sp import attention
+    import caffeonspark_tpu.ops.pallas_kernels as pk
+    import caffeonspark_tpu.parallel.sp as sp_mod
+
+    kernel_calls = []
+    monkeypatch.setattr(pk, "pallas_enabled", lambda: True)
+    monkeypatch.setattr(pk, "flash_attention",
+                        lambda *a, **k: kernel_calls.append(1) or a[0])
+    monkeypatch.setattr(sp_mod, "_ring_attention_local",
+                        lambda *a, **k: kernel_calls.append(1) or a[0])
+    monkeypatch.delenv("COS_DISABLE_FLASH", raising=False)
+
+    rng = np.random.RandomState(0)
+    cases = [
+        # (mesh, q shape (B, H, T, D)) — each violates EXACTLY one guard
+        (build_mesh(dp=4, tp=2), (4, 3, 128, 8)),    # H=3 % tp=2 only
+        (build_mesh(dp=8), (3, 2, 128, 8)),          # B=3 % dp=8
+        (build_mesh(dp=2, sp=4), (2, 2, 102, 8)),    # T=102 % sp=4
+        (build_mesh(dp=2, sp=4), (2, 2, 52, 8)),     # t_local=13 % 8
+    ]
+    for mesh, shape in cases:
+        q = jnp.asarray(rng.randn(*shape), jnp.float32)
+        with L.flash_mesh(mesh):
+            out = L._attention_dispatch(q, q, q, causal=True)
+        assert not kernel_calls, (mesh.shape, shape)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(attention(q, q, q, causal=True)),
+            rtol=2e-4, atol=2e-5, err_msg=str((dict(mesh.shape), shape)))
