@@ -116,7 +116,8 @@ def test_train_with_validation_interleave(setup):
     """InterleaveTest.scala analog: validation DF columns == (accuracy,
     loss); final accuracy above the reference's own 0.8 bar."""
     tmp, solver = setup
-    conf = Config(["-conf", str(solver), "-train"])
+    conf = Config(["-conf", str(solver), "-train",
+                   "-output", str(tmp)])
     cos = CaffeOnSpark()
     train_src = get_source(conf.train_data_layer(), phase_train=True,
                            seed=1)
@@ -150,7 +151,8 @@ def test_train_with_validation_interleave_device_transform(
 
     monkeypatch.setattr(Transformer, "host_stage", spy)
     tmp, solver = setup
-    conf = Config(["-conf", str(solver), "-train"])
+    conf = Config(["-conf", str(solver), "-train",
+                   "-output", str(tmp)])
     cos = CaffeOnSpark()
     train_src = get_source(conf.train_data_layer(), phase_train=True,
                            seed=1)
@@ -198,7 +200,8 @@ def test_features_and_test(setup):
     """PythonApiTest analog: features → SampleID + blob columns;
     test() → accuracy mean > 0.9 after training."""
     tmp, solver = setup
-    conf = Config(["-conf", str(solver), "-train"])
+    conf = Config(["-conf", str(solver), "-train",
+                   "-output", str(tmp)])
     cos = CaffeOnSpark()
     train_src = get_source(conf.train_data_layer(), phase_train=True,
                            seed=1)
@@ -209,7 +212,8 @@ def test_features_and_test(setup):
     from caffeonspark_tpu.processor import CaffeProcessor
     proc = CaffeProcessor.instance(fconf)
     # reuse trained weights: load from the final snapshot
-    snaps = sorted(p for p in os.listdir(".")
+    snaps = sorted(os.path.join(str(tmp), p)
+                   for p in os.listdir(str(tmp))
                    if p.startswith("lenetish_iter_")
                    and p.endswith(".caffemodel"))
     src = get_source(fconf.test_data_layer(), phase_train=False, seed=1)
@@ -225,10 +229,7 @@ def test_features_and_test(setup):
     assert df.rows[0]["SampleID"] == "00000000"
     assert len(df.rows[0]["ip1"]) == 64
     assert len(df.rows[0]["ip2"]) == 10
-    # cleanup stray snapshots written to cwd
-    for p in os.listdir("."):
-        if p.startswith("lenetish_iter_"):
-            os.unlink(p)
+
 
 
 def test_features_with_device_transform(setup, monkeypatch):

@@ -79,7 +79,8 @@ def conf(tmp_path):
     net.write_text(NET.format(lmdb=tmp_path / "lmdb"))
     solver = tmp_path / "solver.prototxt"
     solver.write_text(SOLVER.format(net=net, max_iter=8))
-    c = Config(["-conf", str(solver), "-train"])
+    c = Config(["-conf", str(solver), "-train",
+                "-output", str(tmp_path)])
     return c
 
 
@@ -457,7 +458,8 @@ layer { name: "accuracy" type: "Accuracy" bottom: "ip" bottom: "label"
     solver = tmp_path / "solver.prototxt"
     solver.write_text(SOLVER.format(net=net, max_iter=8).replace(
         "max_iter: 8", "max_iter: 8\ntest_interval: 4\ntest_iter: 2"))
-    conf = Config(["-conf", str(solver), "-train"])
+    conf = Config(["-conf", str(solver), "-train",
+                   "-output", str(tmp_path)])
 
     sc = _FakeSparkContext()
     engine = SparkEngine(sc, conf, require=False)
@@ -589,7 +591,8 @@ layer { name: "accuracy" type: "Accuracy" bottom: "ip" bottom: "label"
     solver = tmp_path / "solver2.prototxt"
     solver.write_text(SOLVER.format(net=net, max_iter=8).replace(
         "max_iter: 8", "max_iter: 8\ntest_interval: 4\ntest_iter: 2"))
-    tconf = Config(["-conf", str(solver), "-train"])
+    tconf = Config(["-conf", str(solver), "-train",
+                   "-output", str(tmp_path)])
 
     sc = _FakeSparkContext()
     cos = cos_mod.CaffeOnSpark(sc)
