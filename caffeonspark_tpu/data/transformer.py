@@ -186,24 +186,37 @@ class Transformer:
         """(N,C,H,W) integral-valued pixels -> (uint8 batch cropped +
         mirrored, aux int32 (N,3) of [h_off, w_off, flip]).  Crop and
         flip come from the same _draw_crop/_draw_flip the host-only
-        path uses, so the two pipelines consume self.rng identically."""
+        path uses, so the two pipelines consume self.rng identically.
+        The byte moves run in the threaded native kernel
+        (cos_crop_mirror_u8) when built; numpy otherwise — identical
+        output either way (test_native.py parity)."""
         n, c, h, w = batch.shape
         crop = int(self.tp.crop_size)
         u8 = batch.astype(np.uint8) if batch.dtype != np.uint8 else batch
         offs = self._draw_crop(n, h, w)
         if offs is not None:
             hs, ws = offs
-            u8 = np.stack([u8[i, :, hs[i]:hs[i] + crop,
-                              ws[i]:ws[i] + crop] for i in range(n)])
         else:
             hs = np.zeros(n, np.int64)
             ws = np.zeros(n, np.int64)
-            u8 = u8.copy()
         flip = self._draw_flip(n)
-        if flip.any():
-            u8[flip] = u8[flip, :, :, ::-1]
         aux = np.stack([hs, ws, flip.astype(np.int64)],
                        axis=1).astype(np.int32)
+
+        from .. import native
+        if native.available():
+            out = native.crop_mirror_u8(
+                u8, hs, ws, flip,
+                crop=crop if offs is not None else 0)
+            return out, aux
+
+        if offs is not None:
+            u8 = np.stack([u8[i, :, hs[i]:hs[i] + crop,
+                              ws[i]:ws[i] + crop] for i in range(n)])
+        else:
+            u8 = u8.copy()
+        if flip.any():
+            u8[flip] = u8[flip, :, :, ::-1]
         return np.ascontiguousarray(u8), aux
 
     def device_stage_fn(self, out_dtype=None):

@@ -115,6 +115,13 @@ def _bind(lib) -> None:
         ctypes.POINTER(ctypes.c_ubyte),
         ctypes.POINTER(ctypes.c_float), ctypes.c_int,
         ctypes.c_float, ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+    lib.cos_crop_mirror_u8.restype = None
+    lib.cos_crop_mirror_u8.argtypes = [
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_ubyte),
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.c_int]
     lib.cos_native_version.restype = ctypes.c_int
 
 
@@ -197,4 +204,31 @@ def transform_batch(batch: np.ndarray, *, crop: int = 0,
         mir.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
         mean_ptr, mode, ctypes.c_float(scale),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), num_threads)
+    return out
+
+
+def crop_mirror_u8(batch: np.ndarray, h_off: np.ndarray,
+                   w_off: np.ndarray, mirror: np.ndarray, *,
+                   crop: int = 0, num_threads: int = 0) -> np.ndarray:
+    """Threaded uint8 crop(+mirror) — the device-transform split's host
+    half (Transformer.host_stage's hot loop).  The RNG draws stay with
+    the caller; this only moves bytes."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    batch = np.ascontiguousarray(batch, np.uint8)
+    n, c, h, w = batch.shape
+    oh = crop if crop else h
+    ow = crop if crop else w
+    ho = np.ascontiguousarray(h_off, np.int32)
+    wo = np.ascontiguousarray(w_off, np.int32)
+    mi = np.ascontiguousarray(mirror, np.uint8)
+    out = np.empty((n, c, oh, ow), np.uint8)
+    lib.cos_crop_mirror_u8(
+        batch.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        n, c, h, w, crop,
+        ho.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        wo.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        mi.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)), num_threads)
     return out

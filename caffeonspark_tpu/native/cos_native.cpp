@@ -215,6 +215,51 @@ void cos_u8_to_float_batch(const unsigned char* in, long total,
     out[i] = static_cast<float>(in[i]);
 }
 
+// The device-transform split's host half, threaded: per-image crop
+// window copy (+ optional horizontal mirror) on uint8 NCHW planes.
+// h_off/w_off/mirror_flags are per-image (the Caffe RNG draws stay in
+// Python so trajectories match the numpy path exactly); crop == 0
+// means no crop (oh=h, ow=w).
+void cos_crop_mirror_u8(const unsigned char* in, int n, int c, int h,
+                        int w, int crop, const int* h_off,
+                        const int* w_off,
+                        const unsigned char* mirror_flags,
+                        unsigned char* out, int num_threads) {
+  const int oh = crop > 0 ? crop : h;
+  const int ow = crop > 0 ? crop : w;
+  std::atomic<int> next(0);
+  int nthreads = num_threads > 0
+                     ? num_threads
+                     : static_cast<int>(std::thread::hardware_concurrency());
+  nthreads = std::max(1, std::min(nthreads, n));
+  auto worker = [&]() {
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
+      const unsigned char* src =
+          in + static_cast<size_t>(i) * c * h * w;
+      unsigned char* dst =
+          out + static_cast<size_t>(i) * c * oh * ow;
+      const int hs = h_off[i], ws = w_off[i];
+      const bool mir = mirror_flags[i] != 0;
+      for (int ch = 0; ch < c; ++ch) {
+        const unsigned char* sp = src + static_cast<size_t>(ch) * h * w;
+        unsigned char* dp = dst + static_cast<size_t>(ch) * oh * ow;
+        for (int y = 0; y < oh; ++y) {
+          const unsigned char* row = sp + (hs + y) * w + ws;
+          unsigned char* orow = dp + y * ow;
+          if (!mir) {
+            std::memcpy(orow, row, ow);
+          } else {
+            for (int x = 0; x < ow; ++x) orow[x] = row[ow - 1 - x];
+          }
+        }
+      }
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 0; t < nthreads; ++t) pool.emplace_back(worker);
+  for (auto& t : pool) t.join();
+}
+
 int cos_native_version() { return 1; }
 
 }  // extern "C"
