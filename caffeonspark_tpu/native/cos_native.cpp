@@ -238,7 +238,10 @@ void cos_crop_mirror_u8(const unsigned char* in, int n, int c, int h,
           in + static_cast<size_t>(i) * c * h * w;
       unsigned char* dst =
           out + static_cast<size_t>(i) * c * oh * ow;
-      const int hs = h_off[i], ws = w_off[i];
+      // no-crop mode ignores the offsets (sibling cos_transform_batch
+      // rule): a nonzero offset with oh==h would read out of bounds
+      const int hs = crop > 0 ? h_off[i] : 0;
+      const int ws = crop > 0 ? w_off[i] : 0;
       const bool mir = mirror_flags[i] != 0;
       for (int ch = 0; ch < c; ++ch) {
         const unsigned char* sp = src + static_cast<size_t>(ch) * h * w;

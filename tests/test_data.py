@@ -476,3 +476,44 @@ def test_end_to_end_lmdb_lenet(tmp_path):
         losses.append(float(out["loss"]))
     assert np.isfinite(losses).all()
     assert min(losses[-3:]) < losses[0]
+
+
+def test_device_transform_with_iter_size(tmp_path, monkeypatch):
+    """combine_batches merges uint8+aux sub-batches (iter_size>1)
+    consistently: the combined feed through device_prefetch equals the
+    host-transform feed combined the same way."""
+    monkeypatch.setenv("COS_DEVICE_TRANSFORM", "1")
+    import jax
+    from caffeonspark_tpu.data.source import get_source
+    from caffeonspark_tpu.data.queue_runner import (combine_batches,
+                                                    device_prefetch)
+
+    _mnist_style_lmdb(str(tmp_path), n=64)
+    txt = f'''
+        name: "data" type: "MemoryData" top: "data" top: "label"
+        source_class: "com.yahoo.ml.caffe.LMDB"
+        transform_param {{ scale: 0.00390625 crop_size: 24 mirror: true }}
+        memory_data_param {{
+          source: "file:{tmp_path}"
+          batch_size: 8 channels: 1 height: 28 width: 28 }}'''
+    lp = LayerParameter.from_text(txt)
+
+    monkeypatch.delenv("COS_DEVICE_TRANSFORM", raising=False)
+    ref_src = get_source(lp, phase_train=True, seed=6)
+    ref_it = combine_batches(ref_src.batches(loop=False, shuffle=False),
+                             2, frozenset())
+    ref = next(ref_it)
+
+    monkeypatch.setenv("COS_DEVICE_TRANSFORM", "1")
+    src = get_source(lp, phase_train=True, seed=6)
+    dxf = src.enable_device_transform()
+    assert dxf is not None
+    it = combine_batches(src.batches(loop=False, shuffle=False),
+                         2, frozenset())
+    raw = next(it)
+    assert raw["data"].dtype == np.uint8 and raw["data"].shape[0] == 16
+    [dev] = list(device_prefetch(iter([raw]), depth=1,
+                                 device_transforms=dxf))
+    np.testing.assert_allclose(np.asarray(dev["data"]), ref["data"],
+                               rtol=0, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(dev["label"]), ref["label"])
