@@ -256,6 +256,46 @@ def _env_fingerprint():
     return fp
 
 
+def _tunnel_diag():
+    """TCP-level evidence for failure records: distinguishes 'relay
+    process dead' (connection refused) from 'relay up, upstream pool
+    wedged' (connect ok but jax.devices() hangs) — the round-4 failure
+    signature.  The axon relay listens on the loopback pool IP."""
+    import ipaddress
+    import socket
+    try:
+        ip = os.environ.get("PALLAS_AXON_POOL_IPS",
+                            "").split(",")[0].strip()
+        if not ip:
+            return {"relay": "no PALLAS_AXON_POOL_IPS (not an axon env)"}
+        host, _, port = ip.partition(":")
+        try:
+            ipaddress.ip_address(host)
+        except ValueError:
+            # a hostname would mean DNS inside fail() — a wedged
+            # resolver must not block the guaranteed JSON line
+            return {"relay": f"non-numeric pool host {host!r}: "
+                             "skipping TCP probe"}
+        try:
+            ports = [int(port)] if port else [2024, 443]
+        except ValueError:
+            ports = [2024, 443]
+        out = {}
+        for p in ports:
+            t0 = time.monotonic()
+            try:
+                s = socket.create_connection((host, p), timeout=5)
+                s.close()
+                out[f"{host}:{p}"] = (
+                    f"tcp connect ok in "
+                    f"{(time.monotonic() - t0) * 1e3:.1f} ms")
+            except OSError as e:
+                out[f"{host}:{p}"] = f"{type(e).__name__}: {e}"
+        return out
+    except Exception as e:     # diagnostics must never break fail()
+        return {"relay": f"diag error: {type(e).__name__}: {e}"}
+
+
 def _claimed_block():
     import glob
     block = dict(_CLAIMED)
@@ -290,6 +330,7 @@ def main():
             "unit": unit,
             "vs_baseline": 0.0, "error": error,
             "attempts": attempts,
+            "tunnel_diag": _tunnel_diag(),
             "claimed": _claimed_block(),
         }))
         sys.exit(1)
