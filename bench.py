@@ -268,7 +268,14 @@ def _tunnel_diag():
                             "").split(",")[0].strip()
         if not ip:
             return {"relay": "no PALLAS_AXON_POOL_IPS (not an axon env)"}
-        host, _, port = ip.partition(":")
+        # bracketed/bare IPv6 too: [::1]:2024, ::1, 127.0.0.1:2024
+        if ip.startswith("["):
+            host, _, rest = ip[1:].partition("]")
+            port = rest.lstrip(":")
+        elif ip.count(":") > 1:
+            host, port = ip, ""       # bare IPv6, no port suffix
+        else:
+            host, _, port = ip.partition(":")
         try:
             ipaddress.ip_address(host)
         except ValueError:

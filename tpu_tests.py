@@ -41,7 +41,7 @@ TEST_FILES = ["tests/test_pallas_tpu.py", "tests/test_tpu_train.py"]
 # shared with the bench harness (side-effect-free import): keeps the
 # fingerprint fields — notably pallas_axon_pool, the bit that separates
 # "tunnel env absent" from "tunnel wedged" — from drifting
-from bench import _env_fingerprint  # noqa: E402
+from bench import _env_fingerprint, _tunnel_diag  # noqa: E402
 
 
 def _parse_junit(path):
@@ -81,6 +81,7 @@ def main():
     def emit(error=None):
         if error:
             result["error"] = error
+            result["tunnel_diag"] = _tunnel_diag()
         tmp = f"{out_path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(result, f, indent=1)
