@@ -24,8 +24,10 @@ HARNESS CONTRACT (round 4 — fight for a number until the deadline):
     failure mode for this harness).
   * The parent ALWAYS prints exactly one final JSON line: on success
     the worker's measurement, on failure {metric, value: 0, error,
-    attempts: [...], claimed: {builder-reported numbers + env
-    fingerprint}} so the artifact carries the full context.
+    attempts: [...], tunnel_diag: {relay TCP probe — distinguishes a
+    dead relay from this round's up-relay/wedged-pool signature},
+    claimed: {builder-reported numbers + env fingerprint}} so the
+    artifact carries the full context.
   * A global deadline (default 780 s) bounds total runtime so the
     driver's timeout can never produce rc=124 with no output.
 
