@@ -56,3 +56,20 @@ def test_crashing_worker_fails_fast_with_claimed_block(tmp_path):
     assert rec["claimed"]["env"]["jax"]
     assert "caffenet_imagenet_train_images_per_sec_per_chip" \
         in rec["claimed"]
+
+
+def test_env_preflight_fails_without_spawning_worker():
+    """Deterministic env-combination errors (BENCH_PIPELINE with the
+    recurrent model) produce the structured failure record immediately
+    — no backend dial, no attempts — with the tunnel_diag field."""
+    import time
+    t0 = time.monotonic()
+    rc, rec = _run({"JAX_PLATFORMS": "cpu", "BENCH_PIPELINE": "1",
+                    "BENCH_MODEL": "lstm"}, timeout=60)
+    assert rc == 1
+    assert time.monotonic() - t0 < 30
+    assert rec["value"] == 0.0
+    assert "not applicable" in rec["error"]
+    assert rec["attempts"] == []
+    assert rec["unit"] == "sentences/sec"
+    assert "tunnel_diag" in rec
