@@ -20,10 +20,13 @@ install:
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -x -q
 
-# real-SparkContext leg (needs pyspark + a JVM; skips itself
-# otherwise): InterleaveTest / PythonApiTest analogs at local[4]
+# real-SparkContext leg (needs pyspark + a JVM) + the multicore 1F1B
+# wall-clock leg (needs >=4 cores): InterleaveTest / PythonApiTest
+# analogs at local[4].  ALWAYS writes SPARK_TESTS_r05.json with
+# per-test outcomes + env fingerprint (tpu_tests.py contract) so runs
+# in docker/CI leave committable proof
 spark-test:
-	$(CPU_ENV) $(PY) -m pytest tests/spark -q -rs
+	$(CPU_ENV) $(PY) spark_tests.py
 
 bench:
 	$(PY) bench.py

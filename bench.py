@@ -225,21 +225,24 @@ def _tail(text, n=600):
     return text[-n:] if text else ""
 
 
-#: docs/benchmarks.md table, builder-reported — embedded in the failure
-#: record so a tunnel-down round still carries the claimed numbers and
-#: where their raw evidence lives (VERDICT r3 ask #1).
-_CLAIMED = {
-    "source": "docs/benchmarks.md + bench_evidence/ (builder-reported; "
-              "not driver-verified when this block appears)",
-    "caffenet_imagenet_train_images_per_sec_per_chip": {
-        "batch": 256, "dtype": "mixed", "value": 17322, "mfu": 0.382},
-    "caffenet_b64_f32_reference_shape": {"value": 8518, "mfu": 0.188},
-    "caffenet_imagenet_forward_images_per_sec_per_chip": {
-        "batch": 256, "dtype": "mixed", "value": 45383, "mfu": 0.334},
-    "resnet50_imagenet_train_images_per_sec_per_chip": {
-        "batch": 64, "dtype": "mixed", "value": 2163, "mfu": 0.254},
-    "lenet_mnist_onchip_test_accuracy": 0.9926,
-}
+def _load_claimed():
+    """Builder-reported numbers, embedded in failure records so a
+    tunnel-down round still carries the claimed numbers and where their
+    raw evidence lives (VERDICT r3 ask #1).  Single-sourced from
+    docs/claimed_benchmarks.json (VERDICT r4 ask #5 — bench.py and
+    docs/benchmarks.md used to hand-keep two copies that could drift;
+    tests/test_bench_harness.py now asserts the md agrees with the
+    JSON, and this loader is the only other consumer)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "docs", "claimed_benchmarks.json")
+    try:
+        with open(path) as f:
+            claimed = json.load(f)
+        claimed.pop("_comment", None)
+        return claimed
+    except Exception as e:  # the failure record must still be emitted
+        return {"source": f"docs/claimed_benchmarks.json load failed: "
+                          f"{type(e).__name__}: {e}"}
 
 
 def _env_fingerprint():
@@ -307,7 +310,7 @@ def _tunnel_diag():
 
 def _claimed_block():
     import glob
-    block = dict(_CLAIMED)
+    block = _load_claimed()
     evdir = os.environ.get(
         "BENCH_EVIDENCE_DIR",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -94,15 +94,14 @@ class CaffeOnSpark:
         JVM) and a real `sc` upgrades train/trainWithValidation/features
         to the barrier-stage executor choreography transparently."""
         from . import spark as spark_mod
-        if self.sc is None or not hasattr(self.sc, "parallelize") \
-                or not spark_mod.spark_available():
+        if self.sc is None or not hasattr(self.sc, "parallelize"):
             return None
+        # no spark_available() gate here: a live SparkContext proves a
+        # working JVM gateway however it was launched (spark-submit
+        # with a bundled JRE has no `java` on PATH) — the which-java
+        # heuristic belongs to the pre-construction path in
+        # _cli_spark_context only (round-4 advisor)
         return spark_mod.SparkEngine(self.sc, conf, require=False)
-
-    def _engine_rdd(self, engine, source: DataSource):
-        recs = list(source.records())
-        return self.sc.parallelize(
-            recs, max(1, engine.cluster_size * 2))
 
     def _engine_run(self, engine, make_feed) -> dict:
         """The driver re-feed loop (:204-227): feed, poll, repeat until
@@ -146,8 +145,12 @@ class CaffeOnSpark:
             engine.setup()
 
             def make_feed():
-                rdd = self._engine_rdd(engine, source)
-                return lambda: engine.feed_partitions(rdd, 0)
+                # executor-side reads: each feed round = one epoch of
+                # every rank's own shard, opened inside the task (the
+                # records never pass through the driver)
+                epochs = itertools.count()
+                return lambda: engine.feed_source(source, 0,
+                                                  next(epochs))
 
             self._engine_run(engine, make_feed)
             return
@@ -177,20 +180,22 @@ class CaffeOnSpark:
             engine.setup(interleave_validation=True)
 
             def make_feed():
-                train_rdd = self._engine_rdd(engine, source_train)
-                # one validation ROUND per feed round, sized exactly
+                # train records: executor-side shard reads per round.
+                # validation: one ROUND per feed round, sized exactly
                 # test_iter x batch (the fixed-size validation
-                # partition, CaffeOnSpark.scala:266,279-282): feeding
+                # partition, CaffeOnSpark.scala:266,279-282) — feeding
                 # the whole validation set each round would outrun the
                 # solver's per-interval drain and deadlock on queue-1
-                # backpressure
+                # backpressure.  The bounded val slice is the one
+                # driver-materialized piece, by design.
+                epochs = itertools.count()
                 need = test_iter * source_validation.batch_size
                 val_round = list(itertools.islice(
                     _record_loop(source_validation), need))
                 val_rdd = self.sc.parallelize(val_round, 1)
 
                 def rounds():
-                    engine.feed_partitions(train_rdd, 0)
+                    engine.feed_source(source_train, 0, next(epochs))
                     engine.feed_partitions(val_rdd, 1)
                 return rounds
 
@@ -260,8 +265,7 @@ class CaffeOnSpark:
             # -label, default_feature_blobs)
             engine.setup(start_training=False)
             try:
-                rdd = self._engine_rdd(engine, source)
-                rows = engine.features_partitions(rdd, blob_names)
+                rows = engine.features_source(source, blob_names)
             finally:
                 engine.shutdown()
             names = (blob_names if blob_names else

@@ -114,11 +114,16 @@ class Transformer:
             hs, ws = offs
             crop = int(tp.crop_size)
             if self.train:
-                out = np.stack([out[i, :, hs[i]:hs[i] + crop,
-                                    ws[i]:ws[i] + crop]
-                                for i in range(n)])
-            else:  # center crop: one slice for the whole batch
-                out = out[:, :, hs[0]:hs[0] + crop, ws[0]:ws[0] + crop]
+                out = (np.stack([out[i, :, hs[i]:hs[i] + crop,
+                                     ws[i]:ws[i] + crop]
+                                 for i in range(n)])
+                       if n else
+                       np.empty((0, c, crop, crop), out.dtype))
+            else:  # center crop: one slice for the whole batch —
+                #      scalar offsets, not hs[0] (an empty batch has
+                #      no element 0 but still a valid cropped shape)
+                h0, w0 = (h - crop) // 2, (w - crop) // 2
+                out = out[:, :, h0:h0 + crop, w0:w0 + crop]
         else:
             out = out.copy()
 

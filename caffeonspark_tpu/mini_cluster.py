@@ -156,7 +156,16 @@ class MiniCluster:
             print("SIGHUP → snapshot", file=sys.stderr)
             self._want_snapshot = True
 
+        def on_term(sig, frame):
+            # supervisor teardown sends SIGTERM first (drain window
+            # before SIGKILL): exit the step loop cleanly so atexit
+            # drains any in-flight async snapshot upload
+            print("SIGTERM → teardown (drain snapshots + exit)",
+                  file=sys.stderr)
+            self._stop = True
+
         signal.signal(signal.SIGINT, on_int)
+        signal.signal(signal.SIGTERM, on_term)
         if hasattr(signal, "SIGHUP"):
             signal.signal(signal.SIGHUP, on_hup)
 

@@ -336,6 +336,14 @@ def _flash_specs(block, d, t):
 def _flash_fwd_call(q, k, v, sm_scale, causal, block_q, block_k,
                     interpret):
     bh, t, d = q.shape
+    if t % block_q or t % block_k:
+        # a truncated grid would leave the output/lse tail rows
+        # uninitialized garbage — fail loudly (mirrors
+        # flash_block_update; in-repo callers pre-check and fall back
+        # to the XLA path, this guards direct calls)
+        raise ValueError(
+            f"flash_attention needs T divisible by the blocks: "
+            f"t={t} % block_q={block_q}, t={t} % block_k={block_k}")
     kern = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
                              causal=causal, block_k=block_k)
     qspec, kvspec, vec, _ = _flash_specs(block_q, d, t)

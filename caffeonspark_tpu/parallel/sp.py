@@ -90,11 +90,10 @@ def _ring_attention_local(q: Array, k: Array, v: Array, *, axis_name: str,
             # fused + differentiable custom-VJP ring
             return _make_ring_flash(axis_name, causal, bq, bk,
                                     interp)(q, k, v)
-        # unequal shard extents (cross-attention): fused forward only
-        out, _ = _flash_ring_forward(q, k, v, axis_name=axis_name,
-                                     causal=causal, bq=bq, bk=bk,
-                                     interpret=interp)
-        return out
+        # unequal shard extents (cross-attention): fused Pallas
+        # forward + einsum-ring backward (see _make_ring_flash_cross)
+        return _make_ring_flash_cross(axis_name, causal, bq, bk,
+                                      interp)(q, k, v)
 
     def accumulate(m, l, o, k_blk, v_blk, src):
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
@@ -283,6 +282,109 @@ def _make_ring_flash(axis_name: str, causal: bool, bq: int, bk: int,
         return (dqv.reshape(b, h, t, d).astype(q.dtype),
                 dk32.reshape(b, h, t, d).astype(k.dtype),
                 dv32.reshape(b, h, t, d).astype(v.dtype))
+
+    rf.defvjp(fwd, bwd)
+    return rf
+
+
+def _make_ring_flash_cross(axis_name: str, causal: bool, bq: int,
+                           bk: int, interpret: bool):
+    """Differentiable fused ring attention for UNEQUAL shard extents
+    (cross-attention: T_q ≠ T_k per shard).
+
+    Forward: the same fused Pallas ring as the equal-extent path
+    (_flash_ring_forward handles t_q ≠ t_k), keeping the lse residual.
+
+    Backward: an einsum ring pass, NOT the flash backward kernels —
+    those assume square (T, T) block geometry (flash_bwd_block derives
+    the K/V specs from q's extent).  Each hop rematerializes one
+    (t_q_local, t_k_local) score block from the saved lse, which is
+    exactly the memory the fused path saves on the forward; for
+    cross-attention the K/V extent is typically the short encoder side,
+    so the block stays small.  Ring choreography matches
+    _make_ring_flash's backward: K/V stay home, (q, dO, lse, delta, dq)
+    rotate, dk/dv accumulate at home in f32, one final ppermute sends
+    dq home.  Causal masking uses GLOBAL positions (visitor q-group j's
+    offset j·t_q vs home K offset idx·t_k) — the equal-extent path can
+    reason per-pair, unequal extents cannot."""
+
+    def _fwd_pass(q, k, v):
+        return _flash_ring_forward(q, k, v, axis_name=axis_name,
+                                   causal=causal, bq=bq, bk=bk,
+                                   interpret=interpret)
+
+    @jax.custom_vjp
+    def rf(q, k, v):
+        return _fwd_pass(q, k, v)[0]
+
+    def fwd(q, k, v):
+        out, lse = _fwd_pass(q, k, v)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, do):
+        q, k, v, out, lse = res
+        n = lax.psum(1, axis_name)
+        idx = lax.axis_index(axis_name)
+        b, h, t_q, d = q.shape
+        t_k = k.shape[2]
+        scale = 1.0 / math.sqrt(d)
+        kf = k.astype(jnp.float32)
+        vf = v.astype(jnp.float32)
+        do32 = do.astype(jnp.float32)
+        delta = jnp.sum(do32 * out.astype(jnp.float32),
+                        axis=-1)                     # (B, H, t_q) f32
+        kpos = idx * t_k + jnp.arange(t_k)           # home K positions
+
+        def pair(vq, vdo, vlse, vdelta, j):
+            """Visitor q-group (home shard j) against the resident K/V:
+            p from the saved lse, then ds → (dq, dk, dv) partials."""
+            s = jnp.einsum("bhqd,bhkd->bhqk", vq.astype(jnp.float32),
+                           kf) * scale
+            p = jnp.exp(s - vlse[..., None])
+            if causal:
+                qpos = j * t_q + jnp.arange(t_q)
+                p = jnp.where((qpos[:, None] >= kpos[None, :])
+                              [None, None], p, 0.0)
+            dp = jnp.einsum("bhqd,bhkd->bhqk", vdo, vf)
+            ds = p * (dp - vdelta[..., None])
+            dqh = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+            dkh = jnp.einsum("bhqk,bhqd->bhkd", ds,
+                             vq.astype(jnp.float32)) * scale
+            dvh = jnp.einsum("bhqk,bhqd->bhkd", p, vdo)
+            return dqh, dkh, dvh
+
+        def maybe_pair(vq, vdo, vlse, vdelta, j):
+            if not causal:
+                return pair(vq, vdo, vlse, vdelta, j)
+            # visitor contributes iff its last q row can see the home
+            # shard's first k row (mirror of the forward's hop skip)
+            return lax.cond(
+                (j + 1) * t_q > idx * t_k,
+                lambda _: pair(vq, vdo, vlse, vdelta, j),
+                lambda _: (jnp.zeros((b, h, t_q, d), jnp.float32),
+                           jnp.zeros((b, h, t_k, d), jnp.float32),
+                           jnp.zeros((b, h, t_k, d), jnp.float32)),
+                None)
+
+        dq0, dk0, dv0 = maybe_pair(q, do32, lse, delta, idx)
+
+        def body(s, carry):
+            vq, vdo, vlse, vdelta, dqv, dk, dv = carry
+            prm = [(i, (i + 1) % n) for i in range(n)]
+            vq, vdo, vlse, vdelta, dqv = (
+                lax.ppermute(x, axis_name, prm)
+                for x in (vq, vdo, vlse, vdelta, dqv))
+            j = (idx - s) % n         # visiting q-group's home shard
+            dqh, dkh, dvh = maybe_pair(vq, vdo, vlse, vdelta, j)
+            return (vq, vdo, vlse, vdelta, dqv + dqh, dk + dkh,
+                    dv + dvh)
+
+        carry = (q, do32, lse, delta, dq0, dk0, dv0)
+        _, _, _, _, dqv, dk32, dv32 = lax.fori_loop(1, n, body, carry)
+        prm = [(i, (i + 1) % n) for i in range(n)]
+        dqv = lax.ppermute(dqv, axis_name, prm)
+        return (dqv.astype(q.dtype), dk32.astype(k.dtype),
+                dv32.astype(v.dtype))
 
     rf.defvjp(fwd, bwd)
     return rf

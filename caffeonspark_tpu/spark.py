@@ -98,6 +98,51 @@ def _get_barrier_context():
     return BarrierTaskContext.get()
 
 
+def _pickle_source_spec(source) -> bytes:
+    """Serializable recipe for reconstructing a DataSource inside a
+    Spark task: the layer proto + construction kwargs.  Ships a few
+    hundred bytes to the executors instead of the dataset itself."""
+    import pickle
+    return pickle.dumps({
+        "layer": source.layer, "phase_train": source.phase_train,
+        "seed": source.seed, "resize": source.resize,
+        "num_threads": source.num_threads,
+    })
+
+
+def _task_source(blob: bytes, rank: int, num_ranks: int):
+    """Executor-side: open the source's own rank shard (the readers'
+    partition_ranges / file-sharding handle the split)."""
+    import pickle
+
+    from .data.source import get_source
+    spec = pickle.loads(blob)
+    return get_source(spec["layer"], phase_train=spec["phase_train"],
+                      rank=rank, num_ranks=num_ranks,
+                      seed=spec["seed"], resize=spec["resize"],
+                      num_threads=spec["num_threads"])
+
+
+def _feed_records(client, proc, queue_idx: int, records) -> int:
+    """Stream records into the rank's feed path — daemon when
+    discovered, same-process processor fallback otherwise.  Shared by
+    the RDD-partition and executor-side-source feed tasks."""
+    if client is not None:
+        try:
+            fed = client.feed(queue_idx, records)
+            client.epoch_end(queue_idx)
+        finally:
+            client.close()
+        return fed
+    fed = 0
+    for rec in records:
+        if not proc.feed_queue(queue_idx, rec):
+            break
+        fed += 1
+    proc.mark_epoch_end(queue_idx)
+    return fed
+
+
 class SparkEngine:
     """Driver-side engine dispatching CaffeProcessor work to executors.
 
@@ -180,23 +225,37 @@ class SparkEngine:
 
         def feed(idx, it):
             client, proc = _discover_for_task(app_id, idx % n, idx)
-            if client is not None:
-                try:
-                    fed = client.feed(queue_idx, it)
-                    client.epoch_end(queue_idx)
-                finally:
-                    client.close()
-                yield fed
-                return
-            fed = 0
-            for rec in it:
-                if not proc.feed_queue(queue_idx, rec):
-                    break
-                fed += 1
-            proc.mark_epoch_end(queue_idx)
-            yield fed
+            yield _feed_records(client, proc, queue_idx, it)
 
         return sum(rdd.mapPartitionsWithIndex(feed).collect())
+
+    def feed_source(self, source, queue_idx: int = 0,
+                    epoch: int = 0) -> int:
+        """One epoch of EXECUTOR-SIDE reads: one task per rank
+        reconstructs the source inside the task and streams its own
+        rank shard into the host-local daemon.  Records never
+        materialize on — or stream through — the driver, matching the
+        reference's executor-resident partition reads (LmdbRDD's
+        compute() opens the database on the executor,
+        LmdbRDD.scala:101-136; the round-4 advisor flagged the
+        previous driver-side list(source.records()) as an OOM for
+        Caffe-scale databases).  TRAIN-phase shards reshuffle per
+        epoch via the source's deterministic (seed, rank, epoch)
+        streaming shuffle."""
+        app_id = self.app_id
+        n = self.cluster_size
+        blob = _pickle_source_spec(source)
+
+        def feed(idx, _it):
+            rank = idx % n
+            src = _task_source(blob, rank, n)
+            records = (src.shuffled_records(epoch) if src.phase_train
+                       else src.records())
+            client, proc = _discover_for_task(app_id, rank, idx)
+            yield _feed_records(client, proc, queue_idx, records)
+
+        return sum(self.sc.parallelize(range(n), n)
+                   .mapPartitionsWithIndex(feed).collect())
 
     def features_partitions(self, rdd, blob_names=None):
         """features()/test() over the cluster: each task ships its
@@ -220,6 +279,34 @@ class SparkEngine:
                 client.close()
 
         return rdd.mapPartitionsWithIndex(extract).collect()
+
+    def features_source(self, source, blob_names=None):
+        """features()/test() with EXECUTOR-SIDE reads: each task opens
+        its rank shard of the source inside the task and ships records
+        straight to the host-local daemon's EXTRACT op — only the
+        result rows cross the driver (featureRDD over LmdbRDD's
+        executor-side partitions, CaffeOnSpark.scala:483-505)."""
+        app_id = self.app_id
+        n = self.cluster_size
+        blob = _pickle_source_spec(source)
+        names = list(blob_names) if blob_names else None
+
+        def extract(idx, _it):
+            rank = idx % n
+            src = _task_source(blob, rank, n)
+            records = src.records()
+            client, proc = _discover_for_task(app_id, rank, idx)
+            if client is None:
+                nm = names or proc.default_feature_blobs()
+                yield from proc.extract_rows(records, nm)
+                return
+            try:
+                yield from client.extract(records, names)
+            finally:
+                client.close()
+
+        return (self.sc.parallelize(range(n), n)
+                .mapPartitionsWithIndex(extract).collect())
 
     def collect_report(self, rank: int = 0) -> Optional[Dict[str, Any]]:
         """Processor progress + validation rows from one executor (the

@@ -101,6 +101,28 @@ class Supervisor:
         return subprocess.Popen(cmd)
 
     def _teardown(self):
+        """SIGTERM with a drain window first, SIGKILL only stragglers.
+
+        An immediate SIGKILL loses in-flight ASYNC snapshot uploads:
+        write-behind checkpointing to a remote FS can run seconds
+        behind the step loop, and killing the rank mid-upload throws
+        away the very snapshot the relaunch needs (the gs:// drill in
+        tests/test_fsutils_gcs.py restarted from scratch because the
+        iter-8 upload died with rank 0).  A rank wedged in a collective
+        (its peer died) never runs its SIGTERM handler, but its
+        uploader THREAD still drains during the window — then the
+        SIGKILL sweep reaps it."""
+        grace = getattr(self.args, "grace", 10.0)
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + grace
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    pass
         for p in self.procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGKILL)
@@ -211,6 +233,10 @@ def main(argv=None) -> int:
     ap.add_argument("-local_ranks", type=int, default=0,
                     help="ranks launched on this host "
                          "(default: all of -cluster)")
+    ap.add_argument("-grace", type=float, default=10.0,
+                    help="teardown drain window seconds (SIGTERM -> "
+                         "wait -> SIGKILL) so async snapshot uploads "
+                         "finish before ranks die")
     ap.add_argument("-stall_timeout", type=float, default=0.0,
                     help="seconds without snapshot progress before "
                          "assuming a remote-rank failure (0 = off; "

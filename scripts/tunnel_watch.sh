@@ -5,7 +5,7 @@
 set -u
 INTERVAL=${1:-600}
 PROBE_TIMEOUT=${2:-120}
-LOG=${TUNNEL_WATCH_LOG:-/tmp/tunnel_watch_r4.log}
+LOG=${TUNNEL_WATCH_LOG:-/tmp/tunnel_watch_r5.log}
 cd "$(dirname "$0")/.."
 n=0
 while true; do
@@ -14,7 +14,7 @@ while true; do
   if timeout "$PROBE_TIMEOUT" python -c "
 import jax
 ds = jax.devices()
-assert ds and ds[0].platform == 'tpu', ds
+assert ds and ds[0].platform in ('tpu', 'axon'), ds
 print('TPU alive:', ds)
 " >> "$LOG" 2>&1; then
     echo "TUNNEL ALIVE at $(date -u +%H:%M:%S) — capturing artifacts" >> "$LOG"

@@ -12,6 +12,8 @@ import pytest
 
 from caffeonspark_tpu.utils import StepTimer, profile_trace
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def test_step_timer():
     t = StepTimer(batch_size=32)
@@ -141,12 +143,12 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
                       'random_seed: 3\n')
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "PALLAS_AXON_POOL_IPS": "",
-           "PYTHONPATH": "/root/repo"}
+           "PYTHONPATH": REPO}
     r = subprocess.run(
         [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
          "-solver", str(solver), "-output", str(tmp_path)],
         capture_output=True, text=True, timeout=300, env=env,
-        cwd="/root/repo")
+        cwd=REPO)
     assert r.returncode == 0, r.stderr[-1500:]
     assert "iter 6/6" in r.stdout
     assert os.path.exists(tmp_path / "i_iter_6.caffemodel")
@@ -187,7 +189,7 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
     solver.write_text(f'net: "{net}"\nbase_lr: 0.01\nmomentum: 0.9\n'
                       'lr_policy: "fixed"\nmax_iter: 40\n'
                       'snapshot_prefix: "m"\nrandom_seed: 6\n')
-    sys.path.insert(0, "/root/repo/examples")
+    sys.path.insert(0, os.path.join(REPO, "examples"))
     try:
         import multiclass_logistic_regression as ex
         acc = ex.main(["-conf", str(solver), "-features", "ip1",
@@ -206,11 +208,11 @@ def test_long_context_example(tmp_path):
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "PALLAS_AXON_POOL_IPS": "",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-           "PYTHONPATH": "/root/repo"}
+           "PYTHONPATH": REPO}
     r = subprocess.run(
         [sys.executable, "examples/long_context.py", "16"],
         capture_output=True, text=True, timeout=520, env=env,
-        cwd="/root/repo")
+        cwd=REPO)
     assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-800:])
     assert "matches the single-device step" in r.stdout
     assert "fused ring attention trains end to end" in r.stdout

@@ -58,6 +58,71 @@ def test_crashing_worker_fails_fast_with_claimed_block(tmp_path):
         in rec["claimed"]
 
 
+def test_claimed_numbers_single_sourced():
+    """docs/claimed_benchmarks.json is the ONE source of builder-
+    reported numbers (VERDICT r4 ask #5).  Assert (a) bench.py's
+    loader returns exactly the JSON, and (b) every numeric claim
+    appears in docs/benchmarks.md's prose/table, so the two human
+    surfaces cannot drift from the machine one."""
+    sys.path.insert(0, REPO)
+    try:
+        from bench import _load_claimed
+    finally:
+        sys.path.remove(REPO)
+    claimed = _load_claimed()
+    with open(os.path.join(REPO, "docs", "claimed_benchmarks.json")) as f:
+        raw = json.load(f)
+    raw.pop("_comment", None)
+    assert claimed == raw
+    assert "caffenet_imagenet_train_images_per_sec_per_chip" in claimed
+
+    md = open(os.path.join(REPO, "docs", "benchmarks.md")).read()
+    md_flat = md.replace(",", "")        # tables write 17,322
+    for key, entry in claimed.items():
+        if key == "source":
+            continue
+        if isinstance(entry, dict):
+            value, mfu = entry["value"], entry.get("mfu")
+        else:
+            value, mfu = entry, None
+        value_str = (f"{value:g}" if isinstance(value, float)
+                     else str(value))
+        assert value_str in md_flat, \
+            f"{key}: claimed value {value_str} not in docs/benchmarks.md"
+        if mfu is not None:
+            assert f"{mfu * 100:.1f}%" in md, \
+                f"{key}: claimed MFU {mfu:.1%} not in docs/benchmarks.md"
+
+
+def test_spark_tests_runner_always_writes_artifact(tmp_path):
+    """spark_tests.py applies the tpu_tests.py contract to the
+    environment-gated legs: an artifact JSON is ALWAYS written, with
+    per-test outcomes and the env facts that decide the gates (here:
+    no pyspark -> the spark leg records honest skips, rc 1)."""
+    out = tmp_path / "SPARK_TESTS_test.json"
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env.update({"JAX_PLATFORMS": "cpu", "SPARK_TESTS_OUT": str(out),
+                "SPARK_TESTS_LEGS": "spark",
+                "SPARK_TESTS_TIMEOUT": "240"})
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "spark_tests.py")],
+        capture_output=True, text=True, timeout=280, env=env, cwd=REPO)
+    rec = json.loads(out.read_text())
+    assert "spark" in rec["legs"]
+    leg = rec["legs"]["spark"]
+    assert leg["tests"], "junit outcomes must be recorded"
+    assert "pyspark" in rec["env"] and "java" in rec["env"]
+    has_spark = rec["env"]["pyspark"] and rec["env"]["java"]
+    if not has_spark:       # this dev box: honest skip, nonzero exit
+        assert proc.returncode == 1
+        assert rec["ok"] is False
+        assert all(t["outcome"] == "skipped" for t in leg["tests"])
+    else:                   # docker/CI: the real proof must pass
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert rec["ok"] is True
+
+
 def test_env_preflight_fails_without_spawning_worker():
     """Deterministic env-combination errors (BENCH_PIPELINE with the
     recurrent model) produce the structured failure record immediately

@@ -16,6 +16,8 @@ from caffeonspark_tpu.proto import (NetParameter, SolverParameter)
 from caffeonspark_tpu.proto.caffe import Datum, SnapshotFormat
 from caffeonspark_tpu.solver import Solver
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 NET = """
 name: "tiny"
 layer { name: "data" type: "MemoryData" top: "data" top: "label"
@@ -243,13 +245,13 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
                       'snapshot_prefix: "k"\nrandom_seed: 4\n')
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "PALLAS_AXON_POOL_IPS": "",
-           "PYTHONPATH": "/root/repo"}
+           "PYTHONPATH": REPO}
     import signal, time
     p = subprocess.Popen(
         [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
          "-solver", str(solver), "-output", str(tmp_path)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env, cwd="/root/repo")
+        env=env, cwd=REPO)
     # wait for at least one periodic snapshot, then hard-kill
     deadline = time.time() + 240
     snap = None
@@ -273,7 +275,7 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
          "-solver", str(solver), "-output", str(tmp_path),
          "-snapshot", str(tmp_path / snap), "-iterations", "60"],
         capture_output=True, text=True, timeout=300, env=env,
-        cwd="/root/repo")
+        cwd=REPO)
     assert r.returncode == 0, r.stdout[-1500:]
     it0 = int(snap.split("_iter_")[1].split(".")[0])
     assert f"resumed from iter {it0}" in r.stdout
@@ -319,7 +321,7 @@ random_seed: 7
          "-solver", str(solver_txt), "-train", str(tmp_path / "lmdb"),
          "-output", str(tmp_path)],
         capture_output=True, text=True, timeout=300, env=env,
-        cwd="/root/repo")
+        cwd=REPO)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "iter 10/12" in r.stdout or "iter 5/12" in r.stdout
     assert os.path.exists(tmp_path / "m_iter_10.caffemodel")
@@ -332,6 +334,6 @@ random_seed: 7
          "-snapshot", str(tmp_path / "m_iter_10.solverstate"),
          "-iterations", "15"],
         capture_output=True, text=True, timeout=300, env=env,
-        cwd="/root/repo")
+        cwd=REPO)
     assert r2.returncode == 0, r2.stderr[-2000:]
     assert "resumed from iter 10" in r2.stdout

@@ -13,6 +13,8 @@ import sys
 import numpy as np
 import pytest
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 REF = "/root/reference/data"
 
 pytestmark = pytest.mark.skipif(
@@ -66,7 +68,7 @@ def test_cifar10_quick_cli(tmp_path):
     out = tmp_path / "out"
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
            "PALLAS_AXON_POOL_IPS": "",
-           "PYTHONPATH": "/root/repo" + os.pathsep
+           "PYTHONPATH": REPO + os.pathsep
            + os.environ.get("PYTHONPATH", "")}
     r = subprocess.run(
         [sys.executable, "-m", "caffeonspark_tpu.caffe_on_spark",

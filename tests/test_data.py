@@ -181,6 +181,20 @@ def test_transformer_crop_center_vs_random():
     assert any(c.min() == 0.0 for c in crops)  # random crops vary
 
 
+def test_transformer_empty_batch_center_crop():
+    """n=0 must yield the cropped shape, not IndexError on hs[0]
+    (round-4 advisor: the per-sample offset arrays have no element 0
+    for an empty batch; eval crop uses scalar center offsets)."""
+    tp = TransformationParameter(crop_size=8)
+    t = Transformer(tp, phase_train=False, seed=0)
+    y = t(np.zeros((0, 3, 12, 12), np.float32))
+    assert y.shape == (0, 3, 8, 8)
+    # train mode with n=0 stacks nothing — also a valid empty batch
+    t2 = Transformer(tp, phase_train=True, seed=0)
+    y2 = t2(np.zeros((0, 3, 12, 12), np.float32))
+    assert y2.shape[0] == 0
+
+
 def test_transformer_mean_file(tmp_path):
     mean = np.random.RandomState(0).rand(1, 6, 6).astype(np.float32) * 10
     bp = BlobProto(shape=BlobShape(dim=[1, 1, 6, 6]),

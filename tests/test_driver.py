@@ -18,6 +18,8 @@ from caffeonspark_tpu.data import LmdbWriter, get_source
 from caffeonspark_tpu.data.synthetic import make_images
 from caffeonspark_tpu.proto.caffe import Datum
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def _write_lmdb(path, n=256, seed=5):
     imgs, labels = make_images(n, seed=seed)
@@ -284,7 +286,7 @@ def test_cli_end_to_end(setup):
     out = tmp / "out"
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "PALLAS_AXON_POOL_IPS": "",
-           "PYTHONPATH": "/root/repo" + os.pathsep
+           "PYTHONPATH": REPO + os.pathsep
            + os.environ.get("PYTHONPATH", "")}
     r = subprocess.run(
         [sys.executable, "-m", "caffeonspark_tpu.caffe_on_spark",

@@ -21,6 +21,8 @@ from caffeonspark_tpu.utils import fsutils  # noqa: E402
 
 from fake_gcs import FakeGCS  # noqa: E402
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 @pytest.fixture()
 def gcs(monkeypatch):
@@ -81,6 +83,71 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
     np.testing.assert_allclose(
         np.asarray(jax.device_get(params["ip"]["weight"])),
         np.asarray(jax.device_get(p2["ip"]["weight"])))
+
+
+def test_supervisor_rank_death_drill_over_gcs(gcs, tmp_path):
+    """Full pod-shaped elastic-recovery drill over the remote FS
+    (VERDICT r4 #8): a cluster=2 supervisor job with `-output gs://`,
+    rank 1 dies mid-run AFTER the iter-8 snapshot (injected fault),
+    the supervisor relaunches every rank FROM the gs:// snapshot, and
+    the completed model lands in the bucket.  Composes
+    test_supervisor_recovers_from_rank_death with the fake GCS server:
+    every snapshot write, discovery listing, and resume read is an
+    HTTP round trip from real separate rank processes."""
+    import subprocess
+    import sys
+
+    from caffeonspark_tpu.data import LmdbWriter
+    from caffeonspark_tpu.data.synthetic import make_images
+    from caffeonspark_tpu.proto.caffe import Datum
+
+    imgs, labels = make_images(128, seed=6)
+    recs = [(b"%06d" % i,
+             Datum(channels=1, height=28, width=28,
+                   data=(imgs[i, 0] * 255).astype(np.uint8).tobytes(),
+                   label=int(labels[i])).to_binary())
+            for i in range(128)]
+    LmdbWriter(str(tmp_path / "lmdb")).write(recs)
+    net = tmp_path / "net.prototxt"
+    net.write_text(f'''
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "LMDB"
+  memory_data_param {{ source: "{tmp_path}/lmdb" batch_size: 8
+    channels: 1 height: 28 width: 28 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}''')
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(
+        f'net: "{net}"\nbase_lr: 0.05\nmomentum: 0.9\n'
+        'lr_policy: "fixed"\ndisplay: 8\nmax_iter: 24\n'
+        'snapshot: 8\nsnapshot_prefix: "sv"\nrandom_seed: 11\n')
+
+    out = "gs://bkt/drill"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+           "PALLAS_AXON_POOL_IPS": "",
+           "STORAGE_EMULATOR_HOST": gcs.endpoint,
+           "COS_FAULT_DIE_ONCE": f"1:12:{tmp_path}/died.marker",
+           "PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    r = subprocess.run(
+        [sys.executable, "-m", "caffeonspark_tpu.tools.supervisor",
+         "-solver", str(solver), "-train", str(tmp_path / "lmdb"),
+         "-output", out, "-cluster", "2",
+         "-max_restarts", "2", "-poll_interval", "0.3"],
+        capture_output=True, text=True, timeout=560, env=env,
+        cwd=REPO)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-1000:])
+    assert "attempt 1 ranks [0, 1] from scratch" in r.stdout
+    assert os.path.exists(tmp_path / "died.marker")
+    assert (f"attempt 2 ranks [0, 1] from "
+            f"{out}/sv_iter_8.solverstate") in r.stdout
+    assert "run complete" in r.stdout
+    assert ("bkt", "drill/sv_iter_24.caffemodel") in gcs.store
+    assert ("bkt", "drill/sv_iter_24.solverstate") in gcs.store
 
 
 def test_supervisor_discovery_over_gcs(gcs):

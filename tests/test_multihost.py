@@ -13,6 +13,8 @@ import sys
 import numpy as np
 import pytest
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def _free_port():
     with socket.socket() as s:
@@ -60,7 +62,7 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
                # baseline runs must NOT inherit the split from the
                # outer shell — parity would compare split vs split
                "COS_DEVICE_TRANSFORM": "",
-               "PYTHONPATH": "/root/repo" + os.pathsep
+               "PYTHONPATH": REPO + os.pathsep
                + os.environ.get("PYTHONPATH", ""), **extra_env}
         procs = []
         for rank in range(2):
@@ -71,7 +73,7 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
                  "-server", f"127.0.0.1:{port}",
                  "-cluster", "2", "-rank", str(rank)],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True, env=env, cwd="/root/repo"))
+                text=True, env=env, cwd=REPO))
         outs = []
         for p in procs:
             out, _ = p.communicate(timeout=520)

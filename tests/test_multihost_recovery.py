@@ -25,6 +25,8 @@ import time
 
 import numpy as np
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def _free_port():
     with socket.socket() as s:
@@ -45,7 +47,7 @@ def _launch(solver, lmdb, out, port, rank, env, extra=()):
          "-server", f"127.0.0.1:{port}",
          "-cluster", str(N_PROCS), "-rank", str(rank), *extra],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True, env=env, cwd="/root/repo")
+        text=True, env=env, cwd=REPO)
 
 
 def test_four_process_rank_failure_resume(tmp_path):
@@ -86,7 +88,7 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "PALLAS_AXON_POOL_IPS": "", "XLA_FLAGS": "",
            "COS_FAULT_STEP_DELAY_MS": "150",
-           "PYTHONPATH": "/root/repo" + os.pathsep
+           "PYTHONPATH": REPO + os.pathsep
            + os.environ.get("PYTHONPATH", "")}
 
     # ---- run 1: kill rank 3 after the first snapshot lands -----------

@@ -11,6 +11,8 @@ import pytest
 from caffeonspark_tpu.net import Net, layer_included
 from caffeonspark_tpu.proto import NetParameter, NetState, Phase, read_net
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 REF_DATA = "/root/reference/data"
 HAS_REF = os.path.isdir(REF_DATA)
 
@@ -54,7 +56,7 @@ def test_init_deterministic_across_runs():
     """Same seed → identical init (stable_hash, not randomized hash())."""
     import subprocess, sys
     code = (
-        "import sys; sys.path.insert(0, '/root/repo');"
+        f"import sys; sys.path.insert(0, {REPO!r});"
         "import os; os.environ['JAX_PLATFORMS']='cpu';"
         "import jax;"
         "from caffeonspark_tpu.net import Net;"

@@ -588,6 +588,44 @@ def test_ring_attention_flash_grads_match(causal):
             rtol=5e-4, atol=5e-5, err_msg=f"d{name} causal={causal}")
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_flash_cross_extent_grads_match(causal):
+    """Cross-attention shape (T_q ≠ T_k per shard) through the fused
+    ring is ALSO differentiable (VERDICT r4 #6): fused Pallas forward,
+    einsum-ring backward with global-position causal masking.  Grads
+    must match autodiff of the full reference attention."""
+    mesh = build_mesh(dp=2, sp=4)
+    rng = np.random.RandomState(8)
+    b, h, d = 2, 2, 16
+    t_q, t_k = 64, 128               # local 16 vs 32 per sp shard
+    q = jnp.asarray(rng.randn(b, h, t_q, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, t_k, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, t_k, d), jnp.float32)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention(q, k, v, causal=causal) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=causal,
+                                      flash="interpret") ** 2)
+
+    # forward parity first (the fused fwd already covered t_q != t_k;
+    # keep it pinned alongside the new grads)
+    ref_out = attention(q, k, v, causal=causal)
+    got_out = ring_attention(q, k, v, mesh, causal=causal,
+                             flash="interpret")
+    np.testing.assert_allclose(np.asarray(jax.device_get(got_out)),
+                               np.asarray(ref_out), rtol=2e-4,
+                               atol=2e-5)
+
+    ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b_ in zip("qkv", ref, got):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(b_)), np.asarray(a),
+            rtol=5e-4, atol=5e-5, err_msg=f"d{name} causal={causal}")
+
+
 def test_ring_attention_flash_trains_sequence_parallel():
     """End to end: a toy attention 'layer' trained with the fused
     differentiable ring on a dp2×sp4 mesh tracks the einsum-ring

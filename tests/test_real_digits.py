@@ -22,6 +22,8 @@ import sys
 
 import pytest
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 REF = "/root/reference/data"
 
 pytestmark = pytest.mark.skipif(
@@ -60,7 +62,7 @@ def test_reference_lenet_on_real_digits(tmp_path):
     # correctly rejects that
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
            "PALLAS_AXON_POOL_IPS": "",
-           "PYTHONPATH": "/root/repo" + os.pathsep
+           "PYTHONPATH": REPO + os.pathsep
            + os.environ.get("PYTHONPATH", "")}
     r = subprocess.run(
         [sys.executable, "-m", "caffeonspark_tpu.caffe_on_spark",

@@ -13,6 +13,8 @@ import pytest
 
 from caffeonspark_tpu.tools.supervisor import find_latest_snapshot
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 N = 2
 SNAP = 8
 MAX_ITER = 24
@@ -65,7 +67,7 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
            # rank 1 exits(3) at iter 12 — after the iter-8 snapshot —
            # exactly once (marker suppresses it post-relaunch)
            "COS_FAULT_DIE_ONCE": f"1:12:{tmp_path}/died.marker",
-           "PYTHONPATH": "/root/repo" + os.pathsep
+           "PYTHONPATH": REPO + os.pathsep
            + os.environ.get("PYTHONPATH", "")}
     r = subprocess.run(
         [sys.executable, "-m", "caffeonspark_tpu.tools.supervisor",
@@ -73,7 +75,7 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
          "-output", str(out), "-cluster", str(N),
          "-max_restarts", "2", "-poll_interval", "0.3"],
         capture_output=True, text=True, timeout=560, env=env,
-        cwd="/root/repo")
+        cwd=REPO)
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-1000:])
     assert "attempt 1 ranks [0, 1] from scratch" in r.stdout
     assert "tearing down for relaunch" in r.stdout
@@ -127,7 +129,7 @@ def test_per_host_supervisors_complete_pod_job(tmp_path):
     out = tmp_path / "out"
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
            "PALLAS_AXON_POOL_IPS": "",
-           "PYTHONPATH": "/root/repo" + os.pathsep
+           "PYTHONPATH": REPO + os.pathsep
            + os.environ.get("PYTHONPATH", "")}
     procs = []
     for host_id in (0, 1):
@@ -139,7 +141,7 @@ def test_per_host_supervisors_complete_pod_job(tmp_path):
              "-rank_base", str(host_id), "-local_ranks", "1",
              "-max_restarts", "0", "-poll_interval", "0.3"],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True, env=env, cwd="/root/repo"))
+            text=True, env=env, cwd=REPO))
     outs = []
     for p in procs:
         o, _ = p.communicate(timeout=560)
@@ -158,7 +160,7 @@ def test_stall_timeout_detects_remote_death(tmp_path):
     solver = _tiny_job(tmp_path)
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
            "PALLAS_AXON_POOL_IPS": "",
-           "PYTHONPATH": "/root/repo" + os.pathsep
+           "PYTHONPATH": REPO + os.pathsep
            + os.environ.get("PYTHONPATH", "")}
     r = subprocess.run(
         [sys.executable, "-m", "caffeonspark_tpu.tools.supervisor",
@@ -168,7 +170,7 @@ def test_stall_timeout_detects_remote_death(tmp_path):
          "-stall_timeout", "12", "-max_restarts", "0",
          "-poll_interval", "0.3"],
         capture_output=True, text=True, timeout=240, env=env,
-        cwd="/root/repo")
+        cwd=REPO)
     assert r.returncode == 1, r.stdout[-1500:]
     assert "no progress for 12s" in r.stdout
     assert "max_restarts exceeded" in r.stdout

@@ -20,6 +20,8 @@ from caffeonspark_tpu.tools import (Vocab, binary2dataframe,
                                     lmdb2dataframe, lmdb2sequence,
                                     sequence2lmdb)
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 CAPTIONS = [
     "a dog runs across the green park",
     "a cat sits on the red mat",
@@ -117,7 +119,7 @@ def test_caption_embedding_round_trip(tmp_path):
 def test_simulator_cli():
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "PALLAS_AXON_POOL_IPS": "",
-           "PYTHONPATH": "/root/repo"}
+           "PYTHONPATH": REPO}
     r = subprocess.run(
         [sys.executable, "-m", "caffeonspark_tpu.tools.simulator",
          "-synthetic", "8", "-batch", "4", "-iterations", "3",
@@ -165,7 +167,7 @@ def test_coco_pipeline_cli(tmp_path, image_dir):
     cf.write_text(json.dumps(coco))
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "PALLAS_AXON_POOL_IPS": "",
-           "PYTHONPATH": "/root/repo"}
+           "PYTHONPATH": REPO}
     r = subprocess.run(
         [sys.executable, "-m", "caffeonspark_tpu.tools.converters",
          "cocodataset", "-captionFile", str(cf), "-imageRoot", str(d),
