@@ -104,14 +104,23 @@ def test_spark_tests_runner_always_writes_artifact(tmp_path):
            if k != "PALLAS_AXON_POOL_IPS"}
     env.update({"JAX_PLATFORMS": "cpu", "SPARK_TESTS_OUT": str(out),
                 "SPARK_TESTS_LEGS": "spark",
-                "SPARK_TESTS_TIMEOUT": "240"})
+                # roomy: in pyspark+JVM environments the real local[4]
+                # leg (JVM startup + both analogs) far exceeds the
+                # seconds the skip path needs here
+                "SPARK_TESTS_TIMEOUT": "600"})
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "spark_tests.py")],
-        capture_output=True, text=True, timeout=280, env=env, cwd=REPO)
+        capture_output=True, text=True, timeout=640, env=env, cwd=REPO)
+    assert out.exists(), (
+        "runner died without writing the artifact:\n"
+        f"stdout: {proc.stdout[-1500:]}\nstderr: {proc.stderr[-1500:]}")
     rec = json.loads(out.read_text())
     assert "spark" in rec["legs"]
     leg = rec["legs"]["spark"]
-    assert leg["tests"], "junit outcomes must be recorded"
+    assert leg.get("tests"), (
+        "junit outcomes must be recorded; leg record: "
+        f"{ {k: v for k, v in leg.items() if k != 'tail'} }\n"
+        f"tail: {leg.get('tail', '')[-600:]}")
     assert "pyspark" in rec["env"] and "java" in rec["env"]
     has_spark = rec["env"]["pyspark"] and rec["env"]["java"]
     if not has_spark:       # this dev box: honest skip, nonzero exit
