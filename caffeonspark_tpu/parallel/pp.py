@@ -96,28 +96,40 @@ def schedule_1f1b(num_stages: int, num_microbatches: int
     property that turns async dispatch into real pipeline overlap.
     """
     S, M = num_stages, num_microbatches
-    seqs: List[List[Tuple[str, int]]] = []
+    seqs: List[List[Tuple[str, int, int]]] = []
     for s in range(S):
         w = min(M, S - 1 - s)
-        seq: List[Tuple[str, int]] = [("F", m) for m in range(w)]
+        seq: List[Tuple[str, int, int]] = [("F", s, m) for m in range(w)]
         f, b = w, 0
         while f < M or b < M:
             if f < M:
-                seq.append(("F", f))
+                seq.append(("F", s, f))
                 f += 1
             if b < M:
-                seq.append(("B", b))
+                seq.append(("B", s, b))
                 b += 1
         seqs.append(seq)
+    return _topo_merge(seqs, S)
+
+
+def _topo_merge(seqs: List[List[Tuple[str, int, int]]], num_stages: int
+                ) -> List[Tuple[str, int, int]]:
+    """Merge per-executor op sequences (each internally ordered) into
+    one global topological dispatch order: an op is emitted only when
+    its cross-stage dependencies (fwd(s-1, m) for F; own F and
+    bwd(s+1, m) for B) are already out.  Round-robin, one op per
+    executor per round — shared by the plain and interleaved 1F1B
+    schedulers."""
+    S = num_stages
     order: List[Tuple[str, int, int]] = []
     emitted = set()
-    idx = [0] * S
-    while any(idx[s] < len(seqs[s]) for s in range(S)):
+    idx = [0] * len(seqs)
+    while any(idx[d] < len(seqs[d]) for d in range(len(seqs))):
         progressed = False
-        for s in range(S):                 # one op per stage per round
-            if idx[s] >= len(seqs[s]):
+        for d in range(len(seqs)):
+            if idx[d] >= len(seqs[d]):
                 continue
-            kind, m = seqs[s][idx[s]]
+            kind, s, m = seqs[d][idx[d]]
             if kind == "F":
                 ok = s == 0 or ("F", s - 1, m) in emitted
             else:
@@ -126,16 +138,67 @@ def schedule_1f1b(num_stages: int, num_microbatches: int
             if ok:
                 order.append((kind, s, m))
                 emitted.add((kind, s, m))
-                idx[s] += 1
+                idx[d] += 1
                 progressed = True
         if not progressed:
             raise RuntimeError("1F1B schedule deadlock (bug)")
     return order
 
 
+def schedule_interleaved_1f1b(num_devices: int, num_microbatches: int,
+                              num_chunks: int
+                              ) -> List[Tuple[str, int, int]]:
+    """Megatron-style INTERLEAVED 1F1B over virtual pipeline stages:
+    device d hosts `num_chunks` non-contiguous model chunks (virtual
+    stage c·D + d), microbatches stream through chunks in groups of D,
+    and each device's warmup is (D-d-1)·2 + (v-1)·D virtual forwards.
+    The steady-state bubble shrinks from (D-1)(f+b) to (D-1)(f+b)/v —
+    the property `test_interleaved_1f1b_beats_plain_under_fifo` proves
+    under the FIFO-device model (and the reason Megatron-LM runs this
+    schedule, Narayanan et al. 2021).  Returns the same
+    (kind, virtual_stage, microbatch) tuples as schedule_1f1b with
+    virtual_stage in [0, D·v); callers map virtual stage → device as
+    `vs % D`.  Requires num_microbatches % num_devices == 0 (the
+    group-of-D streaming pattern)."""
+    D, M, v = num_devices, num_microbatches, num_chunks
+    if v <= 1:
+        return schedule_1f1b(D, M)
+    if M % D:
+        raise ValueError(
+            f"interleaved 1F1B needs microbatches ({M}) divisible by "
+            f"devices ({D})")
+    total = M * v
+
+    def chunk_of(k):      # forward virtual-microbatch k → model chunk
+        return (k // D) % v
+
+    def mb_of(k):
+        return (k // (D * v)) * D + k % D
+
+    seqs: List[List[Tuple[str, int, int]]] = []
+    for d in range(D):
+        warm = min((D - d - 1) * 2 + (v - 1) * D, total)
+        seq: List[Tuple[str, int, int]] = []
+        kf = kb = 0
+        for _ in range(warm):
+            seq.append(("F", chunk_of(kf) * D + d, mb_of(kf)))
+            kf += 1
+        while kf < total or kb < total:
+            if kf < total:
+                seq.append(("F", chunk_of(kf) * D + d, mb_of(kf)))
+                kf += 1
+            if kb < total:
+                c = v - 1 - (kb // D) % v    # backward: chunks reversed
+                seq.append(("B", c * D + d, mb_of(kb)))
+                kb += 1
+        seqs.append(seq)
+    return _topo_merge(seqs, D * v)
+
+
 def simulate_makespan(order: List[Tuple[str, int, int]], num_stages: int,
                       *, fwd_cost: float = 1.0, bwd_cost: float = 2.0,
-                      hop_cost: float = 0.0) -> float:
+                      hop_cost: float = 0.0,
+                      num_devices: Optional[int] = None) -> float:
     """Makespan of a dispatch order under the FIFO-device execution
     model (the model JAX async dispatch actually follows: each device
     runs its queue in enqueue order; an op starts when it reaches the
@@ -144,8 +207,14 @@ def simulate_makespan(order: List[Tuple[str, int, int]], num_stages: int,
     topological order turns async dispatch into real overlap, while the
     naive per-microbatch order head-of-line blocks into a serial chain.
     Used by tests to prove the overlap property machine-independently,
-    and usable for stage-count planning."""
-    dev_free = [0.0] * num_stages
+    and usable for stage-count planning.
+
+    `num_devices` < num_stages models VIRTUAL stages (interleaved
+    1F1B): stage s runs on device s % num_devices, so chunks hosted on
+    one device contend for its queue — exactly the resource model the
+    interleaved schedule's bubble claim is about."""
+    D = num_devices or num_stages
+    dev_free = [0.0] * D
     done: Dict[Tuple[str, int, int], float] = {}
     for kind, s, m in order:
         dur = fwd_cost if kind == "F" else bwd_cost
@@ -157,8 +226,9 @@ def simulate_makespan(order: List[Tuple[str, int, int]], num_stages: int,
             deps.append(("F", s, m))
             if s < num_stages - 1:
                 deps.append(("B", s + 1, m))
-        start = max([dev_free[s]] + [done[d] + hop_cost for d in deps])
-        done[(kind, s, m)] = dev_free[s] = start + dur
+        d = s % D
+        start = max([dev_free[d]] + [done[x] + hop_cost for x in deps])
+        done[(kind, s, m)] = dev_free[d] = start + dur
     return max(done.values()) if done else 0.0
 
 
@@ -179,15 +249,36 @@ class PipelineSolver:
 
     def __init__(self, solver: Solver, *, num_stages: int,
                  devices: Optional[Sequence] = None,
-                 num_microbatches: int = 2):
+                 num_microbatches: int = 2, virtual_stages: int = 1):
+        """`virtual_stages` v > 1 = INTERLEAVED 1F1B: the model splits
+        into num_stages·v chunks, device d hosts chunks {c·D + d}, and
+        the Megatron-style schedule shrinks the pipeline bubble from
+        (D-1)(f+b) to (D-1)(f+b)/v (see schedule_interleaved_1f1b).
+        Needs num_microbatches divisible by num_stages and at least
+        num_stages·v layers."""
         self.solver = solver
         devices = list(devices if devices is not None else jax.devices())
         assert len(devices) >= num_stages, (
             f"{num_stages} stages need {num_stages} devices")
         net = solver.train_net
         self.net = net
-        self.stages = partition_layers(net, num_stages)
-        self.devices = devices[:len(self.stages)]
+        self.virtual_stages = max(1, int(virtual_stages))
+        chunks = num_stages * self.virtual_stages
+        if self.virtual_stages > 1 and len(net.compute_layers) < chunks:
+            raise ValueError(
+                f"interleaved pipeline needs >= {chunks} layers "
+                f"({num_stages} devices x {self.virtual_stages} "
+                f"chunks); net has {len(net.compute_layers)}")
+        if self.virtual_stages > 1 and num_microbatches % num_stages:
+            # fail at construction, not first train_step (same
+            # treatment as the layer-count precondition above)
+            raise ValueError(
+                f"interleaved 1F1B needs microbatches "
+                f"({num_microbatches}) divisible by devices "
+                f"({num_stages})")
+        self.stages = partition_layers(net, chunks)
+        self.num_devices = min(num_stages, len(self.stages))
+        self.devices = devices[:self.num_devices]
         self.num_microbatches = num_microbatches
         self.stage_of_layer: Dict[str, int] = {}
         for i, names in enumerate(self.stages):
@@ -239,10 +330,16 @@ class PipelineSolver:
         self._serialize_ops = False
 
     # ------------------------------------------------------------------
+    def _dev(self, s: int):
+        """Device hosting (virtual) stage s: round-robin over the
+        physical devices — chunk c of device d is virtual stage
+        c·D + d, so s % D recovers d (identity when virtual_stages=1)."""
+        return self.devices[s % self.num_devices]
+
     def place_params(self, params: Params) -> Params:
         out: Params = {}
         for ln, blobs in params.items():
-            dev = self.devices[self.stage_of_layer.get(ln, 0)]
+            dev = self._dev(self.stage_of_layer.get(ln, 0))
             out[ln] = {bn: jax.device_put(a, dev)
                        for bn, a in blobs.items()}
         return out
@@ -298,7 +395,7 @@ class PipelineSolver:
         (dict with 'acts', 'vjps', 'state_shapes', 'fwd_state')."""
         fns = self._build_stage_fns()
         acts = mb["acts"]
-        ins = {b: jax.device_put(acts[b], self.devices[s])
+        ins = {b: jax.device_put(acts[b], self._dev(s))
                for b in self.stage_in[s]}
         sp = self.stage_params(params, s)
         (outs, st_out), vjp = jax.vjp(
@@ -311,7 +408,8 @@ class PipelineSolver:
             loss = jnp.zeros((), jnp.float32)
             for b, w in self.net.loss_weights.items():
                 loss = loss + w * jnp.sum(
-                    jax.device_put(acts[b], self.devices[-1]))
+                    jax.device_put(acts[b],
+                                   self._dev(len(self.stages) - 1)))
             mb["loss"] = loss
 
     def _run_bwd(self, params, s, mb, grads_acc):
@@ -329,10 +427,10 @@ class PipelineSolver:
                 # POP: in-place layers reuse blob names across stages
                 # (relu2's 'fc_big' vs conv's 'fc_big'); each stage's
                 # cotangent belongs to ITS version of the value
-                out_cot[b] = jax.device_put(cot.pop(b), self.devices[s])
+                out_cot[b] = jax.device_put(cot.pop(b), self._dev(s))
             else:
                 out_cot[b] = jnp.zeros_like(
-                    jax.device_put(acts[b], self.devices[s]))
+                    jax.device_put(acts[b], self._dev(s)))
         state_cot = jax.tree_util.tree_map(
             jnp.zeros_like, mb["state_shapes"][s])
         g_sp, g_in = mb["vjps"][s]((out_cot, state_cot))
@@ -371,7 +469,10 @@ class PipelineSolver:
         m = self.num_microbatches
         clip = solver.param.clip_gradients
         S = len(self.stages)
-        order = schedule_1f1b(S, m)
+        order = (schedule_interleaved_1f1b(self.num_devices, m,
+                                           self.virtual_stages)
+                 if self.virtual_stages > 1 else
+                 schedule_1f1b(S, m))
 
         def step(params, state, microbatches, rng):
             mbs = []
