@@ -169,10 +169,213 @@ def snapshot_filename(prefix: str, it: int, *, is_state: bool,
     return f"{prefix}_iter_{it}.{ext}" + (".h5" if h5 else "")
 
 
+# -- sharded optimizer state (ZeRO / multi-host) ----------------------------
+#
+# With COS_ZERO=1 on a multi-host dp mesh the optimizer history is
+# sharded ACROSS PROCESSES: no process can device_get the full array,
+# and a collective gather inside the rank-0-only snapshot path would
+# deadlock (the other ranks never enter it).  Instead each process
+# writes ITS OWN addressable shards to a sidecar next to the
+# .solverstate (`<state>.shard<process>` — an npz of
+# `b<blob_idx>__<start-stop[_start-stop...]>` slabs), the main
+# .solverstate carries a shape-only marker blob (empty data), and
+# restore() reassembles the full array from all sidecars on the shared
+# FS.  This is the orbax-style per-host checkpoint write, shrunk to the
+# .solverstate container — parallel writes, no all-gather, and the
+# reassembled state re-shards on load via ParallelSolver.
+
+class ShardedHostBlob:
+    """Host copy of a partially-addressable array: the full shape plus
+    this process's {bounds_key: ndarray} shards."""
+
+    def __init__(self, shape, shards):
+        self.shape = tuple(int(d) for d in shape)
+        self.shards = shards
+
+
+def _bounds_key(index, shape) -> str:
+    return "_".join(
+        f"{s.start or 0}-{s.stop if s.stop is not None else d}"
+        for s, d in zip(index, shape))
+
+
+def _needs_shards(x: jax.Array) -> bool:
+    """True only for genuinely PARTITIONED multi-host arrays: a fully-
+    replicated array (plain dp state, the iter scalar) is device_get-
+    able everywhere and must keep the dense Caffe-interop format."""
+    if x.ndim == 0 or x.is_fully_addressable:
+        return False
+    try:
+        if x.sharding.is_fully_replicated:
+            return False
+    except AttributeError:
+        pass
+    return True
+
+
+def host_state_blob(x, *, force_shards: bool = False):
+    """np.ndarray for a fully-addressable (or fully-replicated) value;
+    ShardedHostBlob otherwise (only this process's shards — no
+    collective).  `force_shards` takes the sharded path even when
+    fully addressable (single-process virtual meshes, where it
+    exercises the exact format a multi-host run writes).  Passes
+    through host representations untouched so AsyncSnapshotter can
+    pre-materialize consistent copies before buffer donation
+    invalidates the arrays."""
+    if isinstance(x, (np.ndarray, ShardedHostBlob)):
+        return x
+    if isinstance(x, jax.Array) and x.ndim > 0 \
+            and (force_shards or _needs_shards(x)):
+        shards = {}
+        for sh in x.addressable_shards:
+            if sh.replica_id != 0:
+                continue
+            shards[_bounds_key(sh.index, x.shape)] = np.asarray(
+                sh.data, np.float32)
+        return ShardedHostBlob(x.shape, shards)
+    return np.asarray(jax.device_get(x))
+
+
+def state_is_sharded(opt_state: OptState) -> bool:
+    """True when any state leaf is partitioned across processes (then
+    EVERY rank must call snapshot() so its sidecar gets written; rank 0
+    alone cannot see the other hosts' shards)."""
+    for leaf in jax.tree_util.tree_leaves(
+            (opt_state.history, opt_state.history2)):
+        if isinstance(leaf, ShardedHostBlob):
+            return True
+        if isinstance(leaf, jax.Array) and _needs_shards(leaf):
+            return True
+    return False
+
+
+def _shard_sidecar_path(state_path: str) -> str:
+    idx = jax.process_index() if jax.process_count() > 1 else 0
+    return f"{state_path}.shard{idx}"
+
+
+_SIDECAR_META = "__meta_nprocs__"
+
+
+def _load_state_shards(state_path: str) -> Dict[str, np.ndarray]:
+    import io
+    import re
+    d = fsutils.dirname(state_path)
+    base = fsutils.basename(state_path) + ".shard"
+    pat = re.compile(re.escape(base) + r"\d+$")   # excludes .tmp.* etc
+    merged: Dict[str, np.ndarray] = {}
+    names = [n for n in fsutils.listdir(d) if pat.fullmatch(n)]
+    if not names:
+        raise FileNotFoundError(
+            f"{state_path}: solverstate has sharded-state markers but "
+            f"no {base}* sidecars exist — snapshot written with a "
+            "non-shared output dir, or the sidecar writes were lost")
+    nprocs = set()
+    for n in sorted(names):
+        blob = fsutils.read_bytes(fsutils.join(d, n))
+        with np.load(io.BytesIO(blob)) as z:
+            for k in z.files:
+                if k == _SIDECAR_META:
+                    nprocs.add(int(z[k]))
+                else:
+                    merged[k] = z[k]
+    # generation check: stale sidecars from an earlier run with a
+    # different process count in the same output dir would otherwise
+    # merge SILENTLY into corrupted state (the coverage check cannot
+    # see overlapping stale slabs)
+    if len(nprocs) != 1 or len(names) != next(iter(nprocs)):
+        raise ValueError(
+            f"{state_path}: mixed-generation shard sidecars "
+            f"({len(names)} files, declared process counts "
+            f"{sorted(nprocs)}) — clean stale .shard* files from the "
+            "output dir and re-snapshot")
+    return merged
+
+
+def _assemble_blob(idx: int, shape, shards: Dict[str, np.ndarray]
+                   ) -> np.ndarray:
+    out = np.zeros(shape, np.float32)
+    covered = np.zeros(shape, bool)
+    prefix = f"b{idx}__"
+    for key, arr in shards.items():
+        if not key.startswith(prefix):
+            continue
+        sl = tuple(slice(int(a), int(b)) for a, b in
+                   (part.split("-") for part in
+                    key[len(prefix):].split("_")))
+        out[sl] = arr
+        covered[sl] = True
+    if not covered.all():
+        raise ValueError(
+            f"state blob {idx} (shape {shape}): sidecars cover only "
+            f"{covered.mean():.0%} — a host's shard file is missing")
+    return out
+
+
+def _state_blob_seq(net: Net, opt_state: OptState, solver_type: str):
+    """State blobs in canonical .solverstate order (history, then —
+    for two-accumulator solvers — history2), matching restore()."""
+    hists = ((opt_state.history, opt_state.history2)
+             if solver_type.upper() in ("ADAM", "ADADELTA")
+             else (opt_state.history,))
+    for hist in hists:
+        for lname, specs in net.param_layout.items():
+            for bname, _, _ in specs:
+                yield hist[lname][bname]
+
+
+def _write_slabs(slabs: Dict[str, np.ndarray], state_path: str) -> None:
+    import io
+    buf = io.BytesIO()
+    np.savez(buf, **slabs,
+             **{_SIDECAR_META: np.asarray(
+                 jax.process_count() if jax.process_count() > 1 else 1,
+                 np.int64)})
+    fsutils.write_bytes(_shard_sidecar_path(state_path), buf.getvalue())
+
+
+def _collect_state(net: Net, opt_state: OptState, solver_type: str,
+                   force_shards: bool):
+    """One pass over the canonical state-blob order → (blobprotos,
+    sidecar slabs).  The ONE place that knows the marker/slab format —
+    both the rank-0 (write_main) and sidecar-only snapshot paths
+    consume it, so their key naming can never diverge."""
+    protos: list = []
+    slabs: Dict[str, np.ndarray] = {}
+    for i, blob in enumerate(_state_blob_seq(net, opt_state,
+                                             solver_type)):
+        h = host_state_blob(blob, force_shards=force_shards)
+        if isinstance(h, ShardedHostBlob):
+            protos.append(BlobProto(shape=BlobShape(dim=list(h.shape))))
+            for key, arr in h.shards.items():
+                slabs[f"b{i}__{key}"] = arr
+        else:
+            protos.append(_to_blobproto(h))
+    return protos, slabs
+
+
+def _write_state_sidecar(net: Net, opt_state: OptState, state_path: str,
+                         solver_type: str, force_shards: bool) -> None:
+    """Non-rank-0 multi-host snapshot: write ONLY this process's shard
+    sidecar (rank 0 owns the model + solverstate files)."""
+    _, slabs = _collect_state(net, opt_state, solver_type, force_shards)
+    if slabs:
+        _write_slabs(slabs, state_path)
+
+
 def snapshot(net: Net, params: Params, opt_state: OptState, prefix: str,
              *, fmt: int = SnapshotFormat.BINARYPROTO,
-             solver_type: str = "SGD") -> Tuple[str, str]:
-    """Write model + state; returns (model_path, state_path)."""
+             solver_type: str = "SGD", write_main: bool = True,
+             force_shards: bool = False) -> Tuple[str, str]:
+    """Write model + state; returns (model_path, state_path).
+
+    Sharded state (see the sharded-state section above): blobs that are
+    not fully addressable land in a per-process sidecar; the
+    .solverstate carries shape-only markers.  `write_main=False` is the
+    non-rank-0 multi-host call — ONLY the sidecar is written (rank 0
+    owns the model + solverstate).  `force_shards` routes every state
+    blob through the sidecar even when fully addressable (tests the
+    multi-host format on one process)."""
     it = int(jax.device_get(opt_state.iter))
     h5 = fmt == SnapshotFormat.HDF5
     remote = fsutils.is_remote(prefix)
@@ -180,6 +383,23 @@ def snapshot(net: Net, params: Params, opt_state: OptState, prefix: str,
         os.makedirs(fsutils.dirname(prefix), exist_ok=True)
     model_path = snapshot_filename(prefix, it, is_state=False, h5=h5)
     state_path = snapshot_filename(prefix, it, is_state=True, h5=h5)
+    if not write_main:
+        _write_state_sidecar(net, opt_state, state_path, solver_type,
+                             force_shards)
+        return model_path, state_path
+    # collect state FIRST: the h5-vs-sharded incompatibility must fail
+    # before any file is written (a model file with no state would
+    # confuse supervisor snapshot discovery)
+    # (reference Caffe doubles the history list only for solvers with a
+    # second accumulator; keeping SGD states at exactly n_params blobs
+    # preserves .solverstate interop — see _state_blob_seq)
+    protos, shard_slabs = _collect_state(net, opt_state, solver_type,
+                                         force_shards)
+    if shard_slabs and h5:
+        raise ValueError(
+            "sharded optimizer state needs the BINARYPROTO "
+            "snapshot_format (the .h5 container has no shape-only "
+            "marker)")
     if h5:
         if remote:
             # h5py needs a real file: write locally, upload
@@ -197,17 +417,9 @@ def snapshot(net: Net, params: Params, opt_state: OptState, prefix: str,
         save_caffemodel(model_path, net, params)
 
     st = SolverState(iter=it, learned_net=fsutils.basename(model_path))
-    # reference Caffe doubles the history list only for solvers with a
-    # second accumulator (its AdaDelta/Adam do the same) — keeping SGD
-    # states at exactly n_params blobs preserves .solverstate interop
-    hists = ((opt_state.history, opt_state.history2)
-             if solver_type.upper() in ("ADAM", "ADADELTA")
-             else (opt_state.history,))
-    for hist in hists:
-        for lname, specs in net.param_layout.items():
-            for bname, _, _ in specs:
-                st.history.append(_to_blobproto(np.asarray(
-                    jax.device_get(hist[lname][bname]))))
+    st.history.extend(protos)
+    if shard_slabs:
+        _write_slabs(shard_slabs, state_path)
     if h5:
         import h5py
 
@@ -320,20 +532,25 @@ class AsyncSnapshotter:
 
     def submit(self, net: Net, params: Params, opt_state: OptState,
                prefix: str, *, fmt: int = SnapshotFormat.BINARYPROTO,
-               solver_type: str = "SGD"):
+               solver_type: str = "SGD", write_main: bool = True):
         import threading
         self.check()
         if self._last_done is not None:
             self._last_done.wait()   # one write in flight, one host copy
             self.check()
-        # whole-pytree device_get: one batched transfer, np leaves
+        # whole-pytree host copy: one batched transfer, np leaves.
+        # State goes through host_state_blob so ZeRO-sharded blobs
+        # materialize THIS process's shards now — the train loop
+        # donates these buffers on its next step, so the async writer
+        # must never touch the live arrays
         host_params = jax.device_get(params)
-        host_state = jax.device_get(opt_state)
+        host_state = jax.tree_util.tree_map(host_state_blob, opt_state)
         done = threading.Event()
         self._ensure_thread()
         self._q.put((lambda: snapshot(net, host_params, host_state,
                                       prefix, fmt=fmt,
-                                      solver_type=solver_type), done))
+                                      solver_type=solver_type,
+                                      write_main=write_main), done))
         self._last_done = done
         return done
 
@@ -373,7 +590,17 @@ def restore(net: Net, params: Params, opt_state: OptState,
         st = SolverState.from_binary(fsutils.read_bytes(state_path))
         it = int(st.iter)
         learned = st.learned_net
-        hist = [_from_blobproto(bp) for bp in st.history]
+        # shape-only markers = sharded state: reassemble each marked
+        # blob from the per-process sidecars on the shared FS
+        marked = {i for i, bp in enumerate(st.history)
+                  if bp.shape.dim and not len(bp.data)
+                  and not len(bp.double_data)}
+        slabs = _load_state_shards(state_path) if marked else {}
+        hist = [
+            _assemble_blob(i, tuple(int(d) for d in bp.shape.dim),
+                           slabs)
+            if i in marked else _from_blobproto(bp)
+            for i, bp in enumerate(st.history)]
 
     if weights_path is None and learned:
         cand = fsutils.join(fsutils.dirname(state_path),

@@ -290,21 +290,49 @@ class MiniCluster:
                                  "records_per_sec": round(
                                      timer.records_per_sec, 1),
                                  "ts": _time.time()}) + "\n")
-                if ((snap_every and it % snap_every == 0)
-                        or self._want_snapshot) and self._is_rank0:
+                if (snap_every and it % snap_every == 0) \
+                        or self._want_snapshot:
+                    signalled = self._want_snapshot
                     self._want_snapshot = False
-                    m, s = checkpoint.snapshot(
-                        solver.train_net, params, st, self.prefix,
-                        fmt=self.sp.snapshot_format,
-                        solver_type=solver.solver_type)
-                    print(f"snapshot → {m}")
+                    # ZeRO multi-host: every rank writes its own state
+                    # shard sidecar (checkpoint.py sharded-state notes);
+                    # rank 0 also writes the model + solverstate.  The
+                    # snap_every path hits the same `it` on every rank
+                    # (lockstep), so the sidecar set is consistent; a
+                    # SIGNAL-triggered snapshot is only consistent if
+                    # the operator signalled ALL ranks in the same
+                    # iteration window — restore fails loudly on a
+                    # partial sidecar set either way.
+                    sharded = checkpoint.state_is_sharded(st)
+                    if signalled and sharded:
+                        print("WARNING: signal-triggered snapshot with "
+                              "sharded (ZeRO) state — deliver the "
+                              "signal to every rank promptly or the "
+                              "sidecar set will be incomplete",
+                              file=sys.stderr)
+                    if self._is_rank0 or sharded:
+                        m, s = checkpoint.snapshot(
+                            solver.train_net, params, st, self.prefix,
+                            fmt=self.sp.snapshot_format,
+                            solver_type=solver.solver_type,
+                            write_main=self._is_rank0)
+                        if self._is_rank0:
+                            print(f"snapshot → {m}")
         if self._is_rank0:
             print(timer.summary())
 
         model_path = self.args.model or checkpoint.snapshot_filename(
             self.prefix, it, is_state=False,
             h5=self.sp.snapshot_format == 0)
-        if self._is_rank0:  # snapshots are rank-0-only (SURVEY §5.4)
+        if self._stop and not self._is_rank0 \
+                and checkpoint.state_is_sharded(st):
+            # interrupted with ZeRO state: this rank's sidecar is part
+            # of the resumable snapshot
+            checkpoint.snapshot(solver.train_net, params, st,
+                                self.prefix, fmt=self.sp.snapshot_format,
+                                solver_type=solver.solver_type,
+                                write_main=False)
+        if self._is_rank0:  # main files are rank-0-only (SURVEY §5.4)
             if self._stop:
                 # interrupted: write model + state so -snapshot resumes
                 m, s = checkpoint.snapshot(solver.train_net, params, st,

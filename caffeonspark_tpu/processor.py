@@ -298,13 +298,19 @@ class CaffeProcessor:
                         and it % test_interval == 0 \
                         and eval_step is not None and test_iter:
                     self._run_validation(eval_step, params, test_iter)
-                if snap and it % snap == 0 and self.rank == 0:
+                if snap and it % snap == 0 \
+                        and (self.rank == 0
+                             or checkpoint.state_is_sharded(st)):
+                    # non-rank0 participates only to write its ZeRO
+                    # state-shard sidecar (checkpoint.py sharded notes)
                     self.params, self.opt_state = params, st
                     self._snapshot()
                 if it >= max_iter:
                     break
             self.params, self.opt_state = params, st
-            if self.rank == 0 and sp.snapshot_after_train:
+            if sp.snapshot_after_train \
+                    and (self.rank == 0
+                         or checkpoint.state_is_sharded(st)):
                 self._snapshot(final=True)
         except BaseException as e:     # surfaced on stop()/join()
             self._error = e
@@ -353,19 +359,22 @@ class CaffeProcessor:
                               conf.solverParameter.snapshot_prefix
                               or "model")
         fmt = conf.solverParameter.snapshot_format
+        write_main = self.rank == 0
         if getattr(conf, "asyncSnapshot", False):
             if self._snapshotter is None:
                 self._snapshotter = checkpoint.AsyncSnapshotter()
             self._snapshotter.submit(
                 self.solver.train_net, self.params, self.opt_state,
-                prefix, fmt=fmt, solver_type=self.solver.solver_type)
+                prefix, fmt=fmt, solver_type=self.solver.solver_type,
+                write_main=write_main)
             if final:
                 self._snapshotter.wait()
         else:
             checkpoint.snapshot(
                 self.solver.train_net, self.params, self.opt_state,
-                prefix, fmt=fmt, solver_type=self.solver.solver_type)
-        if final and conf.modelPath:
+                prefix, fmt=fmt, solver_type=self.solver.solver_type,
+                write_main=write_main)
+        if final and conf.modelPath and self.rank == 0:
             checkpoint.save_caffemodel(conf.modelPath,
                                        self.solver.train_net,
                                        self.params)
