@@ -240,6 +240,52 @@ def test_sharded_state_snapshot_roundtrip(tmp_path):
         checkpoint.restore(s2.train_net, p3, st3, spath, weights_path=m)
 
 
+def test_sharded_snapshot_elastic_reshard_resume(tmp_path):
+    """Elastic resume: a ZeRO snapshot taken on one mesh size restores
+    onto a DIFFERENT mesh size (dp8 → dp4) — restore() reassembles the
+    full state from the sidecars and ParallelSolver re-shards it for
+    the new mesh; the resumed trajectory matches the original run
+    continued on its own mesh."""
+    from caffeonspark_tpu.parallel import ParallelSolver, build_mesh
+
+    s = Solver(SolverParameter.from_text(SOLVER),
+               NetParameter.from_text(BIG_NET))
+    ps8 = ParallelSolver(s, build_mesh(dp=8), zero_dp=True)
+    params, st = ps8.init()
+    step8 = ps8.train_step()
+    gen = batches(64, 16, seed=2, scale=1 / 256.0, height=16, width=16)
+    for i in range(3):
+        d, l = next(gen)
+        batch = {"data": jnp.asarray(d), "label": jnp.asarray(l)}
+        params, st, _ = step8(params, st, ps8.shard_batch(batch),
+                              s.step_rng(i))
+    prefix = str(tmp_path / "el")
+    m, spath = checkpoint.snapshot(s.train_net, params, st, prefix,
+                                   solver_type=s.solver_type,
+                                   force_shards=True)
+
+    d, l = next(gen)
+    nxt = {"data": jnp.asarray(d), "label": jnp.asarray(l)}
+    _, _, out8 = step8(params, st, ps8.shard_batch(nxt), s.step_rng(3))
+
+    # resume on HALF the data-parallel width
+    s4 = Solver(SolverParameter.from_text(SOLVER),
+                NetParameter.from_text(BIG_NET))
+    ps4 = ParallelSolver(s4, build_mesh(dp=4, devices=jax.devices()[:4]),
+                         zero_dp=True)
+    p4, st4 = s4.init()
+    p4, st4 = checkpoint.restore(s4.train_net, p4, st4, spath,
+                                 weights_path=m)
+    p4 = ps4.shard_params(p4)
+    st4 = ps4.shard_opt_state(st4)
+    assert tuple(st4.history["fc_big"]["weight"].sharding.spec)[0] \
+        == "dp"
+    _, _, out4 = ps4.train_step()(p4, st4, ps4.shard_batch(nxt),
+                                  s4.step_rng(3))
+    assert float(out8["loss"]) == pytest.approx(float(out4["loss"]),
+                                                rel=2e-4)
+
+
 def test_sharded_state_write_main_false_writes_only_sidecar(tmp_path):
     """The non-rank-0 multi-host call: write_main=False leaves no
     model/solverstate (rank 0 owns those), only this process's shard
