@@ -55,6 +55,22 @@ def _from_blobproto(bp: BlobProto) -> np.ndarray:
 # .caffemodel (binaryproto) export / import
 # ---------------------------------------------------------------------------
 
+def _dense_host_param(arr, lname: str, bname: str) -> np.ndarray:
+    """Host copy of a model param for dense export — fails with the
+    actionable story (not an opaque jax transfer error) when the param
+    is partitioned across hosts.  The ONE device_get boundary for
+    model blobs: binaryproto, HDF5, and the async submit path all
+    route through it."""
+    if isinstance(arr, jax.Array) and _needs_shards(arr):
+        raise ValueError(
+            f"layer {lname!r} blob {bname!r} is partitioned across "
+            "hosts (multi-host tp/ep) — a dense .caffemodel cannot be "
+            "written from one rank; gather params first (jit identity "
+            "with replicated out_shardings, run on EVERY rank) before "
+            "snapshotting")
+    return np.asarray(jax.device_get(arr))
+
+
 def params_to_net_param(net: Net, params: Params) -> NetParameter:
     """Learned params → NetParameter carrying blobs (caffemodel body)."""
     out = NetParameter(name=net.name)
@@ -64,7 +80,7 @@ def params_to_net_param(net: Net, params: Params) -> NetParameter:
             blobs = params[lp.name]
             for bname, _, _ in net.param_layout[lp.name]:
                 copy.blobs.append(_to_blobproto(
-                    np.asarray(jax.device_get(blobs[bname]))))
+                    _dense_host_param(blobs[bname], lp.name, bname)))
         out.layer.append(copy)
     return out
 
@@ -144,7 +160,8 @@ def _save_h5_blobs(path: str, net: Net, params: Params) -> None:
             g = data.create_group(lname)
             for i, (bname, _, _) in enumerate(specs):
                 g.create_dataset(str(i), data=np.asarray(
-                    jax.device_get(params[lname][bname]), np.float32))
+                    _dense_host_param(params[lname][bname], lname,
+                                      bname), np.float32))
 
 
 def _load_h5_blobs(path: str) -> Dict[str, list]:
@@ -542,7 +559,13 @@ class AsyncSnapshotter:
         # State goes through host_state_blob so ZeRO-sharded blobs
         # materialize THIS process's shards now — the train loop
         # donates these buffers on its next step, so the async writer
-        # must never touch the live arrays
+        # must never touch the live arrays.  Partitioned PARAMS fail
+        # the actionable way up front (not in the worker thread where
+        # the error would only surface on the next submit)
+        for ln, bl in params.items():
+            for bn, arr in bl.items():
+                if isinstance(arr, jax.Array) and _needs_shards(arr):
+                    _dense_host_param(arr, ln, bn)  # raises
         host_params = jax.device_get(params)
         host_state = jax.tree_util.tree_map(host_state_blob, opt_state)
         done = threading.Event()

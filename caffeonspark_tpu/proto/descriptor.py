@@ -360,6 +360,19 @@ class Message(metaclass=_MessageMeta):
         return msg
 
     def merge_binary(self, data: bytes) -> "Message":
+        # malformed wire data must surface as ValueError (the codec's
+        # documented failure mode) — never a leaked struct.error from a
+        # fixed32/fixed64 read off a truncated buffer, an IndexError
+        # from a varint cut mid-byte, or an OverflowError from an
+        # absurd corrupted length
+        try:
+            return self._merge_binary_impl(data)
+        except (struct.error, IndexError, OverflowError) as e:
+            raise ValueError(
+                f"malformed protobuf wire data: "
+                f"{type(e).__name__}: {e}") from e
+
+    def _merge_binary_impl(self, data: bytes) -> "Message":
         view = memoryview(data)
         pos = 0
         n = len(view)
