@@ -62,7 +62,30 @@ class LmdbReader:
             path = os.path.join(path, "data.mdb")
         self.path = path
         self._f = open(path, "rb")
-        self._map = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        try:
+            self._map = mmap.mmap(self._f.fileno(), 0,
+                                  access=mmap.ACCESS_READ)
+        except ValueError as e:           # empty file
+            self._f.close()
+            raise ValueError(f"{path}: not an LMDB data file: {e}") \
+                from e
+        try:
+            self._read_meta(path)
+        except (struct.error, IndexError, OverflowError) as e:
+            self.close()
+            raise self._corrupt(e) from e
+        except BaseException:     # bad magic etc. — no fd/mmap leak
+            self.close()
+            raise
+
+    def _corrupt(self, e: BaseException) -> ValueError:
+        """Malformed files surface as ValueError — the readers' one
+        documented failure mode (mirrors proto.descriptor); a corrupt
+        byte must never leak struct.error or recurse forever."""
+        return ValueError(f"{self.path}: corrupt LMDB file: "
+                          f"{type(e).__name__}: {e}")
+
+    def _read_meta(self, path: str) -> None:
         m = self._map
         metas = []
         for pg in (0, 1):
@@ -155,9 +178,20 @@ class LmdbReader:
         root = int(self.main["root"])
         if root == 2 ** 64 - 1:  # P_INVALID: empty db
             return
-        yield from self._walk(root, start_key, stop_key)
+        try:
+            yield from self._walk(root, start_key, stop_key, set())
+        except (struct.error, IndexError, OverflowError,
+                RecursionError) as e:
+            raise self._corrupt(e) from e
 
-    def _walk(self, pgno, start_key, stop_key):
+    def _walk(self, pgno, start_key, stop_key, seen):
+        if pgno in seen:
+            # a corrupted child pointer forming a page cycle would
+            # otherwise recurse/loop forever
+            raise ValueError(
+                f"{self.path}: corrupt LMDB file: page cycle at "
+                f"pgno {pgno}")
+        seen.add(pgno)
         base, flags, lower, upper = self._page(pgno)
         n = self._num_keys(lower)
         if flags & P_LEAF:
@@ -180,7 +214,7 @@ class LmdbReader:
                     this_key, _ = self._branch_child(base, i)
                     if this_key and this_key >= stop_key:
                         return
-                yield from self._walk(child, start_key, stop_key)
+                yield from self._walk(child, start_key, stop_key, seen)
         else:
             raise ValueError(f"unexpected page flags {flags:#x}")
 

@@ -169,8 +169,26 @@ class SequenceFileWriter:
         self.close()
 
 
+# exception classes a corrupt byte can surface as from the wire/codec
+# internals — converted to ValueError at the reader boundaries.  The
+# RECORD wrapper additionally catches OSError/EOFError (gzip's
+# BadGzipFile and bz2 raise OSError subclasses; EOFError on truncated
+# streams); the HEADER wrapper must NOT — it would relabel a genuine
+# FileNotFoundError as corruption.
+_WIRE_ERRORS = (struct.error, IndexError, OverflowError, zlib.error)
+_DECOMPRESS_ERRORS = _WIRE_ERRORS + (OSError, EOFError)
+
+
 class SequenceFileReader:
     def __init__(self, path: str):
+        try:
+            self._init(path)
+        except _WIRE_ERRORS as e:
+            raise ValueError(
+                f"{path}: corrupt SequenceFile header: "
+                f"{type(e).__name__}: {e}") from e
+
+    def _init(self, path: str):
         self.path = path
         with open(path, "rb") as f:
             self._buf = f.read()
@@ -200,9 +218,22 @@ class SequenceFileReader:
         self._data_start = pos + 16
 
     def records(self) -> Iterator[Tuple[str, bytes]]:
-        if self.compression == "block":
-            yield from self._block_records()
-            return
+        # malformed/truncated files surface as ValueError (the data
+        # readers' one documented failure mode — matches LmdbReader and
+        # proto.descriptor); a struct.error leak or a silently-dropped
+        # truncated tail record would otherwise shorten epochs without
+        # a trace
+        try:
+            if self.compression == "block":
+                yield from self._block_records()
+            else:
+                yield from self._plain_records()
+        except _DECOMPRESS_ERRORS as e:
+            raise ValueError(
+                f"{self.path}: corrupt SequenceFile: "
+                f"{type(e).__name__}: {e}") from e
+
+    def _plain_records(self) -> Iterator[Tuple[str, bytes]]:
         buf = self._buf
         pos = self._data_start
         n = len(buf)
@@ -217,13 +248,24 @@ class SequenceFileReader:
             (key_len,) = struct.unpack_from(">i", buf, pos)
             pos += 4
             kend = pos + key_len
+            if rec_len < key_len or key_len < 0 \
+                    or pos + (rec_len - key_len) + key_len > n:
+                raise ValueError(
+                    f"{self.path}: truncated record at offset "
+                    f"{pos - 8} (rec_len {rec_len}, key_len {key_len}, "
+                    f"{n - pos} bytes left)")
             _, kpos = read_vint(buf, pos)
-            key = buf[kpos:kend].decode("utf-8")
+            key = buf[kpos:kend].decode("utf-8")   # UnicodeDecodeError
+            #                       IS a ValueError — strict by design
             vsec = buf[kend:kend + (rec_len - key_len)]
             pos = kend + (rec_len - key_len)  # value section incl. length
             if self.compression == "record":
                 vsec = self._decompress(bytes(vsec))
             (vlen,) = struct.unpack_from(">i", vsec, 0)
+            if not 0 <= vlen <= len(vsec) - 4:
+                raise ValueError(
+                    f"{self.path}: corrupt BytesWritable length "
+                    f"{vlen} (section {len(vsec) - 4} bytes)")
             yield key, bytes(vsec[4:4 + vlen])
 
     def _block_records(self) -> Iterator[Tuple[str, bytes]]:
@@ -254,6 +296,10 @@ class SequenceFileReader:
                 voff += vlen
                 _, kdata = read_vint(kser, 0)
                 (vraw,) = struct.unpack_from(">i", vser, 0)
+                if not 0 <= vraw <= len(vser) - 4:
+                    raise ValueError(
+                        f"{self.path}: corrupt BytesWritable length "
+                        f"{vraw} (section {len(vser) - 4} bytes)")
                 yield (kser[kdata:].decode("utf-8"),
                        bytes(vser[4:4 + vraw]))
 
