@@ -549,7 +549,8 @@ class AsyncSnapshotter:
 
     def submit(self, net: Net, params: Params, opt_state: OptState,
                prefix: str, *, fmt: int = SnapshotFormat.BINARYPROTO,
-               solver_type: str = "SGD", write_main: bool = True):
+               solver_type: str = "SGD", write_main: bool = True,
+               force_shards: bool = False):
         import threading
         self.check()
         if self._last_done is not None:
@@ -567,13 +568,17 @@ class AsyncSnapshotter:
                 if isinstance(arr, jax.Array) and _needs_shards(arr):
                     _dense_host_param(arr, ln, bn)  # raises
         host_params = jax.device_get(params)
-        host_state = jax.tree_util.tree_map(host_state_blob, opt_state)
+        host_state = jax.tree_util.tree_map(
+            lambda x: host_state_blob(x, force_shards=force_shards)
+            if isinstance(x, jax.Array) and x.ndim > 0 else
+            host_state_blob(x), opt_state)
         done = threading.Event()
         self._ensure_thread()
         self._q.put((lambda: snapshot(net, host_params, host_state,
                                       prefix, fmt=fmt,
                                       solver_type=solver_type,
-                                      write_main=write_main), done))
+                                      write_main=write_main,
+                                      force_shards=force_shards), done))
         self._last_done = done
         return done
 
