@@ -236,14 +236,24 @@ def test_mixed_precision_master_weights():
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
 
 
-def test_remat_matches_no_remat():
-    """jax.checkpoint rematerialization must not change numerics."""
+@pytest.mark.parametrize("mode", [True, "mxu"])
+def test_remat_matches_no_remat(mode, monkeypatch):
+    """jax.checkpoint rematerialization must not change numerics —
+    both full per-layer remat (COS_REMAT=1) and the save-MXU-results
+    policy (COS_REMAT=mxu: matmul/conv outputs kept, elementwise
+    recomputed)."""
     npm = NetParameter.from_text(SMALL_NET)
     sp = SolverParameter.from_text(SOLVER_TXT)
     a = Solver(sp, npm)
     pa, sta = a.init()
-    b = Solver(sp, npm)
-    b.train_net.remat = True
+    if mode == "mxu":
+        monkeypatch.setenv("COS_REMAT", "mxu")
+        b = Solver(sp, npm)
+        assert b.train_net.remat == "mxu"
+        assert b.train_net.remat_policy is not None
+    else:
+        b = Solver(sp, npm)
+        b.train_net.remat = True
     pb, stb = b.init()
     data, label = next(batches(64, 32, seed=9, scale=1 / 256.0))
     inp = {"data": jnp.asarray(data), "label": jnp.asarray(label)}
