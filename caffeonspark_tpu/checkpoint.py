@@ -20,6 +20,7 @@ Reference behavior reproduced (SURVEY §5.4):
 
 from __future__ import annotations
 
+import functools
 import os
 from typing import Dict, Optional, Tuple
 
@@ -54,6 +55,44 @@ def _from_blobproto(bp: BlobProto) -> np.ndarray:
 # ---------------------------------------------------------------------------
 # .caffemodel (binaryproto) export / import
 # ---------------------------------------------------------------------------
+
+def params_partitioned(params: Params) -> bool:
+    """True when any param is partitioned across processes (multi-host
+    tp/ep) — collective-free predicate."""
+    return any(isinstance(a, jax.Array) and _needs_shards(a)
+               for bl in params.values() for a in bl.values())
+
+
+@functools.lru_cache(maxsize=16)
+def _replicate_fn(rep_sharding):
+    """One compiled identity-with-replicated-output per sharding —
+    a fresh jax.jit(lambda) per call would recompile at every
+    snapshot boundary for every partitioned param."""
+    return jax.jit(lambda a: a, out_shardings=rep_sharding)
+
+
+def gather_params_if_sharded(params: Params) -> Params:
+    """Replicate cross-host-partitioned params (multi-host tp/ep) so a
+    dense .caffemodel can be written.  The gather is a COLLECTIVE —
+    call it on EVERY rank at the same point (iteration-lockstep
+    snapshot boundaries only; a signal-triggered snapshot must NOT
+    call this, the signal may have reached one rank only — callers
+    check params_partitioned() and skip with a warning instead).
+    Fully addressable / replicated params pass through untouched, so
+    this is a no-op on single-host meshes."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def maybe_gather(arr):
+        if isinstance(arr, jax.Array) and _needs_shards(arr):
+            sh = arr.sharding
+            if isinstance(sh, NamedSharding):
+                rep = NamedSharding(sh.mesh, PartitionSpec())
+                return _replicate_fn(rep)(arr)
+        return arr
+
+    return {ln: {bn: maybe_gather(a) for bn, a in bl.items()}
+            for ln, bl in params.items()}
+
 
 def _dense_host_param(arr, lname: str, bname: str) -> np.ndarray:
     """Host copy of a model param for dense export — fails with the

@@ -120,9 +120,6 @@ class MiniCluster:
         dtype = (jnp.bfloat16 if args.dtype == "bfloat16"
                  else jnp.float32)
         compute = jnp.bfloat16 if args.dtype == "mixed" else None
-        self.solver = Solver(self.sp, self.net_param,
-                             rank=args.rank or 0, dtype=dtype,
-                             compute_dtype=compute)
         spec = getattr(args, "mesh", None) or args.devices
         if spec:
             from .processor import _parse_mesh_spec
@@ -138,6 +135,15 @@ class MiniCluster:
         else:
             mesh = build_mesh()
         self.mesh = mesh
+        # the solver's rng rank follows the mesh's DP coordinate, not
+        # the process rank: tp/sp ranks share replicated activations,
+        # so their dropout masks / augmentation streams must be
+        # identical, while dp ranks decorrelate (CaffeNet.cpp:614-618
+        # seed = seed + device semantics, mesh-aware)
+        from .parallel import dp_data_rank
+        self.solver = Solver(self.sp, self.net_param,
+                             rank=dp_data_rank(mesh)[0], dtype=dtype,
+                             compute_dtype=compute)
         self.psolver = ParallelSolver(self.solver, mesh)
         self.args = args
         self._is_rank0 = (args.rank or 0) == 0
@@ -196,9 +202,14 @@ class MiniCluster:
         data_layers = solver.train_net.data_layers
         if not data_layers:
             raise ValueError("train net has no data layer")
+        # data sharding follows the mesh's dp axis, not the process
+        # rank: on a tp/sp-only multi-host mesh every process feeds
+        # the SAME records (parallel.mesh.dp_data_rank) — process-rank
+        # sharding would hand each model shard different data
+        from .parallel import dp_data_rank
+        data_rank, data_ranks = dp_data_rank(self.mesh)
         src = get_source(data_layers[0], phase_train=True,
-                         rank=self.args.rank or 0,
-                         num_ranks=self.args.cluster or 1,
+                         rank=data_rank, num_ranks=data_ranks,
                          seed=int(self.sp.random_seed)
                          if self.sp.random_seed >= 0 else 0)
         step = ps.train_step()
@@ -310,9 +321,30 @@ class MiniCluster:
                               "signal to every rank promptly or the "
                               "sidecar set will be incomplete",
                               file=sys.stderr)
+                    lockstep = bool(snap_every
+                                    and it % snap_every == 0)
+                    if not lockstep \
+                            and checkpoint.params_partitioned(params):
+                        # signal-only snapshot with cross-host tp/ep
+                        # params: the dense-export gather is a
+                        # COLLECTIVE — running it on just the
+                        # signalled rank would deadlock the cluster.
+                        # Skip; the next interval boundary snapshots
+                        # in lockstep.
+                        print("WARNING: signal-triggered snapshot "
+                              "skipped: params are partitioned across "
+                              "hosts and an unsynchronized gather "
+                              "would hang — wait for the next "
+                              "snapshot interval", file=sys.stderr)
+                        continue
+                    # multi-host tp/ep params: COLLECTIVE gather on
+                    # every rank (lockstep boundary) so rank 0 can
+                    # write the dense model; no-op otherwise
+                    export_p = checkpoint.gather_params_if_sharded(
+                        params)
                     if self._is_rank0 or sharded:
                         m, s = checkpoint.snapshot(
-                            solver.train_net, params, st, self.prefix,
+                            solver.train_net, export_p, st, self.prefix,
                             fmt=self.sp.snapshot_format,
                             solver_type=solver.solver_type,
                             write_main=self._is_rank0)
@@ -324,28 +356,38 @@ class MiniCluster:
         model_path = self.args.model or checkpoint.snapshot_filename(
             self.prefix, it, is_state=False,
             h5=self.sp.snapshot_format == 0)
+        # every rank reaches this point AT THE SAME it after a full run
+        # (max_iter is lockstep), so the multi-host tp/ep param gather
+        # (collective, no-op otherwise) is safe — EXCEPT on a signal
+        # stop, where ranks may exit at different iterations and an
+        # unsynchronized collective would hang; export the params
+        # as-is there (the dense write then fails with the actionable
+        # gather-params-first error instead of deadlocking)
+        export_p = (params if self._stop
+                    and checkpoint.params_partitioned(params)
+                    else checkpoint.gather_params_if_sharded(params))
         if self._stop and not self._is_rank0 \
                 and checkpoint.state_is_sharded(st):
             # interrupted with ZeRO state: this rank's sidecar is part
             # of the resumable snapshot
-            checkpoint.snapshot(solver.train_net, params, st,
+            checkpoint.snapshot(solver.train_net, export_p, st,
                                 self.prefix, fmt=self.sp.snapshot_format,
                                 solver_type=solver.solver_type,
                                 write_main=False)
         if self._is_rank0:  # main files are rank-0-only (SURVEY §5.4)
             if self._stop:
                 # interrupted: write model + state so -snapshot resumes
-                m, s = checkpoint.snapshot(solver.train_net, params, st,
-                                           self.prefix,
+                m, s = checkpoint.snapshot(solver.train_net, export_p,
+                                           st, self.prefix,
                                            fmt=self.sp.snapshot_format,
                                            solver_type=solver.solver_type)
                 print(f"stopped at iter {it}; resume with -snapshot {s}")
             if model_path.endswith(".h5"):
                 from .checkpoint import _save_h5_blobs
-                _save_h5_blobs(model_path, solver.train_net, params)
+                _save_h5_blobs(model_path, solver.train_net, export_p)
             else:
                 checkpoint.save_caffemodel(model_path, solver.train_net,
-                                           params)
+                                           export_p)
             print(f"final model → {model_path}")
         self.final_params = params
         # only rank 0 wrote the file; other ranks must not hand out a

@@ -9,6 +9,6 @@ parallelism via ring attention (`sp.ring_attention`).
 
 from .dp import ParallelSolver, tp_param_specs
 from .mesh import (build_mesh, data_sharding, distributed_init,
-                   lockstep_steps, replicated)
+                   dp_data_rank, lockstep_steps, replicated)
 from .pp import PipelineSolver, partition_layers
 from .sp import attention, ring_attention, sp_shard_time

@@ -74,6 +74,37 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def dp_data_rank(mesh: Mesh) -> tuple:
+    """(data_rank, data_num_ranks) for THIS process: which shard of
+    the record stream it must feed.
+
+    Derived from the mesh coordinates of the local devices, NOT the
+    process rank — on a tp/sp-only mesh every process sits at dp
+    index 0 and must feed IDENTICAL records (its model shard consumes
+    the same replicated batch), while the process-rank sharding the
+    cluster flags imply would feed each rank different data and
+    silently train on inconsistent replicas.  Single-process meshes
+    feed the whole stream (device_prefetch shards locally)."""
+    if jax.process_count() <= 1:
+        return 0, 1
+    dp_total = mesh.shape.get("dp", 1)
+    if dp_total <= 1:
+        return 0, 1
+    axes = list(mesh.axis_names)
+    dp_axis = axes.index("dp")
+    local_ids = {d.id for d in jax.local_devices()}
+    rows = sorted({idx[dp_axis]
+                   for idx in np.ndindex(mesh.devices.shape)
+                   if mesh.devices[idx].id in local_ids})
+    k = len(rows)
+    if (k and rows == list(range(rows[0], rows[0] + k))
+            and dp_total % k == 0 and rows[0] % k == 0):
+        return rows[0] // k, dp_total // k
+    # non-contiguous local dp rows (exotic device order): feed the
+    # whole stream rather than misalign the local shard
+    return 0, 1
+
+
 def lockstep_steps(total_records: int, batch_per_step: int,
                    num_ranks: int) -> int:
     """The minPartSize equalization invariant

@@ -92,8 +92,6 @@ class CaffeProcessor:
         from .data.source import get_source
         self.conf = conf
         self.rank = rank
-        self.solver = Solver(conf.solverParameter, conf.netParam,
-                             rank=rank)
         import jax
         devices = (jax.local_devices()[:conf.devices]
                    if conf.devices > 0
@@ -103,6 +101,20 @@ class CaffeProcessor:
                               **_parse_mesh_spec(conf.mesh))
         else:
             mesh = build_mesh(devices=devices)
+        # data sharding + rng seeding follow the mesh's DP coordinate
+        # when processes form a jax.distributed cluster: tp/sp ranks
+        # share replicated activations, so their augmentation/dropout
+        # streams must match and every rank must feed the SAME records
+        # (mini_cluster has the identical rule).  Outside a cluster
+        # (Spark local engine, tests) the conf rank/clusterSize
+        # semantics stand.
+        if jax.process_count() > 1:
+            from .parallel import dp_data_rank
+            data_rank, data_ranks = dp_data_rank(mesh)
+        else:
+            data_rank, data_ranks = rank, max(1, conf.clusterSize)
+        self.solver = Solver(conf.solverParameter, conf.netParam,
+                             rank=data_rank)
         self.psolver = ParallelSolver(self.solver, mesh)
         self.queues = [FeedQueue(), FeedQueue()]   # 0 train, 1 validation
         self.results: List[Dict[str, Any]] = []
@@ -122,8 +134,7 @@ class CaffeProcessor:
 
         seed = int(conf.solverParameter.random_seed) \
             if conf.solverParameter.random_seed >= 0 else 0
-        self._source_kw = dict(rank=rank,
-                               num_ranks=max(1, conf.clusterSize),
+        self._source_kw = dict(rank=data_rank, num_ranks=data_ranks,
                                seed=seed, resize=conf.resize)
         tl = conf.train_data_layer()
         self.train_source: Optional[DataSource] = (
@@ -298,20 +309,25 @@ class CaffeProcessor:
                         and it % test_interval == 0 \
                         and eval_step is not None and test_iter:
                     self._run_validation(eval_step, params, test_iter)
-                if snap and it % snap == 0 \
-                        and (self.rank == 0
-                             or checkpoint.state_is_sharded(st)):
-                    # non-rank0 participates only to write its ZeRO
-                    # state-shard sidecar (checkpoint.py sharded notes)
-                    self.params, self.opt_state = params, st
-                    self._snapshot()
+                if snap and it % snap == 0:
+                    # the multi-host tp/ep param gather is a COLLECTIVE
+                    # — every rank runs it at this lockstep boundary
+                    # (no-op otherwise); non-rank0 then participates
+                    # only to write its ZeRO state-shard sidecar
+                    export_p = checkpoint.gather_params_if_sharded(
+                        params)
+                    if self.rank == 0 \
+                            or checkpoint.state_is_sharded(st):
+                        self.params, self.opt_state = params, st
+                        self._snapshot(export_params=export_p)
                 if it >= max_iter:
                     break
             self.params, self.opt_state = params, st
-            if sp.snapshot_after_train \
-                    and (self.rank == 0
-                         or checkpoint.state_is_sharded(st)):
-                self._snapshot(final=True)
+            if sp.snapshot_after_train:
+                export_p = checkpoint.gather_params_if_sharded(params)
+                if self.rank == 0 \
+                        or checkpoint.state_is_sharded(st):
+                    self._snapshot(final=True, export_params=export_p)
         except BaseException as e:     # surfaced on stop()/join()
             self._error = e
         finally:
@@ -352,7 +368,7 @@ class CaffeProcessor:
                 done += 1
         self.validation.finish_round()
 
-    def _snapshot(self, final: bool = False):
+    def _snapshot(self, final: bool = False, export_params=None):
         conf = self.conf
         from .utils import fsutils
         prefix = fsutils.join(conf.outputPath or ".",
@@ -360,24 +376,26 @@ class CaffeProcessor:
                               or "model")
         fmt = conf.solverParameter.snapshot_format
         write_main = self.rank == 0
+        params = (export_params if export_params is not None
+                  else self.params)
         if getattr(conf, "asyncSnapshot", False):
             if self._snapshotter is None:
                 self._snapshotter = checkpoint.AsyncSnapshotter()
             self._snapshotter.submit(
-                self.solver.train_net, self.params, self.opt_state,
+                self.solver.train_net, params, self.opt_state,
                 prefix, fmt=fmt, solver_type=self.solver.solver_type,
                 write_main=write_main)
             if final:
                 self._snapshotter.wait()
         else:
             checkpoint.snapshot(
-                self.solver.train_net, self.params, self.opt_state,
+                self.solver.train_net, params, self.opt_state,
                 prefix, fmt=fmt, solver_type=self.solver.solver_type,
                 write_main=write_main)
         if final and conf.modelPath and self.rank == 0:
             checkpoint.save_caffemodel(conf.modelPath,
                                        self.solver.train_net,
-                                       self.params)
+                                       params)
 
     # -- feature extraction (doFeatures, :473-523) ------------------------
     def extract_features(self, source: DataSource,
