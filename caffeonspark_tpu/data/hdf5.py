@@ -36,6 +36,15 @@ def _file_list(list_path: str) -> List[str]:
     return out
 
 
+# h5py surfaces corruption as a zoo of exception types (OSError,
+# KeyError, RuntimeError, AttributeError on partially-parsed object
+# headers) — converted to the data readers' one documented failure
+# mode (ValueError) at the per-file read boundaries.  A genuine
+# FileNotFoundError is re-raised untouched (a missing file is not a
+# corrupt one — same rule as sequencefile._DECOMPRESS_ERRORS).
+_H5_ERRORS = (OSError, KeyError, RuntimeError, AttributeError)
+
+
 def hdf5_top_shapes(list_path: str, tops: Sequence[str],
                     batch_size: int) -> Dict[str, Tuple[int, ...]]:
     """(batch,) + per-row shape for each top, probed from the first
@@ -43,13 +52,19 @@ def hdf5_top_shapes(list_path: str, tops: Sequence[str],
     import h5py
     first = _file_list(_strip_scheme(list_path))[0]
     shapes: Dict[str, Tuple[int, ...]] = {}
-    with h5py.File(first, "r") as f:
-        for top in tops:
-            if top not in f:
-                raise ValueError(
-                    f"dataset {top!r} missing from {first} "
-                    f"(has: {sorted(f.keys())})")
-            shapes[top] = (batch_size,) + tuple(f[top].shape[1:])
+    try:
+        with h5py.File(first, "r") as f:
+            for top in tops:
+                if top not in f:
+                    raise ValueError(
+                        f"dataset {top!r} missing from {first} "
+                        f"(has: {sorted(f.keys())})")
+                shapes[top] = (batch_size,) + tuple(f[top].shape[1:])
+    except FileNotFoundError:
+        raise
+    except _H5_ERRORS as e:
+        raise ValueError(f"{first}: corrupt/unreadable HDF5 file: "
+                         f"{type(e).__name__}: {e}") from e
     return shapes
 
 
@@ -66,7 +81,6 @@ class HDF5Source(DataSource):
         raise NotImplementedError("HDF5Data has no image dims")
 
     def records(self) -> Iterator[tuple]:
-        import h5py
         tops = list(self.layer.top)
         files = _file_list(self.source_uri())
         # rank sharding: round-robin whole files when possible, else
@@ -77,13 +91,32 @@ class HDF5Source(DataSource):
         else:
             stride, offset = max(1, self.num_ranks), self.rank
         for path in files:
+            yield from self._file_rows(path, tops, offset, stride)
+
+    def _file_rows(self, path, tops, offset, stride):
+        """One file's rows; ONLY the h5py read is wrapped (a missing
+        list file or programming error must not be re-branded as
+        data corruption)."""
+        import h5py
+        try:
             with h5py.File(path, "r") as f:
+                for t in tops:
+                    if t not in f:
+                        raise ValueError(
+                            f"dataset {t!r} missing from {path} "
+                            f"(has: {sorted(f.keys())})")
                 n = f[tops[0]].shape[0]
                 arrays = {t: f[t] for t in tops}
                 for i in range(offset, n, stride):
                     yield (f"{os.path.basename(path)}:{i}",
                            {t: np.asarray(arrays[t][i], np.float32)
                             for t in tops})
+        except FileNotFoundError:
+            raise
+        except _H5_ERRORS as e:
+            raise ValueError(
+                f"{path}: corrupt/unreadable HDF5 data: "
+                f"{type(e).__name__}: {e}") from e
 
     def next_batch(self, records) -> Dict[str, np.ndarray]:
         tops = list(self.layer.top)

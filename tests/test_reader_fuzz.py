@@ -93,6 +93,34 @@ def test_leveldb_reader_survives_corruption(tmp_path):
     assert rejected, "CRC-guarded reader never rejected corruption?"
 
 
+def test_hdf5_reader_survives_corruption(tmp_path):
+    """h5py raises a zoo of exception types on corrupt files (OSError,
+    KeyError, RuntimeError, AttributeError); our HDF5 boundary
+    converts them all to ValueError."""
+    import h5py
+
+    from caffeonspark_tpu.data.hdf5 import hdf5_top_shapes
+
+    with h5py.File(tmp_path / "d.h5", "w") as f:
+        f.create_dataset("data",
+                         data=np.random.rand(16, 1, 8, 8).astype("f"))
+        f.create_dataset("label", data=np.zeros(16, "f"))
+    (tmp_path / "list.txt").write_text(str(tmp_path / "d2.h5") + "\n")
+    wire = (tmp_path / "d.h5").read_bytes()
+    rng = np.random.RandomState(3)
+    rejected = 0
+    for _ in range(100):
+        m = bytearray(wire)
+        m[rng.randint(0, len(m))] = rng.randint(0, 256)
+        (tmp_path / "d2.h5").write_bytes(bytes(m))
+        try:
+            hdf5_top_shapes(str(tmp_path / "list.txt"),
+                            ["data", "label"], 4)
+        except SANCTIONED:
+            rejected += 1
+    assert rejected, "corruption never detected?"
+
+
 @pytest.mark.parametrize("comp", [None, "record", "block"])
 def test_sequencefile_reader_survives_corruption(tmp_path, comp):
     from caffeonspark_tpu.data.sequencefile import (SequenceFileReader,
