@@ -13,6 +13,7 @@ feeds row batches.
 from __future__ import annotations
 
 import os
+from contextlib import contextmanager
 from typing import Dict, Iterator, List, Sequence, Tuple
 
 import numpy as np
@@ -37,12 +38,25 @@ def _file_list(list_path: str) -> List[str]:
 
 
 # h5py surfaces corruption as a zoo of exception types (OSError,
-# KeyError, RuntimeError, AttributeError on partially-parsed object
-# headers) — converted to the data readers' one documented failure
-# mode (ValueError) at the per-file read boundaries.  A genuine
-# FileNotFoundError is re-raised untouched (a missing file is not a
-# corrupt one — same rule as sequencefile._DECOMPRESS_ERRORS).
-_H5_ERRORS = (OSError, KeyError, RuntimeError, AttributeError)
+# KeyError, IndexError on short datasets, RuntimeError, AttributeError
+# on partially-parsed object headers) — converted to the data readers'
+# one documented failure mode (ValueError) at the per-file read
+# boundaries.  A genuine FileNotFoundError is re-raised untouched (a
+# missing file is not a corrupt one — same rule as
+# sequencefile._DECOMPRESS_ERRORS).
+_H5_ERRORS = (OSError, KeyError, IndexError, RuntimeError,
+              AttributeError)
+
+
+@contextmanager
+def _h5_boundary(path: str, what: str):
+    try:
+        yield
+    except FileNotFoundError:
+        raise
+    except _H5_ERRORS as e:
+        raise ValueError(f"{path}: corrupt/unreadable HDF5 {what}: "
+                         f"{type(e).__name__}: {e}") from e
 
 
 def hdf5_top_shapes(list_path: str, tops: Sequence[str],
@@ -52,7 +66,7 @@ def hdf5_top_shapes(list_path: str, tops: Sequence[str],
     import h5py
     first = _file_list(_strip_scheme(list_path))[0]
     shapes: Dict[str, Tuple[int, ...]] = {}
-    try:
+    with _h5_boundary(first, "file"):
         with h5py.File(first, "r") as f:
             for top in tops:
                 if top not in f:
@@ -60,11 +74,6 @@ def hdf5_top_shapes(list_path: str, tops: Sequence[str],
                         f"dataset {top!r} missing from {first} "
                         f"(has: {sorted(f.keys())})")
                 shapes[top] = (batch_size,) + tuple(f[top].shape[1:])
-    except FileNotFoundError:
-        raise
-    except _H5_ERRORS as e:
-        raise ValueError(f"{first}: corrupt/unreadable HDF5 file: "
-                         f"{type(e).__name__}: {e}") from e
     return shapes
 
 
@@ -98,25 +107,27 @@ class HDF5Source(DataSource):
         list file or programming error must not be re-branded as
         data corruption)."""
         import h5py
-        try:
+        with _h5_boundary(path, "data"):
             with h5py.File(path, "r") as f:
                 for t in tops:
                     if t not in f:
                         raise ValueError(
                             f"dataset {t!r} missing from {path} "
                             f"(has: {sorted(f.keys())})")
-                n = f[tops[0]].shape[0]
+                counts = {t: f[t].shape[0] for t in tops}
+                if len(set(counts.values())) > 1:
+                    # hdf5_data_layer.cpp CHECKs equal num() across
+                    # datasets — mismatched rows would otherwise leak
+                    # an IndexError mid-epoch
+                    raise ValueError(
+                        f"{path}: datasets disagree on row count: "
+                        f"{counts}")
+                n = counts[tops[0]]
                 arrays = {t: f[t] for t in tops}
                 for i in range(offset, n, stride):
                     yield (f"{os.path.basename(path)}:{i}",
                            {t: np.asarray(arrays[t][i], np.float32)
                             for t in tops})
-        except FileNotFoundError:
-            raise
-        except _H5_ERRORS as e:
-            raise ValueError(
-                f"{path}: corrupt/unreadable HDF5 data: "
-                f"{type(e).__name__}: {e}") from e
 
     def next_batch(self, records) -> Dict[str, np.ndarray]:
         tops = list(self.layer.top)

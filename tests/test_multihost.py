@@ -254,7 +254,10 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
     a = load_caffemodel_blobs(str(out / "t_iter_8.caffemodel"))
     b = load_caffemodel_blobs(str(tmp_path / "out1" /
                                   "t_iter_8.caffemodel"))
+    assert a and a.keys() == b.keys(), (sorted(a), sorted(b))
+    assert any(len(v) for v in a.values()), "export carried no blobs"
     for k in a:
+        assert len(a[k]) == len(b[k]), k
         for pa, pb in zip(a[k], b[k]):
             np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
                                        rtol=2e-3, atol=2e-5)
@@ -269,3 +272,81 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
         capture_output=True, text=True, timeout=240, env=env)
     assert r2.returncode == 0 and "resumed from iter 4" in r2.stdout, \
         r2.stdout[-800:]
+
+
+def test_two_process_expert_parallel_training(tmp_path):
+    """Expert parallelism across REAL process boundaries: `-mesh
+    1,1,1,2` shards the MoE expert dimension over 2 processes; both
+    feed identical records (dp_data_rank), the expert-sharded params
+    gather for rank 0's dense export, and the final model matches a
+    single-process run."""
+    from caffeonspark_tpu.checkpoint import load_caffemodel_blobs
+    from caffeonspark_tpu.data import LmdbWriter
+    from caffeonspark_tpu.data.synthetic import make_images
+    from caffeonspark_tpu.proto.caffe import Datum
+
+    imgs, labels = make_images(64, seed=12)
+    recs = [(b"%06d" % i,
+             Datum(channels=1, height=28, width=28,
+                   data=(imgs[i, 0] * 255).astype(np.uint8).tobytes(),
+                   label=int(labels[i])).to_binary())
+            for i in range(64)]
+    LmdbWriter(str(tmp_path / "lmdb")).write(recs)
+    net = tmp_path / "net.prototxt"
+    net.write_text(f'''
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "LMDB"
+  memory_data_param {{ source: "{tmp_path}/lmdb" batch_size: 8
+    channels: 1 height: 28 width: 28 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "flat" type: "Flatten" bottom: "data" top: "flat" }}
+layer {{ name: "moe" type: "MixtureOfExperts" bottom: "flat" top: "moe"
+  moe_param {{ num_experts: 4 hidden_dim: 64 }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "moe" top: "ip"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}''')
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(
+        f'net: "{net}"\nbase_lr: 0.05\nmomentum: 0.9\n'
+        'lr_policy: "fixed"\nmax_iter: 8\nsnapshot: 100\n'
+        'snapshot_prefix: "e"\nrandom_seed: 7\n')
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+           "PALLAS_AXON_POOL_IPS": "",
+           "PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    port = _free_port()
+    out = tmp_path / "out"
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
+         "-solver", str(solver), "-train", str(tmp_path / "lmdb"),
+         "-output", str(out), "-server", f"127.0.0.1:{port}",
+         "-cluster", "2", "-rank", str(r), "-mesh", "1,1,1,2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for r in range(2)]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{o[-1500:]}"
+
+    r1 = subprocess.run(
+        [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
+         "-solver", str(solver), "-train", str(tmp_path / "lmdb"),
+         "-output", str(tmp_path / "out1")],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert r1.returncode == 0, r1.stdout[-800:]
+    a = load_caffemodel_blobs(str(out / "e_iter_8.caffemodel"))
+    b = load_caffemodel_blobs(str(tmp_path / "out1" /
+                                  "e_iter_8.caffemodel"))
+    assert a and a.keys() == b.keys(), (sorted(a), sorted(b))
+    assert any(len(v) for v in a.values()), "export carried no blobs"
+    for k in a:
+        assert len(a[k]) == len(b[k]), k
+        for pa, pb in zip(a[k], b[k]):
+            np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                       rtol=2e-3, atol=2e-5)

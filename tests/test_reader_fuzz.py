@@ -101,24 +101,40 @@ def test_hdf5_reader_survives_corruption(tmp_path):
 
     from caffeonspark_tpu.data.hdf5 import hdf5_top_shapes
 
+    from caffeonspark_tpu.data import get_source
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+
     with h5py.File(tmp_path / "d.h5", "w") as f:
         f.create_dataset("data",
                          data=np.random.rand(16, 1, 8, 8).astype("f"))
         f.create_dataset("label", data=np.zeros(16, "f"))
     (tmp_path / "list.txt").write_text(str(tmp_path / "d2.h5") + "\n")
     wire = (tmp_path / "d.h5").read_bytes()
+    lp = LayerParameter.from_text(f'''
+      name: "h" type: "HDF5Data" top: "data" top: "label"
+      hdf5_data_param {{ source: "{tmp_path}/list.txt"
+                         batch_size: 4 }}''')
     rng = np.random.RandomState(3)
     rejected = 0
     for _ in range(100):
         m = bytearray(wire)
         m[rng.randint(0, len(m))] = rng.randint(0, 256)
         (tmp_path / "d2.h5").write_bytes(bytes(m))
-        try:
+        try:  # both boundaries: the shape probe AND the row reader
             hdf5_top_shapes(str(tmp_path / "list.txt"),
                             ["data", "label"], 4)
+            sum(1 for _ in get_source(lp, phase_train=False).records())
         except SANCTIONED:
             rejected += 1
     assert rejected, "corruption never detected?"
+    # mismatched per-top row counts: ValueError, not a mid-epoch
+    # IndexError (hdf5_data_layer.cpp's equal-num CHECK)
+    with h5py.File(tmp_path / "d2.h5", "w") as f:
+        f.create_dataset("data",
+                         data=np.random.rand(16, 1, 8, 8).astype("f"))
+        f.create_dataset("label", data=np.zeros(8, "f"))
+    with pytest.raises(ValueError, match="row count"):
+        sum(1 for _ in get_source(lp, phase_train=False).records())
 
 
 @pytest.mark.parametrize("comp", [None, "record", "block"])
