@@ -67,6 +67,8 @@ onchip-artifacts:
 	  | tee bench_evidence/profile_segments_b256.txt
 	-BENCH_MODEL=resnet50 $(PY) bench.py
 	-BENCH_MODEL=lstm $(PY) bench.py
+	-BENCH_MODEL=vgg16 $(PY) bench.py
+	-BENCH_MODEL=googlenet $(PY) bench.py
 
 docs:
 	$(PY) docs/gen_html.py

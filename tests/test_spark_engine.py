@@ -69,6 +69,14 @@ def _records(n=256, seed=3):
             for i in range(n)]
 
 
+def _wait_solver_done(proc, expect_iter, timeout=60):
+    deadline = time.time() + timeout
+    while proc._thread.is_alive() and time.time() < deadline:
+        time.sleep(0.2)
+    assert not proc._thread.is_alive(), "solver did not finish"
+    assert int(np.asarray(proc.opt_state.iter)) == expect_iter
+
+
 @pytest.fixture()
 def conf(tmp_path):
     imgs, labels = make_images(64, seed=5)
@@ -267,10 +275,7 @@ def test_feed_daemon_cross_process(conf, tmp_path):
         assert r.returncode == 0, r.stderr[-1000:]
         assert int(r.stdout.strip()) >= 8 * 16
 
-        deadline = time.time() + 60
-        while proc._thread.is_alive() and time.time() < deadline:
-            time.sleep(0.2)
-        assert int(np.asarray(proc.opt_state.iter)) == 8
+        _wait_solver_done(proc, 8)
     finally:
         daemon.stop()
         try:
@@ -384,6 +389,44 @@ def test_strict_rank_engine_error(conf, tmp_path, monkeypatch):
         engine.feed_partitions(_FakeRDD([_records(8)]), 0)
 
 
+def test_feed_daemon_survives_garbage_peer(conf, tmp_path):
+    """A buggy/hostile localhost peer sending garbage bytes (bad
+    header, bogus op, malformed pickle) must not take the daemon
+    down: subsequent healthy clients keep working."""
+    import socket as socket_mod
+    import struct
+
+    proc = CaffeProcessor.instance(conf)
+    proc.start()
+    daemon = FeedDaemon(proc, "garbapp", tmpdir=str(tmp_path))
+    try:
+        for garbage in (b"\x00" * 3,                 # short header
+                        b"\xff" * 16,                # absurd length
+                        struct.pack("<BI", 99, 0),   # unknown op, NAK
+                        struct.pack("<BI", 1, 8) + b"notapickl"):
+            s = socket_mod.create_connection(("127.0.0.1",
+                                              daemon.port), timeout=5)
+            s.sendall(garbage)
+            if garbage == struct.pack("<BI", 99, 0):
+                # complete frame with an unknown op: the daemon must
+                # NAK it (the `op != OP_PING` rejection branch)
+                assert s.recv(1) == b"\x00"
+            s.close()
+        # a healthy client still gets served afterwards
+        client = FeedClient.discover("garbapp", tmpdir=str(tmp_path))
+        assert client is not None
+        fed = client.feed(0, _records(200))
+        assert fed >= 8 * 16
+        client.close()
+        _wait_solver_done(proc, 8)
+    finally:
+        daemon.stop()
+        try:
+            proc.stop()
+        except Exception:
+            pass
+
+
 def test_feed_client_rejects_after_stop(conf, tmp_path):
     proc = CaffeProcessor.instance(conf)
     proc.start()
@@ -393,9 +436,7 @@ def test_feed_client_rejects_after_stop(conf, tmp_path):
         client = FeedClient.discover("stopapp", tmpdir=str(tmp_path))
         assert client is not None
         client.feed(0, recs)          # max_iter reached -> queues stop
-        deadline = time.time() + 60
-        while proc._thread.is_alive() and time.time() < deadline:
-            time.sleep(0.2)
+        _wait_solver_done(proc, 8)
         client2 = FeedClient.discover("stopapp", tmpdir=str(tmp_path))
         fed = client2.feed(0, recs)   # stopped queue: rejected
         assert fed < len(recs)
