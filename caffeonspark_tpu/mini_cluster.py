@@ -219,6 +219,54 @@ class MiniCluster:
         max_iter = self.sp.max_iter
         display = self.sp.display or 0
         snap_every = self.sp.snapshot or 0
+        # interleaved validation on the pod path (the driver CLI's
+        # trainWithValidation semantics, here for supervisor-launched
+        # standalone clusters): every test_interval steps run test_iter
+        # eval batches on the SAME replicated validation stream on
+        # every rank (the eval step is a collective on meshes), rank 0
+        # records the per-round output means
+        test_interval = int(self.sp.test_interval or 0)
+        test_iter = int(self.sp.test_iter[0]) if self.sp.test_iter else 0
+        interleave = bool(test_interval and test_iter
+                          and solver.test_net is not None
+                          and solver.test_net.data_layers)
+        if interleave:
+            from .data.transformer import DEVICE_AUX_SUFFIX
+            from .processor import ValidationReport
+            eval_step = ps.eval_step()
+            val_names = list(solver.test_net.output_blobs)
+            val_report = ValidationReport(val_names)
+            val_src = get_source(
+                solver.test_net.data_layers[0], phase_train=False,
+                rank=0, num_ranks=1,   # replicated validation data
+                seed=int(self.sp.random_seed)
+                if self.sp.random_seed >= 0 else 0)
+            # uint8-infeed split for the validation feed too (the
+            # driver CLI's processor does the same)
+            val_src.enable_device_transform(solver.test_net.dtype)
+            val_gen = val_src.batches(loop=True, shuffle=False)
+            vsh = ps.input_shardings(solver.test_net)
+            val_multiproc = jax.process_count() > 1
+
+            def _vsh_for(k):
+                if k.endswith(DEVICE_AUX_SUFFIX):
+                    return vsh[k[:-len(DEVICE_AUX_SUFFIX)]]
+                return vsh[k]
+
+            def _stage_val(b):
+                # multi-process: numpy can't carry a non-trivial
+                # sharding — build the global array from each
+                # process's IDENTICAL local batch.  global_shape MUST
+                # be the local shape: without it jax scales every
+                # process-spanning sharded dim (concatenating the
+                # duplicate copies — and on sp meshes corrupting the
+                # TIME axis); with it the local data IS the full
+                # replicated-batch value
+                if not val_multiproc:
+                    return b
+                return {k: jax.make_array_from_process_local_data(
+                            _vsh_for(k), v, global_shape=v.shape)
+                        for k, v in b.items()}
         it = int(jax.device_get(st.iter))
         from .data.queue_runner import combine_batches
         tmajor = frozenset(
@@ -301,6 +349,25 @@ class MiniCluster:
                                  "records_per_sec": round(
                                      timer.records_per_sec, 1),
                                  "ts": _time.time()}) + "\n")
+                if interleave and it % test_interval == 0:
+                    for _ in range(test_iter):
+                        vb = val_src.apply_device_stage(
+                            _stage_val(next(val_gen)),
+                            None if val_multiproc else vsh)
+                        vout = eval_step(params, vb)
+                        # pre-reduce each output to a REPLICATED scalar
+                        # (jnp.mean all-reduces a dp-sharded blob): a
+                        # per-example top spanning other hosts' devices
+                        # cannot be device_get directly
+                        val_report.add_batch(
+                            {n: jnp.mean(vout[n]) for n in val_names})
+                    val_report.finish_round()
+                    if self._is_rank0:
+                        row = val_report.rounds[-1]
+                        print("validation iter %d: %s" % (
+                            it, " ".join(f"{n}={v:.4f}"
+                                         for n, v in row.items())),
+                            flush=True)
                 if (snap_every and it % snap_every == 0) \
                         or self._want_snapshot:
                     signalled = self._want_snapshot
@@ -352,6 +419,19 @@ class MiniCluster:
                             print(f"snapshot → {m}")
         if self._is_rank0:
             print(timer.summary())
+            if interleave and val_report.rounds:
+                # same artifact the driver CLI writes (validation.json:
+                # one row of per-output means per validation round)
+                import json
+                vpath = os.path.join(self.args.output,
+                                     "validation.json")
+                os.makedirs(self.args.output, exist_ok=True)
+                with open(vpath, "w") as vf:
+                    for row in val_report.rounds:
+                        vf.write(json.dumps(
+                            {k: round(v, 6) for k, v in row.items()})
+                            + "\n")
+                print(f"validation rounds → {vpath}")
 
         model_path = self.args.model or checkpoint.snapshot_filename(
             self.prefix, it, is_state=False,

@@ -350,3 +350,78 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
         for pa, pb in zip(a[k], b[k]):
             np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
                                        rtol=2e-3, atol=2e-5)
+
+
+def test_two_process_interleaved_validation(tmp_path):
+    """Interleaved validation on the pod path: a 2-proc dp cluster
+    whose solver sets test_interval/test_iter runs the eval step in
+    LOCKSTEP on both ranks (it is a collective on the mesh) over the
+    same replicated validation stream; rank 0 prints the rounds and
+    writes validation.json — the driver CLI's trainWithValidation
+    artifact, now from supervisor-launched standalone clusters."""
+    import json
+
+    from caffeonspark_tpu.data import LmdbWriter
+    from caffeonspark_tpu.data.synthetic import make_images
+    from caffeonspark_tpu.proto.caffe import Datum
+
+    imgs, labels = make_images(96, seed=5)
+    recs = [(b"%06d" % i,
+             Datum(channels=1, height=28, width=28,
+                   data=(imgs[i, 0] * 255).astype(np.uint8).tobytes(),
+                   label=int(labels[i])).to_binary())
+            for i in range(96)]
+    LmdbWriter(str(tmp_path / "lmdb")).write(recs)
+    net = tmp_path / "net.prototxt"
+    net.write_text(f'''
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  include {{ phase: TRAIN }} source_class: "LMDB"
+  memory_data_param {{ source: "{tmp_path}/lmdb" batch_size: 8
+    channels: 1 height: 28 width: 28 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "tdata" type: "MemoryData" top: "data" top: "label"
+  include {{ phase: TEST }} source_class: "LMDB"
+  memory_data_param {{ source: "{tmp_path}/lmdb" batch_size: 8
+    channels: 1 height: 28 width: 28 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}
+layer {{ name: "accuracy" type: "Accuracy" bottom: "ip" bottom: "label"
+  top: "accuracy" include {{ phase: TEST }} }}''')
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(
+        f'net: "{net}"\nbase_lr: 0.05\nmomentum: 0.9\n'
+        'lr_policy: "fixed"\nmax_iter: 8\ntest_interval: 4\n'
+        'test_iter: 2\nsnapshot: 100\nsnapshot_prefix: "v"\n'
+        'random_seed: 5\n')
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+           "PALLAS_AXON_POOL_IPS": "",
+           "PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    port = _free_port()
+    out = tmp_path / "out"
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
+         "-solver", str(solver), "-train", str(tmp_path / "lmdb"),
+         "-output", str(out), "-server", f"127.0.0.1:{port}",
+         "-cluster", "2", "-rank", str(r)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for r in range(2)]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{o[-1500:]}"
+    assert "validation iter 4" in outs[0] and \
+        "validation iter 8" in outs[0]
+    assert "validation iter" not in outs[1]   # rank-0-only reporting
+    rows = [json.loads(l)
+            for l in (out / "validation.json").read_text().splitlines()]
+    assert len(rows) == 2
+    assert set(rows[0]) == {"accuracy", "loss"}
