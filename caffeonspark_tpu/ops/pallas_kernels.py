@@ -197,16 +197,19 @@ def _online_softmax_step(q, kb, vb, m, l, acc, *, sm_scale: float,
     """One online-softmax accumulation (the flash/ring shared algebra):
     scores for (q, kb) fold into the (m, l, acc) carry.  The m_safe
     guard makes fully-masked-so-far rows accumulate exact zeros (a
-    no-op for rows that have seen the causal diagonal)."""
+    no-op for rows that have seen the causal diagonal).  m and l are
+    (block_q, 1) column vectors — Mosaic's block-shape rule wants the
+    per-row stats rank-2, and the column form broadcasts against the
+    (block_q, block_k) score strip with no reshapes."""
     s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * sm_scale
     if causal:
         s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     m_safe = jnp.where(m_new <= _NEG_INF * 0.5, 0.0, m_new)
-    p = jnp.exp(s - m_safe[:, None])
+    p = jnp.exp(s - m_safe)
     corr = jnp.exp(m - m_safe)
-    l_new = l * corr + jnp.sum(p, axis=-1)
-    acc_new = acc * corr[:, None] + jnp.dot(
+    l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * corr + jnp.dot(
         p, vb, preferred_element_type=jnp.float32)
     return m_new, l_new, acc_new
 
@@ -236,11 +239,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         n_k = ((qi + 1) * block_q + block_k - 1) // block_k
     else:
         n_k = t // block_k
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
     a0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, a0))
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(l)
 
 
@@ -260,7 +263,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(
             jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]   # (block_q, 1)
         dlt = delta_ref[0, pl.ds(i * block_q, block_q)]
         s = jnp.dot(qb, kb.T,
                     preferred_element_type=jnp.float32) * sm_scale
@@ -268,11 +271,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
             q_pos = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])            # exact probabilities
+        p = jnp.exp(s - lse)                     # exact probabilities
         dv_new = dv + jnp.dot(p.T, dob,
                               preferred_element_type=jnp.float32)
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - dlt[:, None]) * sm_scale
+        ds = p * (dp - dlt) * sm_scale
         dk_new = dk + jnp.dot(ds.T, qb,
                               preferred_element_type=jnp.float32)
         return dk_new, dv_new
@@ -291,7 +294,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
                          causal: bool, block_k: int):
     qb = q_ref[0].astype(jnp.float32)            # (block_q, D)
     dob = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
+    lse = lse_ref[0]                             # (block_q, 1)
     dlt = delta_ref[0]
     t = k_ref.shape[1]
     block_q = qb.shape[0]
@@ -308,9 +311,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
             k_pos = i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - dlt[:, None]) * sm_scale
+        ds = p * (dp - dlt) * sm_scale
         return dq + jnp.dot(ds, kb, preferred_element_type=jnp.float32)
 
     if causal:
@@ -325,11 +328,15 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _flash_specs(block, d, t):
     # `*_` absorbs the scalar-prefetch refs appended to index-map args
-    # when these specs are used under a PrefetchScalarGridSpec
+    # when these specs are used under a PrefetchScalarGridSpec.
+    # Per-row stats (m/l/lse/delta) travel as (bh, t, 1) column vectors:
+    # Mosaic requires the last two block dims divisible by (8, 128) OR
+    # equal to the array dims — (block, 1) satisfies that ((1, block)
+    # from a rank-2 (bh, t) layout does not, and fails to lower).
     qspec = pl.BlockSpec((1, block, d), lambda b, i, *_: (b, i, 0))
     kvspec = pl.BlockSpec((1, t, d), lambda b, i, *_: (b, 0, 0))
-    vec = pl.BlockSpec((1, block), lambda b, i, *_: (b, i))
-    vec_full = pl.BlockSpec((1, t), lambda b, i, *_: (b, 0))
+    vec = pl.BlockSpec((1, block, 1), lambda b, i, *_: (b, i, 0))
+    vec_full = pl.BlockSpec((1, t, 1), lambda b, i, *_: (b, 0, 0))
     return qspec, kvspec, vec, vec_full
 
 
@@ -347,15 +354,16 @@ def _flash_fwd_call(q, k, v, sm_scale, causal, block_q, block_k,
     kern = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
                              causal=causal, block_k=block_k)
     qspec, kvspec, vec, _ = _flash_specs(block_q, d, t)
-    return pl.pallas_call(
+    out, lse = pl.pallas_call(
         kern,
         out_shape=(jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-                   jax.ShapeDtypeStruct((bh, t), jnp.float32)),
+                   jax.ShapeDtypeStruct((bh, t, 1), jnp.float32)),
         grid=(bh, t // block_q),
         in_specs=[qspec, kvspec, kvspec],
         out_specs=(qspec, vec),
         interpret=interpret,
     )(q, k, v)
+    return out, lse[:, :, 0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -400,6 +408,8 @@ def flash_bwd_block(qf, kf, vf, dof, lse, delta, *, causal: bool,
     don't round each per-hop partial before the sum."""
     bh, t, d = qf.shape
     sm_scale = 1.0 / math.sqrt(d)
+    lse = lse[:, :, None]          # (bh, t, 1): see _flash_specs
+    delta = delta[:, :, None]
     qspec, kvspec, vec, vec_full = _flash_specs(block_q, d, t)
     kspec_b, _, _, _ = _flash_specs(block_k, d, t)
     dq = pl.pallas_call(
@@ -519,11 +529,12 @@ def flash_block_update(q: jax.Array, k_blk: jax.Array,
     )
     offs = (jnp.asarray([q_off], jnp.int32),
             jnp.asarray([k_off], jnp.int32))
-    return pl.pallas_call(
+    mo, lo, ao = pl.pallas_call(
         kern,
         grid_spec=grid_spec,
-        out_shape=(jax.ShapeDtypeStruct((bh, t_q), jnp.float32),
-                   jax.ShapeDtypeStruct((bh, t_q), jnp.float32),
+        out_shape=(jax.ShapeDtypeStruct((bh, t_q, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, t_q, 1), jnp.float32),
                    jax.ShapeDtypeStruct((bh, t_q, d), acc.dtype)),
         interpret=interpret,
-    )(*offs, q, k_blk, v_blk, m, l, acc)
+    )(*offs, q, k_blk, v_blk, m[:, :, None], l[:, :, None], acc)
+    return mo[:, :, 0], lo[:, :, 0], ao

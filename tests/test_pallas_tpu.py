@@ -90,7 +90,16 @@ def test_flash_attention_parity_on_tpu():
             lambda a, b_, c: flash_attention(a, b_, c, causal))(q, k, v)))
         want = np.asarray(jax.device_get(jax.jit(
             lambda a, b_, c: attention(a, b_, c, causal=causal))(q, k, v)))
-        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+        # tolerance is the MXU default-precision floor: on the real
+        # chip both paths multiply f32 operands in bf16 MXU passes and
+        # round differently.  Measured on TPU v5 lite at this shape:
+        # non-causal — XLA default-vs-highest spread 3.5e-3,
+        # flash-vs-xla-default 9.3e-4; causal — flash-vs-xla-default
+        # violations up to 6.5e-3 (sharper softmax rows amplify the
+        # score rounding).  1e-2 is ~1.5x headroom over the worst
+        # observed causal spread.  Exact f32 semantics are pinned by
+        # the interpret-mode tests (tests/test_pallas.py).
+        np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-2)
 
 
 def test_flash_attention_vjp_parity_on_tpu():
@@ -114,7 +123,10 @@ def test_flash_attention_vjp_parity_on_tpu():
         lambda a, b_, c: attention(a, b_, c, causal=True)),
         argnums=(0, 1, 2)))(q, k, v)
     for name, a, b_ in zip("qkv", gr, gf):
+        # MXU default-precision floor (see the fwd parity test's
+        # measured spreads); empirically the grads at this smaller
+        # shape stay within 5e-3 on chip
         np.testing.assert_allclose(
             np.asarray(jax.device_get(b_)),
-            np.asarray(jax.device_get(a)), rtol=5e-4, atol=5e-4,
+            np.asarray(jax.device_get(a)), rtol=5e-3, atol=5e-3,
             err_msg=f"d{name}")

@@ -127,7 +127,11 @@ def test_transformer_flash_train_parity_on_tpu(monkeypatch):
     flash = run(disable_flash=False)
     xla = run(disable_flash=True)
     assert np.isfinite(flash).all() and np.isfinite(xla).all()
-    np.testing.assert_allclose(flash, xla, rtol=5e-4, atol=5e-5)
+    # tolerance is the MXU default-precision floor, not the f32 one the
+    # interpret-mode tests use: both paths multiply f32 operands in
+    # bf16 MXU passes and round differently (~1e-3 relative).  Exact
+    # f32 semantics are pinned on CPU (tests/test_pallas.py).
+    np.testing.assert_allclose(flash, xla, rtol=5e-3, atol=5e-4)
 
 
 def test_ring_attention_cross_extent_on_tpu():
@@ -153,8 +157,10 @@ def test_ring_attention_cross_extent_on_tpu():
     for causal in (False, True):
         ref = attention(q, k, v, causal=causal)
         got = ring_attention(q, k, v, mesh, causal=causal, flash=True)
-        np.testing.assert_allclose(_sync(got), _sync(ref), rtol=2e-4,
-                                   atol=2e-4, err_msg=f"fwd {causal}")
+        # MXU default-precision floor (bf16 multiply passes; measured
+        # band in tests/test_pallas_tpu.py's fwd parity test)
+        np.testing.assert_allclose(_sync(got), _sync(ref), rtol=1e-2,
+                                   atol=1e-2, err_msg=f"fwd {causal}")
 
         def loss(fn):
             return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
@@ -166,7 +172,7 @@ def test_ring_attention_cross_extent_on_tpu():
             argnums=(0, 1, 2))(q, k, v)
         for name, a, b_ in zip("qkv", gr, gf):
             np.testing.assert_allclose(
-                _sync(b_), _sync(a), rtol=5e-4, atol=5e-4,
+                _sync(b_), _sync(a), rtol=1e-2, atol=1e-2,
                 err_msg=f"d{name} causal={causal}")
 
 
