@@ -37,37 +37,38 @@ def _window_sum(v: jax.Array, pad: int) -> jax.Array:
     return acc
 
 
-def _lrn_kernel(x_ref, o_ref, s_ref, *, local_size: int, alpha: float,
-                beta: float, k: float):
-    x = x_ref[0]                     # (C, TILE) resident in VMEM
-    pad = local_size // 2
-    scale = k + (alpha / local_size) * _window_sum(x * x, pad)
-    s_ref[0] = scale
-    o_ref[0] = x * jnp.exp(-beta * jnp.log(scale))
-
-
 def _lrn_kernel_fwd_only(x_ref, o_ref, *, local_size: int, alpha: float,
                          beta: float, k: float):
-    """Inference variant: no scale residual output (XLA cannot DCE an
-    unused output of an opaque kernel, so a separate kernel saves an
-    activation-sized HBM write on the eval path)."""
-    x = x_ref[0]
+    """The one forward kernel (train AND eval): no scale residual.
+    The backward kernel recomputes the denominators from x — a few VPU
+    ops on a block already resident in VMEM — instead of storing an
+    activation-sized scale tensor (round-5 perf pass: dropping the
+    residual removes one full-size HBM write on the forward and one
+    read on the backward, ~2/7 of the LRN stage's training traffic).
+
+    Math runs in f32 regardless of the I/O dtype: in mixed (bf16)
+    training, scale = 1 + (α/n)·Σx² computed in bf16 (eps ≈ 8e-3)
+    rounds away most of the normalizer's significant digits.  The
+    upcast lives in VMEM, so HBM traffic is unchanged."""
+    x = x_ref[0].astype(jnp.float32)
     pad = local_size // 2
     scale = k + (alpha / local_size) * _window_sum(x * x, pad)
-    o_ref[0] = x * jnp.exp(-beta * jnp.log(scale))
+    o_ref[0] = (x * jnp.exp(-beta * jnp.log(scale))).astype(o_ref.dtype)
 
 
-def _lrn_bwd_kernel(x_ref, s_ref, dy_ref, dx_ref, *, local_size: int,
-                    alpha: float, beta: float):
-    """dx = dy·s^{-β} − (2αβ/n)·x·Σ_{i∈W} dy_i·x_i·s_i^{-β-1}."""
-    x = x_ref[0]
-    s = s_ref[0]
-    dy = dy_ref[0]
+def _lrn_bwd_kernel(x_ref, dy_ref, dx_ref, *, local_size: int,
+                    alpha: float, beta: float, k: float):
+    """dx = dy·s^{-β} − (2αβ/n)·x·Σ_{i∈W} dy_i·x_i·s_i^{-β-1}, with
+    s recomputed in-VMEM from x in f32 (bit-identical to the
+    forward's: same block, same op order, same upcast)."""
+    x = x_ref[0].astype(jnp.float32)
+    dy = dy_ref[0].astype(jnp.float32)
     pad = local_size // 2
+    s = k + (alpha / local_size) * _window_sum(x * x, pad)
     s_nb = jnp.exp(-beta * jnp.log(s))        # s^{-β}
     u = dy * x * s_nb / s                      # dy·x·s^{-β-1}
-    dx_ref[0] = dy * s_nb - (2.0 * alpha * beta / local_size) * x \
-        * _window_sum(u, pad)
+    dx_ref[0] = (dy * s_nb - (2.0 * alpha * beta / local_size) * x
+                 * _window_sum(u, pad)).astype(dx_ref.dtype)
 
 
 def _pad_flat(x):
@@ -88,32 +89,6 @@ def _block_spec(c):
 def _lrn_fwd_call(x, local_size, alpha, beta, k, interpret):
     n, c, h, w = x.shape
     xf, hw, padded = _pad_flat(x)
-    kern = functools.partial(_lrn_kernel, local_size=local_size,
-                             alpha=alpha, beta=beta, k=k)
-    out, scale = pl.pallas_call(
-        kern,
-        out_shape=(jax.ShapeDtypeStruct((n, c, padded), x.dtype),
-                   jax.ShapeDtypeStruct((n, c, padded), x.dtype)),
-        grid=(n, padded // TILE),
-        in_specs=[_block_spec(c)],
-        out_specs=(_block_spec(c), _block_spec(c)),
-        interpret=interpret,
-    )(xf)
-    return (out[:, :, :hw].reshape(n, c, h, w),
-            scale[:, :, :hw].reshape(n, c, h, w))
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
-def lrn_across_channels(x: jax.Array, local_size: int = 5,
-                        alpha: float = 1e-4, beta: float = 0.75,
-                        k: float = 1.0,
-                        interpret: bool = False) -> jax.Array:
-    """(N, C, H, W) float32 → LRN, Caffe semantics (alpha/local_size).
-    Differentiable: a second fused kernel computes the exact VJP using
-    saved denominators, so training runs on the Pallas path too; the
-    undifferentiated primal uses a residual-free kernel."""
-    n, c, h, w = x.shape
-    xf, hw, padded = _pad_flat(x)
     kern = functools.partial(_lrn_kernel_fwd_only, local_size=local_size,
                              alpha=alpha, beta=beta, k=k)
     out = pl.pallas_call(
@@ -127,31 +102,39 @@ def lrn_across_channels(x: jax.Array, local_size: int = 5,
     return out[:, :, :hw].reshape(n, c, h, w)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def lrn_across_channels(x: jax.Array, local_size: int = 5,
+                        alpha: float = 1e-4, beta: float = 0.75,
+                        k: float = 1.0,
+                        interpret: bool = False) -> jax.Array:
+    """(N, C, H, W) → LRN, Caffe semantics (alpha/local_size).
+    Differentiable: a second fused kernel computes the exact VJP,
+    recomputing the denominators in VMEM from the saved input — the
+    only residual is x itself, so training adds zero extra HBM
+    traffic over inference."""
+    return _lrn_fwd_call(x, local_size, alpha, beta, k, interpret)
+
+
 def _lrn_vjp_fwd(x, local_size, alpha, beta, k, interpret):
-    out, scale = _lrn_fwd_call(x, local_size, alpha, beta, k, interpret)
-    return out, (x, scale)
+    out = _lrn_fwd_call(x, local_size, alpha, beta, k, interpret)
+    return out, x
 
 
 def _lrn_vjp_bwd(local_size, alpha, beta, k, interpret, res, dy):
-    x, scale = res
+    x = res
     n, c, h, w = x.shape
     xf, hw, padded = _pad_flat(x)
-    sf, _, _ = _pad_flat(scale)
-    # padded scale regions are 0 → guard: set them to 1 (u is 0 there)
-    if padded != hw:
-        mask = jnp.arange(padded) < hw
-        sf = jnp.where(mask[None, None, :], sf, 1.0)
     dyf, _, _ = _pad_flat(dy)
     kern = functools.partial(_lrn_bwd_kernel, local_size=local_size,
-                             alpha=alpha, beta=beta)
+                             alpha=alpha, beta=beta, k=k)
     dx = pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((x.shape[0], c, padded), x.dtype),
         grid=(x.shape[0], padded // TILE),
-        in_specs=[_block_spec(c), _block_spec(c), _block_spec(c)],
+        in_specs=[_block_spec(c), _block_spec(c)],
         out_specs=_block_spec(c),
         interpret=interpret,
-    )(xf, sf, dyf)
+    )(xf, dyf)
     return (dx[:, :, :hw].reshape(n, c, h, w),)
 
 

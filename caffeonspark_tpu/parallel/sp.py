@@ -335,22 +335,33 @@ def _make_ring_flash_cross(axis_name: str, causal: bool, bq: int,
                         axis=-1)                     # (B, H, t_q) f32
         kpos = idx * t_k + jnp.arange(t_k)           # home K positions
 
+        # HIGHEST precision: on TPU a DEFAULT-precision f32 einsum is a
+        # single bf16 MXU pass — measured max score error 1.2e-2 at the
+        # test shape, which exp() turns into an 8e-4 p-inconsistency
+        # against the kernel's lse and a >1e-2 dq violation on sharp
+        # causal rows.  HIGHEST (multi-pass f32) recovers the kernel's
+        # accuracy (p error 2e-4 measured on chip).  Backward-only and
+        # cross-attention blocks are short, so the cost is marginal.
+        hi = jax.lax.Precision.HIGHEST
+
         def pair(vq, vdo, vlse, vdelta, j):
             """Visitor q-group (home shard j) against the resident K/V:
             p from the saved lse, then ds → (dq, dk, dv) partials."""
             s = jnp.einsum("bhqd,bhkd->bhqk", vq.astype(jnp.float32),
-                           kf) * scale
+                           kf, precision=hi) * scale
             p = jnp.exp(s - vlse[..., None])
             if causal:
                 qpos = j * t_q + jnp.arange(t_q)
                 p = jnp.where((qpos[:, None] >= kpos[None, :])
                               [None, None], p, 0.0)
-            dp = jnp.einsum("bhqd,bhkd->bhqk", vdo, vf)
+            dp = jnp.einsum("bhqd,bhkd->bhqk", vdo, vf, precision=hi)
             ds = p * (dp - vdelta[..., None])
-            dqh = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+            dqh = jnp.einsum("bhqk,bhkd->bhqd", ds, kf,
+                             precision=hi) * scale
             dkh = jnp.einsum("bhqk,bhqd->bhkd", ds,
-                             vq.astype(jnp.float32)) * scale
-            dvh = jnp.einsum("bhqk,bhqd->bhkd", p, vdo)
+                             vq.astype(jnp.float32),
+                             precision=hi) * scale
+            dvh = jnp.einsum("bhqk,bhqd->bhkd", p, vdo, precision=hi)
             return dqh, dkh, dvh
 
         def maybe_pair(vq, vdo, vlse, vdelta, j):

@@ -1,0 +1,108 @@
+"""On-chip long-context attention microbench: flash kernel vs XLA.
+
+The long-context story (SURVEY §5.7: ring + flash attention) has
+throughput claims only from interpret-mode semantics so far.  This
+script measures, on the real chip, causal self-attention fwd+bwd at
+long sequence lengths:
+
+  - xla:   the einsum reference (`parallel.sp.attention`) — what a
+           user gets without the Pallas path
+  - flash: `ops.pallas_kernels.flash_attention` (tiled online-softmax,
+           O(T) memory, the kernel the ring path runs per hop)
+
+and drops one evidence bundle per (T, impl) into bench_evidence/ via
+bench.py's writer (same schema: record + timing + env fingerprint).
+
+The metric is attention-FLOPs/s: 4·B·H·T²·D multiply-adds fwd (×3.5
+fwd+bwd, causal ×0.5) — the standard flash-attention accounting — so
+MFU here is attention-math utilization, comparable across T.
+
+Run (serialized with the watcher's lock):
+    flock /tmp/cos_tpu.lock -c 'python scripts/bench_attention.py'
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from bench import _write_evidence
+    from caffeonspark_tpu.ops.pallas_kernels import flash_attention
+    from caffeonspark_tpu.parallel.sp import attention
+
+    jax.config.update("jax_default_matmul_precision", "bfloat16")
+    dev = jax.devices()[0]
+    chip = f"{dev.platform}:{getattr(dev, 'device_kind', '?')}"
+    print("backend:", chip)
+
+    b, h, d = 4, 16, 64
+    iters = 20
+    results = []
+    for t in (1024, 2048, 4096):
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+        # causal attention FLOPs: 2 matmuls x 2 FLOP/MAC x B H T^2 D,
+        # x0.5 causal, x3.5 fwd+bwd (standard flash accounting)
+        flops_step = 3.5 * 0.5 * 4 * b * h * t * t * d
+
+        def make(fn):
+            def loss(q, k, v):
+                return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+            grad = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+
+            def step(q, k, v):
+                def body(c, _):
+                    l, gs = grad(q + c.astype(q.dtype) * 1e-9, k, v)
+                    return (l * 1e-20).astype(jnp.float32), None
+                return jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                    None, length=iters)[0]
+            return jax.jit(step)
+
+        impls = {
+            "xla": lambda q, k, v: attention(q, k, v, causal=True),
+            "flash": lambda q, k, v: flash_attention(q, k, v, True),
+        }
+        row = {"t": t}
+        for name, fn in impls.items():
+            stepj = make(fn)
+            tc = time.perf_counter()
+            np.asarray(jax.device_get(stepj(q, k, v)))  # compile+warm
+            compile_s = time.perf_counter() - tc
+            t0 = time.perf_counter()
+            np.asarray(jax.device_get(stepj(q, k, v)))
+            dt = (time.perf_counter() - t0) / iters
+            tflops = flops_step / dt / 1e12
+            rec = {
+                "metric": f"attention_causal_t{t}_{name}",
+                "value": round(b * t / dt, 1),
+                "unit": "sequences*T/sec(tokens/sec)",
+                "mfu": round(tflops / 197.0, 4),
+                "model_tflops_per_sec": round(tflops, 2),
+                "flops_per_step": flops_step,
+                "batch": b, "heads": h, "head_dim": d, "iters": iters,
+                "precision": "bfloat16", "act_dtype": "bfloat16",
+                "chip": chip,
+            }
+            timing = {"sec_per_iter": dt, "compile_s": compile_s}
+            _write_evidence(rec, timing)
+            row[name] = {"ms": round(dt * 1e3, 3),
+                         "tflops": round(tflops, 2)}
+            print(json.dumps(rec), flush=True)
+        if "xla" in row and "flash" in row:
+            row["speedup"] = round(row["xla"]["ms"] / row["flash"]["ms"], 3)
+        results.append(row)
+    print(json.dumps({"summary": results}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

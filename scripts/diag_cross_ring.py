@@ -1,0 +1,120 @@
+"""On-chip diagnosis of the cross-extent fused-ring grad mismatch.
+
+Round-5 continuation: TPU_TESTS showed test_ring_attention_cross_extent
+failing >1e-2 on the real chip while the equal-extent flash VJP passes
+at 5e-3.  Hypothesis: the cross backward (_make_ring_flash_cross.bwd)
+recomputes scores with XLA einsums at DEFAULT precision (bf16 MXU
+passes) that round DIFFERENTLY from the Pallas forward kernel's
+jnp.dot, then exponentiates against the kernel's saved lse — the
+inconsistency amplifies through exp into the p matrix and lands
+directly in dv/dk/dq (no o/l ratio cancellation like the forward has).
+
+Prints per-leg max-delta for the current code and for candidate fixes:
+  A) backward einsums at precision=HIGHEST (accurate f32 s)
+  B) like A plus lse recomputed at HIGHEST from saved q/k instead of
+     using the kernel's residual (fully self-consistent backward)
+
+Run:  COS_TPU_TESTS=1 python scripts/diag_cross_ring.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("COS_TPU_TESTS", "1")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import math
+
+
+def main():
+    from jax.sharding import Mesh
+    from caffeonspark_tpu.parallel.sp import attention, ring_attention
+
+    print("backend:", jax.default_backend(), jax.devices())
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    rng = np.random.RandomState(12)
+    b, h, d = 2, 2, 32
+    t_q, t_k = 128, 256
+    q = jnp.asarray(rng.randn(b, h, t_q, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, t_k, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, t_k, d), jnp.float32)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    def delta(a, b_):
+        a = np.asarray(jax.device_get(a), np.float64)
+        b_ = np.asarray(jax.device_get(b_), np.float64)
+        ad = np.abs(a - b_)
+        # where does assert_allclose(rtol=atol=1e-2) fail?
+        viol = ad - (1e-2 + 1e-2 * np.abs(b_))
+        return ad.max(), viol.max()
+
+    for causal in (False, True):
+        ref = attention(q, k, v, causal=causal)
+        got = ring_attention(q, k, v, mesh, causal=causal, flash=True)
+        print(f"fwd causal={causal}: max|d|={delta(got, ref)}")
+        gr = jax.grad(loss(lambda q, k, v: attention(
+            q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss(lambda q, k, v: ring_attention(
+            q, k, v, mesh, causal=causal, flash=True)),
+            argnums=(0, 1, 2))(q, k, v)
+        for name, a, b_ in zip("qkv", gr, gf):
+            print(f"  d{name} causal={causal}: (max|d|, viol) ="
+                  f" {delta(b_, a)}")
+
+    # ---- component-level: how far apart are kernel-s and einsum-s? ----
+    scale = 1.0 / math.sqrt(d)
+
+    @jax.jit
+    def s_einsum_default(q, k):
+        return jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+
+    @jax.jit
+    def s_einsum_highest(q, k):
+        return jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                          precision=jax.lax.Precision.HIGHEST) * scale
+
+    sd = s_einsum_default(q, k)
+    sh = s_einsum_highest(q, k)
+    print("einsum-s default-vs-highest max|d|:",
+          float(jnp.max(jnp.abs(sd - sh))))
+
+    # lse consistency: kernel residual vs HIGHEST einsum lse
+    from caffeonspark_tpu.ops.pallas_kernels import flash_block_update
+    bh = b * h
+    m0 = jnp.full((bh, t_q), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bh, t_q), jnp.float32)
+    o0 = jnp.zeros((bh, t_q, d), jnp.float32)
+    mf, lf, of = flash_block_update(
+        q.reshape(bh, t_q, d), k.reshape(bh, t_k, d),
+        v.reshape(bh, t_k, d), m0, l0, o0, 0, 0, causal=False,
+        block_q=128, block_k=128)
+    lse_kernel = mf + jnp.log(jnp.maximum(lf, 1e-30))
+    sh_f = sh.reshape(bh, t_q, t_k)
+    lse_true = jax.scipy.special.logsumexp(sh_f, axis=-1)
+    print("lse kernel-vs-true(highest) max|d|:",
+          float(jnp.max(jnp.abs(lse_kernel - lse_true))))
+    lse_default = jax.scipy.special.logsumexp(
+        sd.reshape(bh, t_q, t_k), axis=-1)
+    print("lse default-einsum-vs-true max|d|:",
+          float(jnp.max(jnp.abs(lse_default - lse_true))))
+
+    # p inconsistency under the CURRENT backward (default-precision s,
+    # kernel lse) vs the reference p
+    p_cur = jnp.exp(sd.reshape(bh, t_q, t_k) - lse_kernel[..., None])
+    p_ref = jax.nn.softmax(sh_f, axis=-1)
+    print("p current-backward-vs-ref max|d|:",
+          float(jnp.max(jnp.abs(p_cur - p_ref))))
+    p_fixA = jnp.exp(sh_f - lse_kernel[..., None])
+    print("p fixA (highest s, kernel lse) max|d|:",
+          float(jnp.max(jnp.abs(p_fixA - p_ref))))
+    p_fixB = jnp.exp(sh_f - lse_true[..., None])
+    print("p fixB (highest s, recomputed lse) max|d|:",
+          float(jnp.max(jnp.abs(p_fixB - p_ref))))
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,28 @@ def test_lrn_pallas_grad_matches_xla(shape):
                                rtol=3e-4, atol=3e-5)
 
 
+def test_lrn_pallas_bf16_io_f32_normalizer():
+    """Mixed-precision training feeds the kernel bf16 activations; the
+    normalizer must still be computed in f32.  In bf16 (eps ~ 8e-3)
+    scale = 1 + (alpha/n)*sum(x^2) rounds away its significant digits
+    and LRN silently degrades toward identity — so the kernel upcasts
+    in VMEM.  Pin: bf16-in/bf16-out output matches the f32 reference
+    within bf16 OUTPUT rounding (2^-8), far tighter than the identity
+    gap this alpha produces."""
+    rng = np.random.RandomState(3)
+    xf = rng.randn(2, 8, 6, 6).astype(np.float32) * 3
+    x16 = jnp.asarray(xf, jnp.bfloat16)
+    ref = _xla_lrn(jnp.asarray(x16, jnp.float32))  # same rounded input
+    got = lrn_across_channels(x16, 5, 1e-4, 0.75, 1.0, True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref), rtol=1e-2, atol=1e-2)
+    # and the normalization actually happened (output != identity)
+    gap = np.max(np.abs(np.asarray(got, np.float32)
+                        - np.asarray(x16, np.float32)))
+    assert gap > 1e-2, "LRN degenerated to identity"
+
+
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("t,block", [(256, 128), (64, 64), (384, 128)])
 def test_flash_attention_matches_reference(causal, t, block):
