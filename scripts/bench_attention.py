@@ -42,10 +42,16 @@ def main():
     chip = f"{dev.platform}:{getattr(dev, 'device_kind', '?')}"
     print("backend:", chip)
 
-    b, h, d = 4, 16, 64
-    iters = 20
+    # BENCH_ATTN_SMOKE=1: tiny-shape CPU harness check (interpret-mode
+    # flash, no evidence writes because chip says cpu) — validates the
+    # script end-to-end before the watcher burns a tunnel window on it
+    smoke = os.environ.get("BENCH_ATTN_SMOKE") == "1"
+    interpret = smoke and dev.platform not in ("tpu", "axon")
+
+    b, h, d = (1, 2, 64) if smoke else (4, 16, 64)
+    iters = 2 if smoke else 20
     results = []
-    for t in (1024, 2048, 4096):
+    for t in ((256,) if smoke else (1024, 2048, 4096)):
         rng = np.random.RandomState(0)
         q = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
         k = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
@@ -70,7 +76,8 @@ def main():
 
         impls = {
             "xla": lambda q, k, v: attention(q, k, v, causal=True),
-            "flash": lambda q, k, v: flash_attention(q, k, v, True),
+            "flash": lambda q, k, v: flash_attention(
+                q, k, v, True, interpret=interpret),
         }
         row = {"t": t}
         for name, fn in impls.items():
