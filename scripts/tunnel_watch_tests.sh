@@ -56,7 +56,7 @@ print('TPU alive:', ds)
           # a window that died mid-suite leaves a tests:0 wedge record
           # that is strictly less informative than the committed
           # artifact (a REAL pre-fix suite execution); restore it so a
-          # blind end-of-round commit can't replace evidence with a
+          # blind end-of-round commit cannot replace evidence with a
           # wedge stub.  The attempt details live in this log.
           git checkout -- TPU_TESTS_r05.json 2>/dev/null
           echo "non-green artifact restored to committed version"
