@@ -69,6 +69,7 @@ onchip-artifacts:
 	-BENCH_MODEL=lstm $(PY) bench.py
 	-BENCH_MODEL=vgg16 $(PY) bench.py
 	-BENCH_MODEL=googlenet $(PY) bench.py
+	-$(PY) scripts/bench_attention.py
 
 docs:
 	$(PY) docs/gen_html.py
