@@ -76,8 +76,11 @@ def main():
             x, -jnp.inf, jax.lax.max, (1, 1, k, k), (1, 1, s, s), "VALID")
 
     def lrn(x):
-        return lrn_across_channels(x.astype(jnp.float32), 5, 1e-4, 0.75,
-                                   1.0).astype(x.dtype)
+        # matches ops.layers._lrn: the kernel takes the activation
+        # dtype directly and upcasts to f32 in VMEM (an .astype here
+        # would add two full activation round trips the model path
+        # does not pay)
+        return lrn_across_channels(x, 5, 1e-4, 0.75, 1.0)
 
     N = BATCH
     results = {}
@@ -86,10 +89,23 @@ def main():
     w1 = t((96, 3, 11, 11))
     results["conv1(11x11s4,3->96)"] = timeit(
         "conv1(11x11s4,3->96)", fwd_bwd(lambda x, w: conv(x, w, 4)), x0, w1)
+    # the model path's actual conv1 (s2d stem rewrite, on by default on
+    # TPU) — the raw row above is the A in the A/B
+    from caffeonspark_tpu.ops.layers import _s2d_conv
+    results["conv1-s2d(model path)"] = timeit(
+        "conv1-s2d(model path)",
+        fwd_bwd(lambda x, w: _s2d_conv(x, w, 4, 11, 11, 0, 0)), x0, w1)
     a1 = t((N, 96, 55, 55))
     results["relu+lrn+pool@55x96"] = timeit(
         "relu+lrn+pool@55x96",
         fwd_bwd(lambda x: maxpool(lrn(jax.nn.relu(x)))), a1)
+    # sub-segment breakdown of the dominant stage (which op owns it?)
+    results["  relu-only@55x96"] = timeit(
+        "  relu-only@55x96", fwd_bwd(jax.nn.relu), a1)
+    results["  lrn-only@55x96"] = timeit(
+        "  lrn-only@55x96", fwd_bwd(lrn), a1)
+    results["  pool-only@55x96"] = timeit(
+        "  pool-only@55x96", fwd_bwd(maxpool), a1)
     # stage 2: 27x27x96 -> conv2 5x5 pad2 g2 -> 256 -> relu,lrn,pool -> 13
     a2 = t((N, 96, 27, 27))
     w2 = t((256, 48, 5, 5))
