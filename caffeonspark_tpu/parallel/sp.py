@@ -340,9 +340,12 @@ def _make_ring_flash_cross(axis_name: str, causal: bool, bq: int,
         # test shape, which exp() turns into an 8e-4 p-inconsistency
         # against the kernel's lse and a >1e-2 dq violation on sharp
         # causal rows.  HIGHEST (multi-pass f32) recovers the kernel's
-        # accuracy (p error 2e-4 measured on chip).  Backward-only and
-        # cross-attention blocks are short, so the cost is marginal.
-        hi = jax.lax.Precision.HIGHEST
+        # accuracy (p error 2e-4 measured on chip).  Only f32 operands
+        # need it: bf16 activations upcast to f32 re-round LOSSLESSLY
+        # under a DEFAULT bf16 pass, so they keep the fast multiply.
+        hi = (jax.lax.Precision.HIGHEST
+              if any(a.dtype == jnp.float32 for a in (q, k, v))
+              else jax.lax.Precision.DEFAULT)
 
         def pair(vq, vdo, vlse, vdelta, j):
             """Visitor q-group (home shard j) against the resident K/V:
