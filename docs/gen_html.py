@@ -18,6 +18,8 @@ PAGES = {
     "architecture.html": "architecture.md",
     "benchmarks.html": "benchmarks.md",
     "migration.html": "migration.md",
+    "tuning.html": "tuning.md",
+    "deploy.html": "deploy.md",
 }
 
 
