@@ -283,6 +283,22 @@ class Net:
         self.compute_layers = [lp for lp in self.layers
                                if not L.get_op(lp.type).is_data]
 
+        # --- ReLU→LRN peephole (COS_FUSE_RELU_LRN=1, opt-in) -------------
+        # XLA cannot fuse a producer into an opaque pallas call, so a
+        # ReLU feeding the Pallas LRN kernel materializes its output as
+        # the kernel's residual AND keeps the pre-activation live for
+        # its own mask — one extra activation-sized HBM round trip per
+        # stage in training.  Fused, the LRN kernel applies relu (and
+        # its mask, in the VJP) in VMEM and the only residual is the
+        # pre-activation.  Caveat (why opt-in): the relu top is no
+        # longer a materialized blob — for an in-place relu the name
+        # then holds the PRE-activation, so feature extraction of that
+        # blob changes meaning.
+        import os as _os
+        self.fused_relu_lrn: set = set()
+        if _os.environ.get("COS_FUSE_RELU_LRN") == "1":
+            self.compute_layers = self._fuse_relu_lrn(self.compute_layers)
+
         # --- shape inference + param spec construction -------------------
         blob_shapes: Dict[str, Tuple[int, ...]] = {
             name: tuple(shape) for name, shape, _ in self.input_specs}
@@ -306,7 +322,8 @@ class Net:
                             for (_, s, _) in specs]
             dummy_bottoms = [jax.ShapeDtypeStruct(s, dtype) for s in bshapes]
             ctx = L.Ctx(train=self.state.phase == Phase.TRAIN,
-                        rng=jax.random.key(0), layer_name=lp.name)
+                        rng=jax.random.key(0), layer_name=lp.name,
+                        fused_relu_lrn=frozenset(self.fused_relu_lrn))
             tops = jax.eval_shape(
                 lambda p, b, lp=lp, op=op, ctx=ctx: op.apply(ctx, lp, p, b),
                 dummy_params, dummy_bottoms)
@@ -340,6 +357,44 @@ class Net:
                     w = 0.0
                 if w:
                     self.loss_weights[t] = w
+
+    # ------------------------------------------------------------------
+    def _fuse_relu_lrn(self, layers: List[LayerParameter]
+                       ) -> List[LayerParameter]:
+        """Replace eligible [ReLU, LRN] pairs with one LRN layer whose
+        op applies relu in-kernel (see __init__).  Eligible: plain relu
+        (negative_slope 0, no loss weight, 1 bottom / 1 top) whose top
+        is consumed by exactly one later layer, an ACROSS_CHANNELS LRN.
+        The LRN entry is a deep copy (the source NetParameter may build
+        other Nets); its name is recorded in self.fused_relu_lrn, which
+        Net.apply threads to the op through Ctx."""
+        from .proto.caffe import NormRegion
+        out: List[Optional[LayerParameter]] = list(layers)
+        for i, r in enumerate(out):
+            if r is None or r.type != "ReLU":
+                continue
+            if len(r.bottom) != 1 or len(r.top) != 1:
+                continue
+            if float(getattr(r.relu_param, "negative_slope", 0.0) or 0.0):
+                continue
+            if any(float(w) for w in r.loss_weight):
+                continue
+            rtop = r.top[0]
+            consumers = [(j, lp) for j, lp in enumerate(out)
+                         if lp is not None and j > i and rtop in lp.bottom]
+            if len(consumers) != 1:
+                continue
+            j, nl = consumers[0]
+            if (nl.type != "LRN" or len(nl.bottom) != 1
+                    or nl.lrn_param.norm_region
+                    != NormRegion.ACROSS_CHANNELS):
+                continue
+            fused = LayerParameter.from_binary(nl.to_binary())
+            fused.bottom = [r.bottom[0]]
+            out[j] = fused
+            out[i] = None
+            self.fused_relu_lrn.add(nl.name)
+        return [lp for lp in out if lp is not None]
 
     # ------------------------------------------------------------------
     def init(self, key: Array) -> Params:
@@ -387,7 +442,8 @@ class Net:
             train = self.state.phase == Phase.TRAIN
         blobs: Dict[str, Array] = dict(inputs)
         ctx = L.Ctx(train=train, rng=rng,
-                    state_in=net_state or {}, state_out={})
+                    state_in=net_state or {}, state_out={},
+                    fused_relu_lrn=frozenset(self.fused_relu_lrn))
         cast = (self.compute_dtype != self.dtype)
         for lp in self.compute_layers:
             op = L.get_op(lp.type)

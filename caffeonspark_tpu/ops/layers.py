@@ -49,6 +49,9 @@ class Ctx:
     state_in: Dict[str, List[Array]] = field(default_factory=dict)
     state_out: Dict[str, List[Array]] = field(default_factory=dict)
     layer_name: str = ""
+    # LRN layer names whose op applies relu in-kernel (net.py's
+    # COS_FUSE_RELU_LRN peephole)
+    fused_relu_lrn: frozenset = frozenset()
 
     def take_rng(self) -> Array:
         assert self.rng is not None, "layer needs rng but none provided"
@@ -606,12 +609,19 @@ def _lrn(ctx, lp, params, bottoms):
     x = bottoms[0]
     n = int(p.local_size)
     alpha, beta, k = p.alpha, p.beta, p.k
+    # net.py's ReLU→LRN peephole routed the pre-activation here: apply
+    # relu in-kernel (pallas) or inline (XLA fallback) — identical
+    # semantics on every backend
+    fuse_relu = lp.name in ctx.fused_relu_lrn
     if p.norm_region == NormRegion.ACROSS_CHANNELS:
         from .pallas_kernels import lrn_across_channels, pallas_enabled
         if pallas_enabled() and x.ndim == 4:
             # fused VMEM-resident kernel on TPU, with a matching fused
             # VJP kernel so the training path stays on Pallas
-            return [lrn_across_channels(x, n, alpha, beta, k)]
+            return [lrn_across_channels(x, n, alpha, beta, k, False,
+                                        fuse_relu)]
+        if fuse_relu:
+            x = jnp.maximum(x, 0)
         sq = x * x
         pad = n // 2
         sqp = jnp.pad(sq, ((0, 0), (pad, pad), (0, 0), (0, 0)))

@@ -38,7 +38,7 @@ def _window_sum(v: jax.Array, pad: int) -> jax.Array:
 
 
 def _lrn_kernel_fwd_only(x_ref, o_ref, *, local_size: int, alpha: float,
-                         beta: float, k: float):
+                         beta: float, k: float, fuse_relu: bool):
     """The one forward kernel (train AND eval): no scale residual.
     The backward kernel recomputes the denominators from x — a few VPU
     ops on a block already resident in VMEM — instead of storing an
@@ -49,26 +49,43 @@ def _lrn_kernel_fwd_only(x_ref, o_ref, *, local_size: int, alpha: float,
     Math runs in f32 regardless of the I/O dtype: in mixed (bf16)
     training, scale = 1 + (α/n)·Σx² computed in bf16 (eps ≈ 8e-3)
     rounds away most of the normalizer's significant digits.  The
-    upcast lives in VMEM, so HBM traffic is unchanged."""
+    upcast lives in VMEM, so HBM traffic is unchanged.
+
+    fuse_relu computes lrn(max(x, 0)) on the pre-activation input:
+    XLA cannot fuse a producer into an opaque pallas call, so a
+    separate ReLU→LRN chain materializes BOTH the relu output (the
+    kernel's residual) and — for the relu mask — keeps the
+    pre-activation live too.  Fused, the only residual is the
+    pre-activation x and the mask is recomputed in VMEM (net.py's
+    relu+lrn peephole, COS_FUSE_RELU_LRN)."""
     x = x_ref[0].astype(jnp.float32)
+    if fuse_relu:
+        x = jnp.maximum(x, 0.0)
     pad = local_size // 2
     scale = k + (alpha / local_size) * _window_sum(x * x, pad)
     o_ref[0] = (x * jnp.exp(-beta * jnp.log(scale))).astype(o_ref.dtype)
 
 
 def _lrn_bwd_kernel(x_ref, dy_ref, dx_ref, *, local_size: int,
-                    alpha: float, beta: float, k: float):
+                    alpha: float, beta: float, k: float,
+                    fuse_relu: bool):
     """dx = dy·s^{-β} − (2αβ/n)·x·Σ_{i∈W} dy_i·x_i·s_i^{-β-1}, with
     s recomputed in-VMEM from x in f32 (bit-identical to the
-    forward's: same block, same op order, same upcast)."""
-    x = x_ref[0].astype(jnp.float32)
+    forward's: same block, same op order, same upcast).  With
+    fuse_relu the LRN gradient flows through max(x,0) and the mask
+    zeroes dx where x < 0 — also recomputed in VMEM."""
+    xr = x_ref[0].astype(jnp.float32)
     dy = dy_ref[0].astype(jnp.float32)
+    x = jnp.maximum(xr, 0.0) if fuse_relu else xr
     pad = local_size // 2
     s = k + (alpha / local_size) * _window_sum(x * x, pad)
     s_nb = jnp.exp(-beta * jnp.log(s))        # s^{-β}
     u = dy * x * s_nb / s                      # dy·x·s^{-β-1}
-    dx_ref[0] = (dy * s_nb - (2.0 * alpha * beta / local_size) * x
-                 * _window_sum(u, pad)).astype(dx_ref.dtype)
+    dx = dy * s_nb - (2.0 * alpha * beta / local_size) * x \
+        * _window_sum(u, pad)
+    if fuse_relu:
+        dx = jnp.where(xr > 0.0, dx, 0.0)
+    dx_ref[0] = dx.astype(dx_ref.dtype)
 
 
 def _pad_flat(x):
@@ -86,11 +103,12 @@ def _block_spec(c):
                         memory_space=pltpu.VMEM)
 
 
-def _lrn_fwd_call(x, local_size, alpha, beta, k, interpret):
+def _lrn_fwd_call(x, local_size, alpha, beta, k, interpret, fuse_relu):
     n, c, h, w = x.shape
     xf, hw, padded = _pad_flat(x)
     kern = functools.partial(_lrn_kernel_fwd_only, local_size=local_size,
-                             alpha=alpha, beta=beta, k=k)
+                             alpha=alpha, beta=beta, k=k,
+                             fuse_relu=fuse_relu)
     out = pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((n, c, padded), x.dtype),
@@ -102,31 +120,37 @@ def _lrn_fwd_call(x, local_size, alpha, beta, k, interpret):
     return out[:, :, :hw].reshape(n, c, h, w)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
 def lrn_across_channels(x: jax.Array, local_size: int = 5,
                         alpha: float = 1e-4, beta: float = 0.75,
                         k: float = 1.0,
-                        interpret: bool = False) -> jax.Array:
-    """(N, C, H, W) → LRN, Caffe semantics (alpha/local_size).
+                        interpret: bool = False,
+                        fuse_relu: bool = False) -> jax.Array:
+    """(N, C, H, W) → LRN, Caffe semantics (alpha/local_size); with
+    fuse_relu, lrn(relu(x)) in one pass (see the kernel docstring).
     Differentiable: a second fused kernel computes the exact VJP,
-    recomputing the denominators in VMEM from the saved input — the
-    only residual is x itself, so training adds zero extra HBM
-    traffic over inference."""
-    return _lrn_fwd_call(x, local_size, alpha, beta, k, interpret)
+    recomputing the denominators (and relu mask) in VMEM from the
+    saved input — the only residual is x itself, so training adds
+    zero extra HBM traffic over inference."""
+    return _lrn_fwd_call(x, local_size, alpha, beta, k, interpret,
+                         fuse_relu)
 
 
-def _lrn_vjp_fwd(x, local_size, alpha, beta, k, interpret):
-    out = _lrn_fwd_call(x, local_size, alpha, beta, k, interpret)
+def _lrn_vjp_fwd(x, local_size, alpha, beta, k, interpret, fuse_relu):
+    out = _lrn_fwd_call(x, local_size, alpha, beta, k, interpret,
+                        fuse_relu)
     return out, x
 
 
-def _lrn_vjp_bwd(local_size, alpha, beta, k, interpret, res, dy):
+def _lrn_vjp_bwd(local_size, alpha, beta, k, interpret, fuse_relu, res,
+                 dy):
     x = res
     n, c, h, w = x.shape
     xf, hw, padded = _pad_flat(x)
     dyf, _, _ = _pad_flat(dy)
     kern = functools.partial(_lrn_bwd_kernel, local_size=local_size,
-                             alpha=alpha, beta=beta, k=k)
+                             alpha=alpha, beta=beta, k=k,
+                             fuse_relu=fuse_relu)
     dx = pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((x.shape[0], c, padded), x.dtype),

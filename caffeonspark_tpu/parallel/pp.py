@@ -368,7 +368,12 @@ class PipelineSolver:
             def stage_fn(sparams, acts, rng, *, _names=tuple(names),
                          _out=tuple(sorted(self.stage_out[s]))):
                 blobs = dict(acts)
-                ctx = L.Ctx(train=True, rng=rng)
+                # thread the net's ReLU→LRN fusion set: a bare Ctx
+                # would silently drop the fused relu from pipeline
+                # training (the LRN op keys fuse_relu off this set)
+                ctx = L.Ctx(train=True, rng=rng,
+                            fused_relu_lrn=frozenset(
+                                getattr(net, "fused_relu_lrn", ())))
                 for nme in _names:
                     lp = by_name[nme]
                     op = L.get_op(lp.type)

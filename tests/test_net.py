@@ -723,3 +723,69 @@ def test_caffenet_shapes():
     assert bs["pool5"] == (b, 256, 6, 6)
     assert bs["fc6"] == (b, 4096)
     assert bs["fc8"] == (b, 1000)
+
+
+_FUSE_NET = """
+name: "fuse"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 2 dim: 6 dim: 5 dim: 5 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "norm1" type: "LRN" bottom: "conv1" top: "norm1"
+  lrn_param { local_size: 3 alpha: 0.05 beta: 0.75 } }
+layer { name: "ip" type: "InnerProduct" bottom: "norm1" top: "ip"
+  inner_product_param { num_output: 4
+    weight_filler { type: "xavier" } } }"""
+
+
+def test_relu_lrn_peephole_matches_unfused(monkeypatch):
+    """COS_FUSE_RELU_LRN=1 drops the eligible ReLU and routes the
+    pre-activation into the fused LRN op — identical outputs and
+    gradients on the XLA fallback path (the interpret-mode kernel
+    parity is test_lrn_pallas_fused_relu_matches_unfused)."""
+    np_ = NetParameter.from_text(_FUSE_NET)
+    key = jax.random.key(7)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 6, 5, 5),
+                    jnp.float32)
+
+    net_ref = Net(np_, NetState(phase=Phase.TRAIN))
+    p_ref = net_ref.init(key)
+    monkeypatch.setenv("COS_FUSE_RELU_LRN", "1")
+    net_fu = Net(np_, NetState(phase=Phase.TRAIN))
+    assert net_fu.fused_relu_lrn == {"norm1"}
+    assert [lp.name for lp in net_fu.compute_layers] == \
+        ["conv1", "norm1", "ip"]
+    # the source NetParameter must be untouched (other Nets build
+    # from it): the unfused net still has its relu
+    assert [lp.name for lp in net_ref.compute_layers] == \
+        ["conv1", "relu1", "norm1", "ip"]
+    p_fu = net_fu.init(key)
+
+    def out_sum(net, p):
+        blobs, _ = net.apply(p, {"data": x}, train=True,
+                             rng=jax.random.key(1))
+        return jnp.sum(blobs["ip"] ** 2)
+
+    np.testing.assert_allclose(float(out_sum(net_fu, p_fu)),
+                               float(out_sum(net_ref, p_ref)),
+                               rtol=1e-6)
+    g_ref = jax.grad(lambda p: out_sum(net_ref, p))(p_ref)
+    g_fu = jax.grad(lambda p: out_sum(net_fu, p))(p_fu)
+    for ln in g_ref:
+        for br, bf in zip(g_ref[ln].values(), g_fu[ln].values()):
+            np.testing.assert_allclose(np.asarray(bf), np.asarray(br),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_relu_lrn_peephole_skips_shared_relu(monkeypatch):
+    """A relu top with a second consumer must NOT fuse."""
+    txt = _FUSE_NET + """
+layer { name: "ip2" type: "InnerProduct" bottom: "conv1" top: "ip2"
+  inner_product_param { num_output: 3
+    weight_filler { type: "xavier" } } }"""
+    monkeypatch.setenv("COS_FUSE_RELU_LRN", "1")
+    net = Net(NetParameter.from_text(txt), NetState(phase=Phase.TRAIN))
+    assert net.fused_relu_lrn == set()
+    assert any(lp.name == "relu1" for lp in net.compute_layers)

@@ -60,6 +60,31 @@ def test_lrn_pallas_grad_matches_xla(shape):
                                rtol=3e-4, atol=3e-5)
 
 
+def test_lrn_pallas_fused_relu_matches_unfused():
+    """fuse_relu=True must equal relu → lrn, forward AND grad (the
+    grad includes the relu mask recomputed in the bwd kernel)."""
+    import jax
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(2, 8, 5, 7).astype(np.float32) * 2)
+    dy = jnp.asarray(rng.randn(2, 8, 5, 7).astype(np.float32))
+
+    def f_ref(x):
+        return jnp.sum(_xla_lrn(jax.nn.relu(x), alpha=0.05) * dy)
+
+    def f_fused(x):
+        return jnp.sum(
+            lrn_across_channels(x, 5, 0.05, 0.75, 1.0, True, True) * dy)
+
+    np.testing.assert_allclose(
+        np.asarray(lrn_across_channels(x, 5, 0.05, 0.75, 1.0, True,
+                                       True)),
+        np.asarray(_xla_lrn(jax.nn.relu(x), alpha=0.05)),
+        rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(f_fused)(x)), np.asarray(jax.grad(f_ref)(x)),
+        rtol=3e-4, atol=3e-5)
+
+
 def test_lrn_pallas_bf16_io_f32_normalizer():
     """Mixed-precision training feeds the kernel bf16 activations; the
     normalizer must still be computed in f32.  In bf16 (eps ~ 8e-3)

@@ -877,3 +877,53 @@ def test_mha_sp_mesh_routes_through_fused_ring(monkeypatch):
     assert calls_fl and all(f == "interpret" for f in calls_fl), calls_fl
     assert np.isfinite(l_fl).all(), l_fl
     np.testing.assert_allclose(l_fl, l_ref, rtol=5e-4)
+
+
+def test_pipeline_respects_relu_lrn_fusion(monkeypatch):
+    """COS_FUSE_RELU_LRN=1 + PipelineSolver: the stage fns must thread
+    the net's fusion set into their Ctx — a bare Ctx silently drops
+    the fused relu (normalizing raw pre-activations) with no error.
+    Pinned by training a relu→lrn net fused-pipelined vs unfused
+    single-device."""
+    net_txt = """
+name: "fuselrn"
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 4 channels: 1 height: 12 width: 12 } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 6 kernel_size: 3
+    weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "norm1" type: "LRN" bottom: "conv1" top: "norm1"
+  lrn_param { local_size: 3 alpha: 0.05 } }
+layer { name: "ip2" type: "InnerProduct" bottom: "norm1" top: "ip2"
+  inner_product_param { num_output: 10
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
+  bottom: "label" top: "loss" }"""
+    from caffeonspark_tpu.parallel import PipelineSolver
+    sp = SolverParameter.from_text(SOLVER)
+    npm = NetParameter.from_text(net_txt)
+    rs = np.random.RandomState(5)
+    batch = {"data": rs.rand(4, 1, 12, 12).astype(np.float32),
+             "label": (rs.rand(4) * 10 // 1).astype(np.float32)}
+
+    s1 = Solver(sp, npm)          # unfused single-device reference
+    p1, st1 = s1.init()
+    step1 = s1.jit_train_step()
+
+    monkeypatch.setenv("COS_FUSE_RELU_LRN", "1")
+    s2 = Solver(sp, npm)
+    assert s2.train_net.fused_relu_lrn == {"norm1"}
+    pipe = PipelineSolver(s2, num_stages=2, num_microbatches=2)
+    p2, st2 = pipe.init()
+    step2 = pipe.train_step()
+    mbs = pipe.split_microbatches(
+        {k: jnp.asarray(v) for k, v in batch.items()})
+    for i in range(2):
+        rng = s1.step_rng(i)
+        p1, st1, out1 = step1(p1, st1,
+                              {k: jnp.asarray(v)
+                               for k, v in batch.items()}, rng)
+        p2, st2, out2 = step2(p2, st2, mbs, rng)
+        assert float(out2["loss"]) == pytest.approx(
+            float(out1["loss"]), rel=2e-4), i
