@@ -20,6 +20,7 @@ train_state/test_state stages.
 from __future__ import annotations
 
 import math
+import os
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -294,10 +295,12 @@ class Net:
         # longer a materialized blob — for an in-place relu the name
         # then holds the PRE-activation, so feature extraction of that
         # blob changes meaning.
-        import os as _os
-        self.fused_relu_lrn: set = set()
-        if _os.environ.get("COS_FUSE_RELU_LRN") == "1":
-            self.compute_layers = self._fuse_relu_lrn(self.compute_layers)
+        self.fused_relu_lrn: frozenset = frozenset()
+        if os.environ.get("COS_FUSE_RELU_LRN") == "1":
+            fused: set = set()
+            self.compute_layers = self._fuse_relu_lrn(
+                self.compute_layers, fused)
+            self.fused_relu_lrn = frozenset(fused)
 
         # --- shape inference + param spec construction -------------------
         blob_shapes: Dict[str, Tuple[int, ...]] = {
@@ -323,7 +326,7 @@ class Net:
             dummy_bottoms = [jax.ShapeDtypeStruct(s, dtype) for s in bshapes]
             ctx = L.Ctx(train=self.state.phase == Phase.TRAIN,
                         rng=jax.random.key(0), layer_name=lp.name,
-                        fused_relu_lrn=frozenset(self.fused_relu_lrn))
+                        fused_relu_lrn=self.fused_relu_lrn)
             tops = jax.eval_shape(
                 lambda p, b, lp=lp, op=op, ctx=ctx: op.apply(ctx, lp, p, b),
                 dummy_params, dummy_bottoms)
@@ -359,15 +362,16 @@ class Net:
                     self.loss_weights[t] = w
 
     # ------------------------------------------------------------------
-    def _fuse_relu_lrn(self, layers: List[LayerParameter]
+    def _fuse_relu_lrn(self, layers: List[LayerParameter], fused: set
                        ) -> List[LayerParameter]:
         """Replace eligible [ReLU, LRN] pairs with one LRN layer whose
         op applies relu in-kernel (see __init__).  Eligible: plain relu
         (negative_slope 0, no loss weight, 1 bottom / 1 top) whose top
         is consumed by exactly one later layer, an ACROSS_CHANNELS LRN.
         The LRN entry is a deep copy (the source NetParameter may build
-        other Nets); its name is recorded in self.fused_relu_lrn, which
-        Net.apply threads to the op through Ctx."""
+        other Nets); its name is added to `fused` (becomes
+        self.fused_relu_lrn, which Net.apply threads to the op through
+        Ctx)."""
         from .proto.caffe import NormRegion
         out: List[Optional[LayerParameter]] = list(layers)
         for i, r in enumerate(out):
@@ -389,11 +393,11 @@ class Net:
                     or nl.lrn_param.norm_region
                     != NormRegion.ACROSS_CHANNELS):
                 continue
-            fused = LayerParameter.from_binary(nl.to_binary())
-            fused.bottom = [r.bottom[0]]
-            out[j] = fused
+            fused_lp = LayerParameter.from_binary(nl.to_binary())
+            fused_lp.bottom = [r.bottom[0]]
+            out[j] = fused_lp
             out[i] = None
-            self.fused_relu_lrn.add(nl.name)
+            fused.add(nl.name)
         return [lp for lp in out if lp is not None]
 
     # ------------------------------------------------------------------
@@ -443,7 +447,7 @@ class Net:
         blobs: Dict[str, Array] = dict(inputs)
         ctx = L.Ctx(train=train, rng=rng,
                     state_in=net_state or {}, state_out={},
-                    fused_relu_lrn=frozenset(self.fused_relu_lrn))
+                    fused_relu_lrn=self.fused_relu_lrn)
         cast = (self.compute_dtype != self.dtype)
         for lp in self.compute_layers:
             op = L.get_op(lp.type)

@@ -372,8 +372,7 @@ class PipelineSolver:
                 # would silently drop the fused relu from pipeline
                 # training (the LRN op keys fuse_relu off this set)
                 ctx = L.Ctx(train=True, rng=rng,
-                            fused_relu_lrn=frozenset(
-                                getattr(net, "fused_relu_lrn", ())))
+                            fused_relu_lrn=net.fused_relu_lrn)
                 for nme in _names:
                     lp = by_name[nme]
                     op = L.get_op(lp.type)
