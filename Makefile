@@ -61,7 +61,6 @@ onchip-artifacts:
 	-COS_CONV_LAYOUT=NHWC $(PY) bench.py
 	-COS_REMAT=mxu $(PY) bench.py
 	-COS_REMAT=1 $(PY) bench.py
-	-COS_FUSE_RELU_LRN=1 $(PY) bench.py
 	-BENCH_PIPELINE=1 $(PY) bench.py
 	-BENCH_PIPELINE=1 COS_DEVICE_TRANSFORM=1 $(PY) bench.py
 	-mkdir -p bench_evidence && $(PY) scripts/profile_segments.py 256 \

@@ -95,27 +95,31 @@ def main():
     results["conv1-s2d(model path)"] = timeit(
         "conv1-s2d(model path)",
         fwd_bwd(lambda x, w: _s2d_conv(x, w, 4, 11, 11, 0, 0)), x0, w1)
+    # bvlc_reference order is conv -> relu -> POOL -> NORM (LRN runs on
+    # the post-pool tensor; earlier revisions of this script modeled
+    # relu->lrn->pool, i.e. LRN at 55x55, which the real net never does)
     a1 = t((N, 96, 55, 55))
-    results["relu+lrn+pool@55x96"] = timeit(
-        "relu+lrn+pool@55x96",
-        fwd_bwd(lambda x: maxpool(lrn(jax.nn.relu(x)))), a1)
-    # sub-segment breakdown of the dominant stage (which op owns it?)
+    results["relu+pool+lrn@stage1"] = timeit(
+        "relu+pool+lrn@stage1",
+        fwd_bwd(lambda x: lrn(maxpool(jax.nn.relu(x)))), a1)
+    # sub-segment breakdown of the stage (which op owns it?)
     results["  relu-only@55x96"] = timeit(
         "  relu-only@55x96", fwd_bwd(jax.nn.relu), a1)
-    results["  lrn-only@55x96"] = timeit(
-        "  lrn-only@55x96", fwd_bwd(lrn), a1)
     results["  pool-only@55x96"] = timeit(
         "  pool-only@55x96", fwd_bwd(maxpool), a1)
-    # stage 2: 27x27x96 -> conv2 5x5 pad2 g2 -> 256 -> relu,lrn,pool -> 13
+    a1p = t((N, 96, 27, 27))
+    results["  lrn-only@27x96"] = timeit(
+        "  lrn-only@27x96", fwd_bwd(lrn), a1p)
+    # stage 2: 27x27x96 -> conv2 5x5 pad2 g2 -> 256 -> relu,pool,norm -> 13
     a2 = t((N, 96, 27, 27))
     w2 = t((256, 48, 5, 5))
     results["conv2(5x5p2g2,96->256)"] = timeit(
         "conv2(5x5p2g2,96->256)",
         fwd_bwd(lambda x, w: conv(x, w, 1, 2, 2)), a2, w2)
     a3 = t((N, 256, 27, 27))
-    results["relu+lrn+pool@27x256"] = timeit(
-        "relu+lrn+pool@27x256",
-        fwd_bwd(lambda x: maxpool(lrn(jax.nn.relu(x)))), a3)
+    results["relu+pool+lrn@stage2"] = timeit(
+        "relu+pool+lrn@stage2",
+        fwd_bwd(lambda x: lrn(maxpool(jax.nn.relu(x)))), a3)
     # stage 3-5 convs at 13x13
     a4 = t((N, 256, 13, 13))
     w3 = t((384, 256, 3, 3))
