@@ -69,6 +69,8 @@ onchip-artifacts:
 	-BENCH_MODEL=lstm $(PY) bench.py
 	-BENCH_MODEL=vgg16 $(PY) bench.py
 	-BENCH_MODEL=googlenet $(PY) bench.py
+	-BENCH_MODEL=alexnet $(PY) bench.py
+	-COS_FUSE_RELU_LRN=1 BENCH_MODEL=alexnet $(PY) bench.py
 	-$(PY) scripts/bench_attention.py
 
 docs:

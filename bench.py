@@ -662,8 +662,9 @@ def worker(mode):
                       "unit": "ms", "chip": chip}), flush=True)
 
     model = os.environ.get("BENCH_MODEL", "caffenet")
-    default_batch = {"caffenet": 256, "resnet50": 64, "vgg16": 64,
-                     "googlenet": 128, "lstm": 64}.get(model, 64)
+    default_batch = {"caffenet": 256, "alexnet": 256, "resnet50": 64,
+                     "vgg16": 64, "googlenet": 128,
+                     "lstm": 64}.get(model, 64)
     batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
     iters = int(os.environ.get("BENCH_ITERS", "50"))
     pipeline = os.environ.get("BENCH_PIPELINE") == "1"

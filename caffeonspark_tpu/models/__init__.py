@@ -1,4 +1,4 @@
 """Model zoo (LeNet, CaffeNet, ...) as programmatic NetParameters."""
 
-from .zoo import (caffenet, googlenet, lenet, resnet50, transformer_lm,
-                  vgg16)
+from .zoo import (alexnet, caffenet, googlenet, lenet, resnet50,
+                  transformer_lm, vgg16)

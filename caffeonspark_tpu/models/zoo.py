@@ -115,6 +115,69 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "fc8" bottom: "label"
     return parse_net_prototxt(t)
 
 
+def alexnet(batch_size: int = 64, num_classes: int = 1000,
+            crop: int = 227) -> NetParameter:
+    """Original bvlc_alexnet (Krizhevsky 2012 order: **norm before
+    pool**, unlike bvlc_reference_net/CaffeNet which pools first).
+    Same parameter shapes as caffenet(); the relu→norm adjacency makes
+    this the zoo family where the COS_FUSE_RELU_LRN peephole fires
+    (norm1/norm2) — and the 55×55/27×27 pre-pool LRN extents make it
+    the LRN-heaviest workload in the zoo."""
+    t = f"""
+name: "AlexNet"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param {{ batch_size: {batch_size} channels: 3
+    height: {crop} width: {crop} }} }}
+"""
+    t += _CONV.format(name="conv1", bottom="data", n=96, k=11,
+                      extra="stride: 4", std=0.01, bias=0)
+    t += """
+layer { name: "norm1" type: "LRN" bottom: "conv1" top: "norm1"
+  lrn_param { local_size: 5 alpha: 0.0001 beta: 0.75 } }
+layer { name: "pool1" type: "Pooling" bottom: "norm1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+"""
+    t += _CONV.format(name="conv2", bottom="pool1", n=256, k=5,
+                      extra="pad: 2 group: 2", std=0.01, bias=1)
+    t += """
+layer { name: "norm2" type: "LRN" bottom: "conv2" top: "norm2"
+  lrn_param { local_size: 5 alpha: 0.0001 beta: 0.75 } }
+layer { name: "pool2" type: "Pooling" bottom: "norm2" top: "pool2"
+  pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+"""
+    t += _CONV.format(name="conv3", bottom="pool2", n=384, k=3,
+                      extra="pad: 1", std=0.01, bias=0)
+    t += _CONV.format(name="conv4", bottom="conv3", n=384, k=3,
+                      extra="pad: 1 group: 2", std=0.01, bias=1)
+    t += _CONV.format(name="conv5", bottom="conv4", n=256, k=3,
+                      extra="pad: 1 group: 2", std=0.01, bias=1)
+    t += """
+layer { name: "pool5" type: "Pooling" bottom: "conv5" top: "pool5"
+  pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+"""
+    t += _FC.format(name="fc6", bottom="pool5", n=4096, std=0.005, bias=1)
+    t += """
+layer { name: "relu6" type: "ReLU" bottom: "fc6" top: "fc6" }
+layer { name: "drop6" type: "Dropout" bottom: "fc6" top: "fc6"
+  dropout_param { dropout_ratio: 0.5 } }
+"""
+    t += _FC.format(name="fc7", bottom="fc6", n=4096, std=0.005, bias=1)
+    t += """
+layer { name: "relu7" type: "ReLU" bottom: "fc7" top: "fc7" }
+layer { name: "drop7" type: "Dropout" bottom: "fc7" top: "fc7"
+  dropout_param { dropout_ratio: 0.5 } }
+"""
+    t += _FC.format(name="fc8", bottom="fc7", n=num_classes, std=0.01,
+                    bias=0)
+    t += """
+layer { name: "accuracy" type: "Accuracy" bottom: "fc8" bottom: "label"
+  top: "accuracy" include { phase: TEST } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "fc8" bottom: "label"
+  top: "loss" }
+"""
+    return parse_net_prototxt(t)
+
+
 def lenet(batch_size: int = 64) -> NetParameter:
     npm = parse_net_prototxt(LENET)
     for lyr in npm.layer:

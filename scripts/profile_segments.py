@@ -84,7 +84,7 @@ def main():
 
     N = BATCH
     results = {}
-    # stage 1: data 227 -> conv1 11x11s4 -> 55x55x96 -> relu,lrn,pool -> 27
+    # stage 1: data 227 -> conv1 11x11s4 -> 55x55x96 -> relu,POOL,NORM -> 27
     x0 = t((N, 3, 227, 227))
     w1 = t((96, 3, 11, 11))
     results["conv1(11x11s4,3->96)"] = timeit(
@@ -111,7 +111,7 @@ def main():
     results["  lrn-only@27x96"] = timeit(
         "  lrn-only@27x96", fwd_bwd(lrn), a1p)
     # stage 2: 27x27x96 -> conv2 5x5 pad2 g2 -> 256 -> relu,pool,norm -> 13
-    a2 = t((N, 96, 27, 27))
+    a2 = a1p          # same shape as the lrn-only input: share the tensor
     w2 = t((256, 48, 5, 5))
     results["conv2(5x5p2g2,96->256)"] = timeit(
         "conv2(5x5p2g2,96->256)",

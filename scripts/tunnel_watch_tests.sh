@@ -5,7 +5,7 @@
 # flash-kernel Mosaic fixes (10/11 green) and the cross-extent ring
 # precision fix (individually re-run on chip: PASSED) but re-wedged
 # before a full green suite artifact landed.  This watcher camps for
-# the NEXT window(s) to capture four goals, each tracked by a marker
+# the NEXT window(s) to capture five goals, each tracked by a marker
 # so a window that dies mid-list leaves the remaining goals armed:
 #   1. a green TPU_TESTS_r05.json (all 11 gated tests incl. the fixed
 #      cross-extent ring and the residual-free f32-internal LRN bwd)
@@ -15,6 +15,8 @@
 #      (scripts/bench_attention.py: flash vs XLA at T=1024/2048/4096)
 #   4. the corrected per-segment profile (REAL layer order: pool
 #      before norm; the first profile modeled LRN at pre-pool extents)
+#   5. zoo.alexnet (original norm-before-pool order) baseline + the
+#      COS_FUSE_RELU_LRN A/B — the family where the peephole fires
 # ALL chip touches — including the liveness probe and the TCP diag —
 # run under /tmp/cos_tpu.lock so a manual session and the watcher
 # never contend for the single chip (the 06:48 suite timeout was
@@ -29,8 +31,8 @@ MARK=/tmp/cos_r5b
 cd "$(dirname "$0")/.."
 n=0
 while true; do
-  if [ -f "$MARK.tests" ] && [ -f "$MARK.bench" ] && [ -f "$MARK.attn" ] && [ -f "$MARK.prof" ]; then
-    echo "all four goals captured — watcher done" >> "$LOG"
+  if [ -f "$MARK.tests" ] && [ -f "$MARK.bench" ] && [ -f "$MARK.attn" ] && [ -f "$MARK.prof" ] && [ -f "$MARK.alex" ]; then
+    echo "all five goals captured — watcher done" >> "$LOG"
     exit 0
   fi
   n=$((n + 1))
@@ -77,17 +79,36 @@ print('TPU alive:', ds)
         timeout 900 python scripts/bench_attention.py && touch "$MARK.attn"
       fi
       if [ -f "$MARK.attn" ] && [ ! -f "$MARK.prof" ]; then
-        echo "post-LRN-fix per-segment profile (with per-op sub-rows)"
+        echo "corrected-order per-segment profile (per-op sub-rows)"
         timeout 900 python scripts/profile_segments.py 256 \
           | tee bench_evidence/profile_segments_b256_postlrn.txt \
           && touch "$MARK.prof"
       fi
+      if [ -f "$MARK.prof" ] && [ ! -f "$MARK.alex" ]; then
+        echo "AlexNet (norm-before-pool) baseline + relu-lrn-fusion A/B"
+        # per-run sub-markers: a retry window re-runs only the leg
+        # that has not yet dropped its own bundle
+        if [ ! -f "$MARK.alex_base" ]; then
+          n0=$(ls bench_evidence/*.json 2>/dev/null | wc -l)
+          BENCH_MODEL=alexnet timeout 700 python bench.py
+          n1=$(ls bench_evidence/*.json 2>/dev/null | wc -l)
+          [ "$n1" -gt "$n0" ] && touch "$MARK.alex_base"
+        fi
+        if [ -f "$MARK.alex_base" ] && [ ! -f "$MARK.alex_fused" ]; then
+          n0=$(ls bench_evidence/*.json 2>/dev/null | wc -l)
+          COS_FUSE_RELU_LRN=1 BENCH_MODEL=alexnet timeout 700 python bench.py
+          n1=$(ls bench_evidence/*.json 2>/dev/null | wc -l)
+          [ "$n1" -gt "$n0" ] && touch "$MARK.alex_fused"
+        fi
+        [ -f "$MARK.alex_base" ] && [ -f "$MARK.alex_fused" ] \
+          && touch "$MARK.alex"
+      fi
     ' >> "$LOG" 2>&1
-    if [ -f "$MARK.tests" ] && [ -f "$MARK.bench" ] && [ -f "$MARK.attn" ] && [ -f "$MARK.prof" ]; then
+    if [ -f "$MARK.tests" ] && [ -f "$MARK.bench" ] && [ -f "$MARK.attn" ] && [ -f "$MARK.prof" ] && [ -f "$MARK.alex" ]; then
       echo "all goals captured — watcher done" >> "$LOG"
       exit 0
     fi
-    echo "goals remaining (prof=$([ -f $MARK.prof ] && echo y || echo n) tests=$([ -f $MARK.tests ] && echo y || echo n) bench=$([ -f $MARK.bench ] && echo y || echo n) attn=$([ -f $MARK.attn ] && echo y || echo n)) — resuming camp" >> "$LOG"
+    echo "goals remaining (alex=$([ -f $MARK.alex ] && echo y || echo n) prof=$([ -f $MARK.prof ] && echo y || echo n) tests=$([ -f $MARK.tests ] && echo y || echo n) bench=$([ -f $MARK.bench ] && echo y || echo n) attn=$([ -f $MARK.attn ] && echo y || echo n)) — resuming camp" >> "$LOG"
   else
     flock /tmp/cos_tpu.lock python -c "from bench import _tunnel_diag; print('diag:', _tunnel_diag())" >> "$LOG" 2>&1
   fi

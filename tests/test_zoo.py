@@ -199,3 +199,23 @@ def test_lstm_lm_trains():
         params, st, out = step(params, st, inp, s.step_rng(i))
         losses.append(float(out["loss"]))
     assert losses[-1] < 0.25 * losses[0], (losses[0], losses[-1])
+
+
+def test_alexnet_params_and_fusion(monkeypatch):
+    """Original-order AlexNet: same published parameter count as
+    CaffeNet (the two differ only in norm/pool order), norm runs at
+    the PRE-pool extents, and the ReLU→LRN peephole fires on exactly
+    norm1/norm2 when enabled."""
+    from caffeonspark_tpu.models import alexnet
+    net = Net(alexnet(batch_size=8))
+    assert net.num_params() == 60_965_224
+    assert net.blob_shapes["norm1"] == (8, 96, 55, 55)
+    assert net.blob_shapes["norm2"] == (8, 256, 27, 27)
+    assert net.blob_shapes["fc8"] == (8, 1000)
+    assert net.fused_relu_lrn == frozenset()
+    monkeypatch.setenv("COS_FUSE_RELU_LRN", "1")
+    fused = Net(alexnet(batch_size=8))
+    assert fused.fused_relu_lrn == {"norm1", "norm2"}
+    assert not any(lp.name in ("relu_conv1", "relu_conv2")
+                   for lp in fused.compute_layers)
+    assert fused.blob_shapes["fc8"] == (8, 1000)
