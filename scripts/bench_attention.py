@@ -81,13 +81,25 @@ def main():
         }
         row = {"t": t}
         for name, fn in impls.items():
-            stepj = make(fn)
-            tc = time.perf_counter()
-            np.asarray(jax.device_get(stepj(q, k, v)))  # compile+warm
-            compile_s = time.perf_counter() - tc
-            t0 = time.perf_counter()
-            np.asarray(jax.device_get(stepj(q, k, v)))
-            dt = (time.perf_counter() - t0) / iters
+            # per-leg isolation: the XLA leg materializes the full
+            # (B,H,T,T) score/softmax tensors — at T=4096 that is
+            # multi-GB and may OOM where flash's O(block·T) does not.
+            # A dead reference leg must not kill the flash rows.
+            try:
+                stepj = make(fn)
+                tc = time.perf_counter()
+                np.asarray(jax.device_get(stepj(q, k, v)))  # compile+warm
+                compile_s = time.perf_counter() - tc
+                t0 = time.perf_counter()
+                np.asarray(jax.device_get(stepj(q, k, v)))
+                dt = (time.perf_counter() - t0) / iters
+            except Exception as e:  # noqa: BLE001
+                row[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+                print(json.dumps({"metric":
+                                  f"attention_causal_t{t}_{name}",
+                                  "error": row[name]["error"]}),
+                      flush=True)
+                continue
             tflops = flops_step / dt / 1e12
             rec = {
                 "metric": f"attention_causal_t{t}_{name}",
@@ -105,10 +117,13 @@ def main():
             row[name] = {"ms": round(dt * 1e3, 3),
                          "tflops": round(tflops, 2)}
             print(json.dumps(rec), flush=True)
-        if "xla" in row and "flash" in row:
+        if ("ms" in row.get("xla", {})) and ("ms" in row.get("flash", {})):
             row["speedup"] = round(row["xla"]["ms"] / row["flash"]["ms"], 3)
         results.append(row)
     print(json.dumps({"summary": results}), flush=True)
+    # the flash legs are the point; a missing flash row is a failure
+    if not all("ms" in r.get("flash", {}) for r in results):
+        sys.exit(1)
 
 
 if __name__ == "__main__":
