@@ -7,7 +7,7 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: build native install test spark-test bench smoke tpu-tests \
-  bench-evidence onchip-artifacts docs clean
+  bench-evidence bench-ingest onchip-artifacts docs clean
 
 build: native install
 
@@ -30,6 +30,13 @@ spark-test:
 
 bench:
 	$(PY) bench.py
+
+# inline vs pipelined ingest comparison on CPU; JSON artifact with
+# per-stage (queue-wait / pack / stage / step) timings
+bench-ingest:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_ingest.py --quick \
+	  --out bench_evidence/bench_ingest_quick.json
 
 smoke:
 	BENCH_SMOKE=1 $(PY) bench.py

@@ -1,8 +1,10 @@
 """Data sources, transformers, and readers (LMDB, SequenceFile, Parquet)."""
 
 from .lmdb_io import LmdbReader, LmdbWriter
+from .queue_runner import (DROPPED, FeedQueue, PipelinedFeed,
+                           TransformerPool, device_prefetch)
 from .sequencefile import SequenceFileReader, SequenceFileWriter
 from .source import (LMDB, DataSource, ImageDataFrame, SeqImageDataSource,
                      STOP_MARK, datum_to_record, get_source,
                      register_source)
-from .transformer import Transformer, load_mean_file
+from .transformer import AugDraw, Transformer, load_mean_file

@@ -6,16 +6,23 @@ Reference semantics preserved (SURVEY §2.2):
   * STOP_MARK sentinel ends an epoch (`CaffeProcessor.scala:205`);
   * `feedQueue` spins `offer` until the solver completes — device→task
     backpressure (`CaffeProcessor.scala:192-198`);
+  * transformer threads decode/augment while the device computes
+    (`transform_thread_per_device`, `CaffeProcessor.scala:54-55`) —
+    here `TransformerPool`, an ORDERED multi-threaded pack pool;
   * double-buffered transformer→solver handoff (QueuePair depth 2,
-    `CaffeProcessor.scala:32-35`) — here a device-prefetch depth of 2:
-    while the TPU runs step N, batch N+1 is already transferring H2D.
+    `CaffeProcessor.scala:32-35`) — here `device_prefetch`, optionally
+    with a background stager thread so the H2D transfer and the jitted
+    device-transform dispatch also leave the solver thread.
 """
 
 from __future__ import annotations
 
 import collections
+import logging
+import os
 import queue
 import threading
+import time
 from typing import Callable, Dict, Iterator, Optional
 
 import jax
@@ -23,7 +30,67 @@ import numpy as np
 
 from .source import STOP_MARK
 
+_LOG = logging.getLogger(__name__)
+
 SOURCE_QUEUE_CAPACITY = 1024
+
+# consecutive pack failures that abort the pipeline (systematic
+# data/config error) — one constant for both the standalone pool's
+# default policy and CaffeProcessor.MAX_CONSECUTIVE_DROPS
+DROP_LIMIT_DEFAULT = 20
+
+# ordered-slot marker for a batch the pool dropped after a pack error
+# (corrupt record): the slot still advances the sequence so validation
+# rounds can count it, train consumers skip it
+DROPPED = object()
+
+_END = object()          # worker/stager shutdown sentinel
+
+
+def transform_threads(default: int = 2) -> int:
+    """Transformer-pool width per processor (COS_TRANSFORM_THREADS;
+    0 = inline legacy path: pack on the solver thread)."""
+    try:
+        return max(0, int(os.environ.get("COS_TRANSFORM_THREADS",
+                                         str(default))))
+    except ValueError:
+        return default
+
+
+def stage_depth(default: int = 2) -> int:
+    """Background-stager handoff depth (COS_STAGE_DEPTH)."""
+    try:
+        return max(1, int(os.environ.get("COS_STAGE_DEPTH",
+                                         str(default))))
+    except ValueError:
+        return default
+
+
+def stage_background(default: Optional[bool] = None) -> bool:
+    """Run the device stager on its own thread?  Default: only on
+    accelerator backends, where H2D rides a DMA engine and host cores
+    are free to run the stager.  On the CPU backend every device op
+    (device_put included) funnels through jax's single async dispatch
+    executor, so a stager thread adds scheduler/handoff latency without
+    adding bandwidth — staging stays on the consumer thread there.
+    COS_STAGE_BG=0/1 overrides."""
+    env = os.environ.get("COS_STAGE_BG")
+    if env is not None:
+        return env.lower() not in ("0", "", "false", "no")
+    if default is not None:
+        return default
+    return jax.default_backend() != "cpu"
+
+
+def tune_decode_threads(src, pool_width: int):
+    """Under a multi-worker transformer pool, inter-batch parallelism
+    replaces the native decoder's intra-batch thread pool: N workers
+    each spawning the decoder's default ncores threads oversubscribes
+    the host (measured 2.6x slower packs on a 2-core box).  Pin
+    per-call decode to one thread unless the caller set num_threads
+    explicitly."""
+    if pool_width > 1 and getattr(src, "num_threads", None) == 0:
+        src.num_threads = 1
 
 
 class FeedQueue:
@@ -34,18 +101,34 @@ class FeedQueue:
         self._stopped = False
 
     def offer(self, item, timeout: Optional[float] = None) -> bool:
-        """Blocking put with backpressure; returns False if stopped."""
+        """Put with backpressure; returns False if stopped or the
+        deadline expires.  timeout=None blocks until space (polling in
+        short slices so stop() stays responsive); a numeric timeout is
+        a real deadline for the WHOLE call — including timeout=0, a
+        single non-blocking attempt."""
         if self._stopped:
             return False
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         while True:
+            if self._stopped:
+                return False
+            if deadline is None:
+                wait = 0.1
+            else:
+                wait = deadline - time.monotonic()
+                if wait <= 0:
+                    try:
+                        self._q.put_nowait(item)
+                        return True
+                    except queue.Full:
+                        return False
+                wait = min(0.1, wait)
             try:
-                self._q.put(item, timeout=timeout or 0.1)
+                self._q.put(item, timeout=wait)
                 return True
             except queue.Full:
-                if self._stopped:
-                    return False
-                if timeout is not None:
-                    return False
+                continue
 
     def reset(self):
         """Re-arm a stopped queue (processor restart) and drop leftovers."""
@@ -57,10 +140,15 @@ class FeedQueue:
                 return
 
     def mark_epoch_end(self):
-        self._q.put(STOP_MARK)
+        self.offer(STOP_MARK)
 
     def take(self, timeout: Optional[float] = None):
-        return self._q.get(timeout=timeout) if timeout else self._q.get()
+        """Blocking get; a numeric timeout (INCLUDING 0) raises
+        queue.Empty on expiry instead of falling into the forever-
+        blocking branch."""
+        if timeout is None:
+            return self._q.get()
+        return self._q.get(timeout=timeout)
 
     def stop(self):
         self._stopped = True
@@ -77,37 +165,364 @@ class FeedQueue:
         return self._q.qsize()
 
 
-def batch_iterator(feed: FeedQueue, batch_size: int,
-                   pack: Callable) -> Iterator[Dict[str, np.ndarray]]:
-    """Drain a FeedQueue into packed batches; one epoch per STOP_MARK."""
-    buf = []
-    while True:
-        item = feed.take()
-        if item is STOP_MARK:
-            if buf:
-                yield pack(buf)
+class TransformerPool:
+    """Ordered multi-threaded decode/augment/pack pool — the
+    transform_thread_per_device analog (`CaffeProcessor.scala:54-55`)
+    that takes host transform work off the solver thread.
+
+    One dispatcher thread drains `feed`, groups records into
+    batch-sized buffers (STOP_MARK drops the ragged epoch tail, a
+    `None` record terminates the pool), pre-draws the per-batch
+    augmentation via `draw_fn` IN FEED ORDER (so on clean data the
+    pool reproduces the inline path's RNG stream exactly), and hands
+    (seq, buffer, draw) to `num_threads` workers calling
+    `pack(buffer, draw)`.  Output is re-sequenced: `take()`/iteration
+    yields batches in feed order regardless of worker scheduling, with
+    exactly one terminal condition per pool.  The pre-draw happens at
+    dispatch, so a batch whose pack later FAILS has still consumed the
+    RNG — on dirty data the pooled stream diverges from the inline
+    path after the first drop (deliberate: drawing after decode would
+    serialize the workers, and the reference's per-thread transformer
+    RNGs never had cross-path parity at all).
+
+    Pack failures follow the reference's per-iteration tolerance: the
+    slot becomes DROPPED (skipped by train consumers, countable by
+    validation), drop accounting is thread-safe, and `drop_limit`
+    consecutive failures abort the pipeline (the error re-raises from
+    `take()`).  `on_pack_ok`/`on_pack_error` externalize the counters
+    (CaffeProcessor shares one counter across train + validation);
+    an `on_pack_error` that raises aborts the pool the same way.
+    """
+
+    def __init__(self, feed: FeedQueue, batch_size: int,
+                 pack: Callable, *, num_threads: int = 2,
+                 draw_fn: Optional[Callable] = None,
+                 on_pack_ok: Optional[Callable] = None,
+                 on_pack_error: Optional[Callable] = None,
+                 drop_limit: int = DROP_LIMIT_DEFAULT,
+                 depth: Optional[int] = None,
+                 metrics=None,
+                 should_stop: Optional[Callable[[], bool]] = None):
+        self.feed = feed
+        self.batch_size = int(batch_size)
+        self.pack = pack
+        self.num_threads = max(1, int(num_threads))
+        self.draw_fn = draw_fn
+        self.on_pack_ok = on_pack_ok
+        self.on_pack_error = on_pack_error
+        self.drop_limit = drop_limit
+        self.depth = depth if depth is not None else 2 * self.num_threads
+        self.metrics = metrics
+        self._ext_stop = should_stop or (lambda: False)
+        self._stopped = False
+        self._work: queue.Queue = queue.Queue(maxsize=max(1, self.depth))
+        # results window: bounded by construction (a worker blocks
+        # depositing seq >= next_emit + window), so a stalled consumer
+        # backpressures the whole pool instead of growing the dict
+        self._window = self.depth + self.num_threads
+        self._cond = threading.Condition()
+        self._results: Dict[int, object] = {}
+        self._next_emit = 0
+        self._in_seq: Optional[int] = None   # total batches dispatched
+        self._error: Optional[BaseException] = None
+        self._consecutive = 0
+        self.drops = 0
+        self._threads: list = []
+        self._started = False
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "TransformerPool":
+        assert not self._started, "pool already started"
+        self._started = True
+        d = threading.Thread(target=self._dispatch, daemon=True,
+                             name="cos-xform-dispatch")
+        self._threads.append(d)
+        for i in range(self.num_threads):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"cos-xform-{i}")
+            self._threads.append(t)
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self, join_timeout: Optional[float] = None):
+        """Flag every pool thread down; optionally reap them."""
+        self._stopped = True
+        with self._cond:
+            self._cond.notify_all()
+        if join_timeout is not None:
+            self.join(timeout=join_timeout)
+
+    def join(self, timeout: Optional[float] = None):
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        for t in self._threads:
+            t.join(timeout=None if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+
+    def _should_stop(self) -> bool:
+        # an abort (_error) halts the whole pipeline too: without it
+        # the dispatcher would keep draining records and workers would
+        # keep decoding doomed batches until the consumer reaches its
+        # teardown
+        return (self._stopped or self._error is not None
+                or self._ext_stop())
+
+    def _fail(self, exc: BaseException):
+        with self._cond:
+            if self._error is None:
+                self._error = exc
+            self._cond.notify_all()
+
+    # -- dispatcher: feed order, epoch boundaries, ordered draws --------
+    def _dispatch(self):
+        buf: list = []
+        seq = 0
+        try:
+            while not self._should_stop():
+                try:
+                    item = self.feed.take(timeout=0.2)
+                except queue.Empty:
+                    if self.feed.stopped:
+                        break
+                    continue
+                if item is None:
+                    break               # terminal sentinel
+                if item is STOP_MARK:
+                    # epoch boundary: drop the ragged tail
+                    if buf and self.metrics is not None:
+                        self.metrics.incr("ragged_tail_records",
+                                          len(buf))
+                    buf = []
+                    if self.feed.stopped:
+                        break           # stop()-wake, not an epoch
+                    continue
+                buf.append(item)
+                if len(buf) == self.batch_size:
+                    draw = (self.draw_fn(len(buf))
+                            if self.draw_fn is not None else None)
+                    if not self._put_work((seq, buf, draw)):
+                        return
+                    seq += 1
+                    buf = []
+        except BaseException as e:      # noqa: BLE001 — surfaced on take()
+            self._fail(e)
+        finally:
+            with self._cond:
+                self._in_seq = seq
+                self._cond.notify_all()
+            for _ in range(self.num_threads):
+                self._put_work(_END, force=True)
+
+    def _put_work(self, item, force: bool = False) -> bool:
+        while True:
+            if not force and self._should_stop():
+                return False
+            try:
+                self._work.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                if force and self._should_stop():
+                    # workers are exiting on their own stop checks;
+                    # don't spin on a full queue forever
+                    return False
+                continue
+
+    # -- workers: pack + thread-safe drop accounting --------------------
+    def _record_ok(self):
+        cb = self.on_pack_ok
+        if cb is not None:
+            cb()
             return
-        buf.append(item)
-        if len(buf) == batch_size:
-            yield pack(buf)
-            buf = []
+        with self._cond:
+            self._consecutive = 0
+
+    def _record_drop(self, exc: Exception):
+        with self._cond:
+            self.drops += 1
+        cb = self.on_pack_error
+        if cb is not None:
+            cb(exc)                     # may raise to abort the pool
+            return
+        if self.metrics is not None:
+            self.metrics.incr("dropped_batches")
+        _LOG.warning("dropping batch after record error: %s", exc)
+        with self._cond:
+            self._consecutive += 1
+            n = self._consecutive
+        if n >= self.drop_limit:
+            raise RuntimeError(
+                f"{n} consecutive batch failures — systematic "
+                f"data/config error; last: {exc}") from exc
+
+    def _worker(self):
+        while True:
+            try:
+                item = self._work.get(timeout=0.2)
+            except queue.Empty:
+                if self._should_stop():
+                    return
+                continue
+            if item is _END:
+                return
+            seq, buf, draw = item
+            t0 = time.perf_counter()
+            try:
+                batch = self.pack(buf, draw)
+            except Exception as e:      # pack failure → DROPPED slot
+                batch = DROPPED
+                try:
+                    self._record_drop(e)
+                except BaseException as abort:  # noqa: BLE001
+                    self._fail(abort)
+            else:
+                if self.metrics is not None:
+                    self.metrics.add("pack", time.perf_counter() - t0)
+                try:
+                    self._record_ok()
+                except BaseException as abort:  # noqa: BLE001
+                    self._fail(abort)
+            self._deposit(seq, batch)
+
+    def _deposit(self, seq: int, batch):
+        with self._cond:
+            while (self._error is None and not self._should_stop()
+                   and seq - self._next_emit >= self._window):
+                self._cond.wait(0.2)
+            self._results[seq] = batch
+            self._cond.notify_all()
+
+    # -- consumer -------------------------------------------------------
+    def take(self, timeout: Optional[float] = None, *,
+             skip_dropped: bool = True):
+        """Next packed batch in feed order.  Raises queue.Empty when
+        `timeout` expires, re-raises a pipeline abort, returns None
+        when the input is exhausted or the pool is stopping.  With
+        skip_dropped=False a pack-failed slot returns DROPPED (the
+        validation round counter needs the slot)."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cond:
+            while True:
+                if self._error is not None:
+                    raise self._error
+                if self._next_emit in self._results:
+                    batch = self._results.pop(self._next_emit)
+                    self._next_emit += 1
+                    self._cond.notify_all()
+                    if batch is DROPPED and skip_dropped:
+                        continue
+                    return batch
+                if (self._in_seq is not None
+                        and self._next_emit >= self._in_seq):
+                    return None          # input exhausted, all emitted
+                if self._should_stop():
+                    return None
+                if deadline is None:
+                    wait = 0.2
+                else:
+                    wait = deadline - time.monotonic()
+                    if wait <= 0:
+                        raise queue.Empty
+                    wait = min(0.2, wait)
+                self._cond.wait(wait)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            batch = self.take()
+            if batch is None:
+                return
+            yield batch
 
 
-def transformer_pool(feed: FeedQueue, batch_size: int, pack: Callable,
-                     out: "queue.Queue", num_threads: int = 1):
-    """Background transformer threads (transform_thread_per_device
-    analog, `CaffeProcessor.scala:54-55`): decode/augment off the
-    critical path while the device computes."""
-    def run():
-        for batch in batch_iterator(feed, batch_size, pack):
-            out.put(batch)
-        out.put(STOP_MARK)
+class PipelinedFeed:
+    """records → FeedQueue → TransformerPool for generator-based
+    callers (mini_cluster): a reader thread streams `src` records into
+    a bounded feed queue (one mark_epoch_end per epoch, shuffled at
+    TRAIN like DataSource.batches), the pool packs them off-thread.
+    Iterate for ordered batches; close() tears the threads down."""
 
-    threads = [threading.Thread(target=run, daemon=True)
-               for _ in range(num_threads)]
-    for t in threads:
-        t.start()
-    return threads
+    def __init__(self, src, *, loop: bool = True,
+                 shuffle: Optional[bool] = None, num_threads: int = 2,
+                 metrics=None,
+                 should_stop: Optional[Callable[[], bool]] = None,
+                 capacity: int = SOURCE_QUEUE_CAPACITY):
+        self._closed = False
+        ext = should_stop or (lambda: False)
+        self.feed = FeedQueue(capacity)
+        self._reader_error: dict = {}
+        do_shuffle = src.phase_train if shuffle is None else shuffle
+        tune_decode_threads(src, num_threads)
+
+        def read():
+            # NOTE: mirrors DataSource.batches()'s record loop (shuffle
+            # selection, empty-source guard, epoch counting, loop-True
+            # tail carry-over) — the pooled-vs-inline parity tests pin
+            # the two together; change them in lockstep.  Divergence is
+            # loop=False only: batches() yields the ragged tail as a
+            # short batch, the pool (fixed batch shapes) drops it.
+            epoch = 0
+            try:
+                while not self._closed and not ext():
+                    got_any = False
+                    records = (src.shuffled_records(epoch) if do_shuffle
+                               else src.records())
+                    for rec in records:
+                        got_any = True
+                        if not self.feed.offer(rec):
+                            return
+                    if not got_any:
+                        return
+                    if not loop:
+                        # single pass: the ragged tail can't form a
+                        # fixed-shape batch — drop it explicitly
+                        self.feed.mark_epoch_end()
+                        return
+                    # looping epochs stream CONTINUOUSLY, matching
+                    # DataSource.batches(loop=True): a partial tail
+                    # carries into the next epoch's records (no
+                    # STOP_MARK — with one, a rank whose shard is
+                    # smaller than batch_size would never form a batch
+                    # and the consumer would hang)
+                    epoch += 1
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                self._reader_error["e"] = e
+            finally:
+                self.feed.offer(None)   # terminal sentinel
+                self.feed.stop()
+
+        self.pool = TransformerPool(
+            self.feed, src.batch_size,
+            pack=src.pack_batch, draw_fn=src.make_draw_fn(),
+            num_threads=num_threads, metrics=metrics,
+            should_stop=lambda: self._closed or ext())
+        self.pool.start()
+        self._reader = threading.Thread(target=read, daemon=True,
+                                        name="cos-feed-reader")
+        self._reader.start()
+
+    def __iter__(self):
+        for batch in self.pool:
+            yield batch
+        err = self._reader_error.get("e")
+        if err is not None:
+            raise err
+
+    def close(self, join_timeout: Optional[float] = 2.0):
+        self._closed = True
+        self.feed.stop()
+        self.pool.stop(join_timeout=join_timeout)
+
+    def __del__(self):
+        # safety net for consumers that abandon iteration without
+        # close(): flag the reader/pool threads down so they don't
+        # busy-poll for the process lifetime (no join at GC time)
+        try:
+            self._closed = True
+            self.feed.stop()
+            self.pool.stop()
+        except Exception:               # noqa: BLE001 — interpreter exit
+            pass
 
 
 def combine_batches(batches: Iterator[Dict[str, np.ndarray]], k: int,
@@ -129,11 +544,33 @@ def combine_batches(batches: Iterator[Dict[str, np.ndarray]], k: int,
                 axis=1 if key in time_major else 0)
                 for key in buf[0]}
             buf = []
+    if buf:
+        # a short epoch's trailing partial group is discarded by design
+        # (static iter_size·B step shapes) — but say so, or it reads as
+        # lost data
+        _LOG.info(
+            "combine_batches: dropping %d trailing sub-batch(es) short "
+            "of an iter_size=%d group", len(buf), k)
+
+
+def _resolve_host_copy(host_copy: Optional[bool]) -> bool:
+    """Copy numpy buffers before device_put?  On the CPU backend
+    jax.device_put ALIASES aligned host buffers (zero-copy), so a
+    pooled/reused pack buffer mutated after staging would corrupt the
+    staged batch; accelerator backends copy H2D anyway.  Default: copy
+    on CPU only; COS_STAGE_COPY=0/1 overrides."""
+    if host_copy is not None:
+        return bool(host_copy)
+    env = os.environ.get("COS_STAGE_COPY")
+    if env is not None:
+        return env.lower() not in ("0", "", "false", "no")
+    return jax.default_backend() == "cpu"
 
 
 def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], *,
                     depth: int = 2, sharding=None,
-                    device_transforms=None
+                    device_transforms=None, background: bool = False,
+                    metrics=None, host_copy: Optional[bool] = None
                     ) -> Iterator[Dict[str, jax.Array]]:
     """Asynchronously stage `depth` batches onto the device (the
     double-buffered QueuePair analog). jax transfers are async: calling
@@ -146,16 +583,29 @@ def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], *,
     dispatched right behind the transfer so it overlaps like the
     transfer itself.  Tops without an aux key pass through untouched.
 
+    With `background=True` the staging itself (device_put dispatch +
+    jitted transform dispatch) runs on a dedicated stager thread with a
+    bounded handoff queue — the H2D path overlaps compute even when the
+    upstream producer (host pack) is slow, and the solver thread only
+    ever blocks on a ready-batch queue.  Closing the returned generator
+    stops the thread.
+
+    `host_copy` (see _resolve_host_copy) defends staged batches against
+    pack-buffer reuse on the aliasing CPU backend.
+
     Multi-host: when the mesh spans processes, each process's batch is
     its LOCAL shard of the global batch (per-device batch semantics —
     'batch sizes in prototxt files are per device'); the global array is
     assembled with make_array_from_process_local_data."""
     from .transformer import DEVICE_AUX_SUFFIX
-    buf = collections.deque()
     multiproc = jax.process_count() > 1
-    jitted = {k: jax.jit(fn) for k, fn in (device_transforms or {}).items()}
+    jitted = {k: jax.jit(fn)
+              for k, fn in (device_transforms or {}).items()}
+    copy_host = _resolve_host_copy(host_copy)
 
     def put_one(v, sh):
+        if copy_host and isinstance(v, np.ndarray):
+            v = np.array(v, copy=True)
         if sh is None:
             return jax.device_put(v)
         if multiproc:
@@ -184,9 +634,74 @@ def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], *,
                                     and aux is not None) else v
         return out
 
+    def timed_put(b):
+        t0 = time.perf_counter()
+        staged = put(b)
+        if metrics is not None:
+            metrics.add("stage", time.perf_counter() - t0)
+        return staged
+
+    if background:
+        return _background_stage(batches, timed_put, depth, metrics)
+    return _foreground_stage(batches, timed_put, depth)
+
+
+def _foreground_stage(batches, timed_put, depth):
+    buf = collections.deque()
     for b in batches:
-        buf.append(put(b))
+        buf.append(timed_put(b))
         if len(buf) > depth:
             yield buf.popleft()
     while buf:
         yield buf.popleft()
+
+
+def _background_stage(batches, timed_put, depth, metrics):
+    outq: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+    state: dict = {}
+
+    def run():
+        try:
+            for b in batches:
+                staged = timed_put(b)
+                if metrics is not None:
+                    metrics.gauge("stage_depth", outq.qsize())
+                while not stop.is_set():
+                    try:
+                        outq.put(staged, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:      # noqa: BLE001 — re-raised below
+            state["err"] = e
+        finally:
+            while not stop.is_set():
+                try:
+                    outq.put(_END, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+
+    def gen():
+        # lazy start: the thread exists only once the consumer actually
+        # iterates — a generator that is built but never driven (early
+        # exit between construction and the first next()) must not leak
+        # a stager spinning on a full handoff queue
+        t = threading.Thread(target=run, daemon=True, name="cos-stager")
+        t.start()
+        try:
+            while True:
+                item = outq.get()
+                if item is _END:
+                    err = state.get("err")
+                    if err is not None:
+                        raise err
+                    return
+                yield item
+        finally:
+            stop.set()
+
+    return gen()

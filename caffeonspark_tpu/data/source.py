@@ -24,7 +24,7 @@ import numpy as np
 from ..proto.caffe import Datum, LayerParameter
 from .lmdb_io import LmdbReader
 from .sequencefile import SequenceFileReader
-from .transformer import DEVICE_AUX_SUFFIX, Transformer
+from .transformer import AugDraw, DEVICE_AUX_SUFFIX, Transformer
 
 ImageRecord = Tuple[str, float, int, int, int, bool, bytes]
 
@@ -121,12 +121,15 @@ class DataSource:
         """Opaque partition descriptors for sharded reads (rank i of n)."""
         return list(range(n))
 
-    def next_batch(self, records: Sequence[ImageRecord]
+    def next_batch(self, records: Sequence[ImageRecord],
+                   draw: Optional[AugDraw] = None
                    ) -> Dict[str, np.ndarray]:
         """Pack + transform records into the data layer's blobs
         (ImageDataSource.nextBatch analog, `ImageDataSource.scala:99-163`).
         All-encoded batches take the native threaded JPEG path
-        (libcos_native, the jcaffe Mat/decode analog) when built."""
+        (libcos_native, the jcaffe Mat/decode analog) when built.
+        `draw` replays a pre-drawn augmentation (TransformerPool's
+        ordered-draw protocol) instead of consuming the RNG here."""
         c, h, w = self.image_dims()
         n = len(records)
         labels = np.asarray([r[1] for r in records], np.float32)
@@ -168,14 +171,42 @@ class DataSource:
                     f"payloads, but record {bad[0]!r} carries "
                     f"{bad[6].dtype} data — unset COS_DEVICE_TRANSFORM "
                     "for float-valued sources")
-            u8, aux = self.transformer.host_stage(data)
+            u8, aux = self.transformer.host_stage(data, draw=draw)
             batch = {out_names[0]: u8,
                      out_names[0] + DEVICE_AUX_SUFFIX: aux}
         else:
-            batch = {out_names[0]: self.transformer(data)}
+            batch = {out_names[0]: self.transformer(data, draw=draw)}
         if len(out_names) > 1:
             batch[out_names[1]] = labels
         return batch
+
+    # -- transformer-pool protocol ------------------------------------
+    def pack_batch(self, records: Sequence[ImageRecord],
+                   draw: Optional[AugDraw] = None
+                   ) -> Dict[str, np.ndarray]:
+        """next_batch with an optional ordered pre-draw — the callable
+        TransformerPool workers run.  Sources that override next_batch
+        (HDF5/DataFrame blob packing) never get a draw (make_draw_fn
+        returns None for them), so their signature stays untouched."""
+        if draw is None:
+            return self.next_batch(records)
+        return self.next_batch(records, draw=draw)
+
+    def make_draw_fn(self):
+        """Per-batch augmentation pre-draw `fn(n) -> AugDraw` for the
+        pool dispatcher, consuming the transformer RNG in FEED ORDER on
+        one thread so `num_threads > 1` packing reproduces the inline
+        path's augmentation stream.  None when this source packs its
+        own blobs or has no static image geometry — those pack without
+        a pre-draw (transformer draws under its own lock)."""
+        if type(self).next_batch is not DataSource.next_batch:
+            return None
+        try:
+            c, h, w = self.image_dims()
+        except Exception:       # noqa: BLE001 — geometry-less source
+            return None
+        t = self.transformer
+        return lambda n: t.draw(n, h, w)
 
     def enable_device_transform(self, net_dtype=None):
         """Opt in to the uint8-infeed transform split: when

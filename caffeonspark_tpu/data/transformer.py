@@ -17,7 +17,8 @@ Order of operations (matches Caffe Transform, data_transformer.cpp):
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import threading
+from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -26,6 +27,17 @@ from ..proto.caffe import BlobProto, TransformationParameter
 # batch-dict key suffix carrying the (N, 3) int32 [h_off, w_off, flip]
 # aux array of the device-transform split (see Transformer.host_stage)
 DEVICE_AUX_SUFFIX = "__devxf"
+
+
+class AugDraw(NamedTuple):
+    """One batch's pre-drawn augmentation: `offs` is (hs, ws) per-sample
+    crop offsets or None when no crop applies, `flip` the per-sample
+    mirror flags.  Produced by Transformer.draw() so a multi-threaded
+    pack pool can consume the RNG in feed order on ONE thread and hand
+    workers a fixed draw — the pooled pipeline then reproduces the
+    inline path's augmentation stream exactly."""
+    offs: Optional[Tuple[np.ndarray, np.ndarray]]
+    flip: np.ndarray
 
 
 def load_mean_file(path: str) -> np.ndarray:
@@ -52,6 +64,9 @@ class Transformer:
         self.tp = tp or TransformationParameter()
         self.train = phase_train
         self.rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        # np.RandomState is not safe under concurrent draws; draw()
+        # serializes consumers (pool dispatcher vs inline callers)
+        self._rng_lock = threading.Lock()
         self.mean: Optional[np.ndarray] = None
         if self.tp.has("mean_file") and self.tp.mean_file:
             import os
@@ -89,12 +104,27 @@ class Transformer:
             return self.rng.randint(0, 2, size=n).astype(bool)
         return np.zeros(n, bool)
 
-    def __call__(self, batch: np.ndarray) -> np.ndarray:
-        """batch: (N, C, H, W) float32 (raw 0..255 pixel scale)."""
+    def draw(self, n: int, h: int, w: int) -> AugDraw:
+        """Consume the RNG for one n-sample batch — crop offsets then
+        mirror flags, the exact order __call__/host_stage use — under a
+        lock, so a transformer-pool dispatcher can pre-draw batches in
+        feed order while workers pack concurrently."""
+        with self._rng_lock:
+            offs = self._draw_crop(n, h, w)
+            flip = self._draw_flip(n)
+        return AugDraw(offs, flip)
+
+    def __call__(self, batch: np.ndarray,
+                 draw: Optional[AugDraw] = None) -> np.ndarray:
+        """batch: (N, C, H, W) float32 (raw 0..255 pixel scale);
+        `draw` replays a pre-drawn augmentation instead of consuming
+        the RNG here (TransformerPool ordered-draw protocol)."""
         tp = self.tp
         n, c, h, w = batch.shape
         crop = int(tp.crop_size)
         out = batch
+        if draw is None:
+            draw = self.draw(n, h, w)
 
         # Caffe subtracts mean_file at the SOURCE index (data_index uses
         # h_off/w_off, mirror only remaps the destination) — equivalent
@@ -109,7 +139,7 @@ class Transformer:
         else:
             mean_done = True
 
-        offs = self._draw_crop(n, h, w)
+        offs = draw.offs
         if offs is not None:
             hs, ws = offs
             crop = int(tp.crop_size)
@@ -136,7 +166,7 @@ class Transformer:
                 m = m[:, hs0:hs0 + out.shape[2], ws0:ws0 + out.shape[3]]
             out = out - m[None]
 
-        flip = self._draw_flip(n)
+        flip = draw.flip
         if flip.any():
             out[flip] = out[flip, :, :, ::-1]
 
@@ -187,24 +217,28 @@ class Transformer:
         oh, ow = self.output_hw(in_h, in_w)
         return tuple(self.mean.shape[1:]) in {(in_h, in_w), (oh, ow)}
 
-    def host_stage(self, batch: np.ndarray):
+    def host_stage(self, batch: np.ndarray,
+                   draw: Optional[AugDraw] = None):
         """(N,C,H,W) integral-valued pixels -> (uint8 batch cropped +
         mirrored, aux int32 (N,3) of [h_off, w_off, flip]).  Crop and
-        flip come from the same _draw_crop/_draw_flip the host-only
-        path uses, so the two pipelines consume self.rng identically.
-        The byte moves run in the threaded native kernel
-        (cos_crop_mirror_u8) when built; numpy otherwise — identical
-        output either way (test_native.py parity)."""
+        flip come from the same draw() the host-only path uses (or a
+        pre-drawn AugDraw in the pooled pipeline), so the two pipelines
+        consume self.rng identically.  The byte moves run in the
+        threaded native kernel (cos_crop_mirror_u8) when built; numpy
+        otherwise — identical output either way (test_native.py
+        parity)."""
         n, c, h, w = batch.shape
         crop = int(self.tp.crop_size)
         u8 = batch.astype(np.uint8) if batch.dtype != np.uint8 else batch
-        offs = self._draw_crop(n, h, w)
+        if draw is None:
+            draw = self.draw(n, h, w)
+        offs = draw.offs
         if offs is not None:
             hs, ws = offs
         else:
             hs = np.zeros(n, np.int64)
             ws = np.zeros(n, np.int64)
-        flip = self._draw_flip(n)
+        flip = draw.flip
         aux = np.stack([hs, ws, flip.astype(np.int64)],
                        axis=1).astype(np.int32)
 

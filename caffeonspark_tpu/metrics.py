@@ -1,0 +1,185 @@
+"""Per-stage ingest/step timeline metrics for the pipelined runtime.
+
+The reference executor has no visibility into where a training step's
+wall-time goes (queue wait vs transform vs H2D vs solver); this module
+gives the TPU pipeline that visibility cheaply: lock-guarded ring
+buffers per stage, O(1) per sample, summarized on demand.
+
+Stage names used by the runtime:
+  queue_wait  solver thread blocked in next(gen) waiting for a batch
+  pack        transformer-pool decode/augment/pack of one batch
+  stage       device_put / make_array + device-transform dispatch (H2D)
+  step        jitted train-step call (on accelerators this is dispatch
+              wall-time — the async runtime returns before compute
+              finishes; per-step throughput comes from mark_step())
+
+Stages are NOT disjoint when staging (and, on the inline path, packing)
+runs synchronously inside next(gen): there queue_wait SUBSUMES the pack
+and stage samples recorded for the same batch, so per-stage totals can
+legitimately exceed wall-time.  They are disjoint in the fully
+pipelined configuration (pool + background stager), where queue_wait
+measures pure starvation.
+
+Counters (dropped batches, ragged-tail records) and gauges (queue
+depths, sampled each step) ride along in the same summary.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+_DEFAULT_CAPACITY = 8192
+
+
+class _Series:
+    """Total/count plus a bounded sample ring for percentiles."""
+
+    __slots__ = ("total", "count", "max", "_ring", "_cap", "_i")
+
+    def __init__(self, capacity: int):
+        self.total = 0.0
+        self.count = 0
+        self.max = 0.0
+        self._ring: List[float] = []
+        self._cap = capacity
+        self._i = 0
+
+    def add(self, v: float):
+        self.total += v
+        self.count += 1
+        if v > self.max:
+            self.max = v
+        if len(self._ring) < self._cap:
+            self._ring.append(v)
+        else:
+            self._ring[self._i] = v
+            self._i = (self._i + 1) % self._cap
+
+    def summary(self) -> Dict[str, float]:
+        s = sorted(self._ring)
+        n = len(s)
+
+        def pct(p):
+            return s[min(n - 1, int(p * n))] if n else 0.0
+
+        return {
+            "count": self.count,
+            "total_s": round(self.total, 6),
+            "mean_ms": round(1e3 * self.total / self.count, 4)
+            if self.count else 0.0,
+            "p50_ms": round(1e3 * pct(0.50), 4),
+            "p95_ms": round(1e3 * pct(0.95), 4),
+            "max_ms": round(1e3 * self.max, 4),
+        }
+
+
+class _Gauge:
+    """Sampled depth/level: count, mean, max."""
+
+    __slots__ = ("total", "count", "max")
+
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+        self.max = 0.0
+
+    def observe(self, v: float):
+        self.total += v
+        self.count += 1
+        if v > self.max:
+            self.max = v
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "samples": self.count,
+            "mean": round(self.total / self.count, 3) if self.count else 0.0,
+            "max": self.max,
+        }
+
+
+class PipelineMetrics:
+    """Thread-safe per-stage timeline: durations, counters, gauges, and
+    step timestamps for steady-state throughput."""
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._series: Dict[str, _Series] = {}
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, _Gauge] = {}
+        self._steps: List[float] = []
+        self._cap = capacity
+        self._step_i = 0
+        self._created = time.monotonic()
+
+    # -- recording (hot path: one lock, O(1)) ---------------------------
+    def add(self, stage: str, seconds: float):
+        with self._lock:
+            s = self._series.get(stage)
+            if s is None:
+                s = self._series[stage] = _Series(self._cap)
+            s.add(seconds)
+
+    def incr(self, name: str, n: int = 1):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float):
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = _Gauge()
+            g.observe(value)
+
+    def mark_step(self):
+        """Timestamp one completed solver step (throughput series)."""
+        with self._lock:
+            if len(self._steps) < self._cap:
+                self._steps.append(time.monotonic())
+            else:
+                self._steps[self._step_i] = time.monotonic()
+                self._step_i = (self._step_i + 1) % self._cap
+
+    # -- reading --------------------------------------------------------
+    def has_samples(self) -> bool:
+        with self._lock:
+            return bool(self._series or self._counters or self._steps)
+
+    def steady_steps_per_sec(self, skip: int = 5) -> Optional[float]:
+        """Throughput over the step timestamps with the first `skip`
+        (compile + cache warmup) steps discarded; None if too few."""
+        with self._lock:
+            if self._step_i:     # ring wrapped: chronological order
+                ts = self._steps[self._step_i:] + self._steps[:self._step_i]
+            else:
+                ts = list(self._steps)
+        ts = ts[skip:]
+        if len(ts) < 2 or ts[-1] <= ts[0]:
+            return None
+        return (len(ts) - 1) / (ts[-1] - ts[0])
+
+    def summary(self) -> dict:
+        with self._lock:
+            stages = {k: v.summary() for k, v in self._series.items()}
+            counters = dict(self._counters)
+            gauges = {k: v.summary() for k, v in self._gauges.items()}
+            nsteps = len(self._steps)
+        out = {
+            "stages": stages,
+            "counters": counters,
+            "queue_depths": gauges,
+            "steps": nsteps,
+            "uptime_s": round(time.monotonic() - self._created, 3),
+        }
+        sps = self.steady_steps_per_sec()
+        if sps is not None:
+            out["steady_steps_per_sec"] = round(sps, 3)
+        return out
+
+    def dump(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.summary(), f, indent=2, sort_keys=True)
+            f.write("\n")
+        return path

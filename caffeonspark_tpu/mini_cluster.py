@@ -25,6 +25,7 @@ import argparse
 import os
 import signal
 import sys
+import time
 
 
 def build_argparser() -> argparse.ArgumentParser:
@@ -70,6 +71,11 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("-metrics", dest="metrics", default=None,
                    help="append per-display-step JSONL records "
                    "(iter, loss, lr, steps/s, records/s) to this file")
+    p.add_argument("-pipeline_metrics", dest="pipeline_metrics",
+                   default=None,
+                   help="write the per-stage ingest timeline "
+                   "(queue-wait / pack / stage / step, queue depths) "
+                   "as JSON to this file at exit")
     p.add_argument("-dtype", dest="dtype", default="float32",
                    choices=["float32", "bfloat16", "mixed"],
                    help="float32 | bfloat16 (params+compute bf16) | "
@@ -268,12 +274,41 @@ class MiniCluster:
                             _vsh_for(k), v, global_shape=v.shape)
                         for k, v in b.items()}
         it = int(jax.device_get(st.iter))
-        from .data.queue_runner import combine_batches
+        from .data.queue_runner import (PipelinedFeed, combine_batches,
+                                        stage_background, stage_depth,
+                                        transform_threads)
+        from .metrics import PipelineMetrics
         tmajor = frozenset(
             n for n, _, kind in solver.train_net.input_specs
             if kind.endswith(":T"))
         dxf = src.enable_device_transform(solver.train_net.dtype)
-        batches_it = combine_batches(src.batches(loop=True),
+        # pipelined ingest: reader thread -> transformer pool packs off
+        # the step loop; COS_TRANSFORM_THREADS=0 restores the inline
+        # generator path
+        pmetrics = PipelineMetrics()
+        nthreads = transform_threads()
+        feed = None
+        if nthreads > 0:
+            feed = PipelinedFeed(src, loop=True, num_threads=nthreads,
+                                 metrics=pmetrics,
+                                 should_stop=lambda: self._stop)
+            raw_batches = iter(feed)
+        else:
+            def _timed_batches():
+                # inline path: record read + decode + transform all
+                # happen right here, serial with the step loop
+                it_ = src.batches(loop=True)
+                while True:
+                    t0 = time.perf_counter()
+                    try:
+                        b = next(it_)
+                    except StopIteration:
+                        return
+                    pmetrics.add("pack", time.perf_counter() - t0)
+                    yield b
+
+            raw_batches = _timed_batches()
+        batches_it = combine_batches(raw_batches,
                                      max(1, self.sp.iter_size), tmajor)
         if solver.train_net.dtype != jnp.float32:
             import ml_dtypes
@@ -288,9 +323,12 @@ class MiniCluster:
                            else v.astype(np_dtype) for k, v in b.items()}
 
             batches_it = _cast(batches_it)
-        gen = device_prefetch(batches_it, depth=2,
+        gen = device_prefetch(batches_it, depth=stage_depth(),
                               sharding=ps.input_shardings(),
-                              device_transforms=dxf)
+                              device_transforms=dxf,
+                              background=nthreads > 0
+                              and stage_background(),
+                              metrics=pmetrics)
         # each step consumes exactly one source batch (device_prefetch
         # shards it across dp; it does not multiply the record count)
         timer = StepTimer(batch_size=src.batch_size)
@@ -309,114 +347,141 @@ class MiniCluster:
         if die_once:
             r_, i_, die_marker = die_once.split(":", 2)
             die_rank, die_iter = int(r_), int(i_)
-        with profile_trace(self.args.profile):
-            while it < max_iter and not self._stop:
-                if fault_delay:
-                    import time
-                    time.sleep(fault_delay)
-                if (it == die_iter and (self.args.rank or 0) == die_rank
-                        and not os.path.exists(die_marker)):
-                    open(die_marker, "w").close()
-                    print(f"FAULT INJECTION: rank {die_rank} dying at "
-                          f"iter {it}", flush=True)
-                    os._exit(3)
-                batch = next(gen)
-                params, st, out = step(params, st, batch,
-                                       solver.step_rng(it))
-                it += 1
-                timer.tick()
-                if display and it % display == 0:
-                    loss = float(jax.device_get(out["loss"]))
-                    lr_now = float(jax.device_get(out["lr"]))
-                    smoothed = loss if smoothed is None else (
-                        0.9 * smoothed + 0.1 * loss)
-                    print(
-                        f"iter {it}/{max_iter} loss={loss:.4f} "
-                        f"(smoothed {smoothed:.4f}) "
-                        f"lr={lr_now:.6f} "
-                        f"[{timer.steps_per_sec:.1f} it/s, "
-                        f"{timer.records_per_sec:.0f} img/s]")
-                    if self.args.metrics and self._is_rank0:
-                        import json
-                        import time as _time
-                        with open(self.args.metrics, "a") as mf:
-                            mf.write(json.dumps(
-                                {"iter": it, "loss": round(loss, 6),
-                                 "smoothed": round(smoothed, 6),
-                                 "lr": lr_now,
-                                 "steps_per_sec": round(
-                                     timer.steps_per_sec, 2),
-                                 "records_per_sec": round(
-                                     timer.records_per_sec, 1),
-                                 "ts": _time.time()}) + "\n")
-                if interleave and it % test_interval == 0:
-                    for _ in range(test_iter):
-                        vb = val_src.apply_device_stage(
-                            _stage_val(next(val_gen)),
-                            None if val_multiproc else vsh)
-                        vout = eval_step(params, vb)
-                        # pre-reduce each output to a REPLICATED scalar
-                        # (jnp.mean all-reduces a dp-sharded blob): a
-                        # per-example top spanning other hosts' devices
-                        # cannot be device_get directly
-                        val_report.add_batch(
-                            {n: jnp.mean(vout[n]) for n in val_names})
-                    val_report.finish_round()
-                    if self._is_rank0:
-                        row = val_report.rounds[-1]
-                        print("validation iter %d: %s" % (
-                            it, " ".join(f"{n}={v:.4f}"
-                                         for n, v in row.items())),
-                            flush=True)
-                if (snap_every and it % snap_every == 0) \
-                        or self._want_snapshot:
-                    signalled = self._want_snapshot
-                    self._want_snapshot = False
-                    # ZeRO multi-host: every rank writes its own state
-                    # shard sidecar (checkpoint.py sharded-state notes);
-                    # rank 0 also writes the model + solverstate.  The
-                    # snap_every path hits the same `it` on every rank
-                    # (lockstep), so the sidecar set is consistent; a
-                    # SIGNAL-triggered snapshot is only consistent if
-                    # the operator signalled ALL ranks in the same
-                    # iteration window — restore fails loudly on a
-                    # partial sidecar set either way.
-                    sharded = checkpoint.state_is_sharded(st)
-                    if signalled and sharded:
-                        print("WARNING: signal-triggered snapshot with "
-                              "sharded (ZeRO) state — deliver the "
-                              "signal to every rank promptly or the "
-                              "sidecar set will be incomplete",
-                              file=sys.stderr)
-                    lockstep = bool(snap_every
-                                    and it % snap_every == 0)
-                    if not lockstep \
-                            and checkpoint.params_partitioned(params):
-                        # signal-only snapshot with cross-host tp/ep
-                        # params: the dense-export gather is a
-                        # COLLECTIVE — running it on just the
-                        # signalled rank would deadlock the cluster.
-                        # Skip; the next interval boundary snapshots
-                        # in lockstep.
-                        print("WARNING: signal-triggered snapshot "
-                              "skipped: params are partitioned across "
-                              "hosts and an unsynchronized gather "
-                              "would hang — wait for the next "
-                              "snapshot interval", file=sys.stderr)
-                        continue
-                    # multi-host tp/ep params: COLLECTIVE gather on
-                    # every rank (lockstep boundary) so rank 0 can
-                    # write the dense model; no-op otherwise
-                    export_p = checkpoint.gather_params_if_sharded(
-                        params)
-                    if self._is_rank0 or sharded:
-                        m, s = checkpoint.snapshot(
-                            solver.train_net, export_p, st, self.prefix,
-                            fmt=self.sp.snapshot_format,
-                            solver_type=solver.solver_type,
-                            write_main=self._is_rank0)
+        try:
+            with profile_trace(self.args.profile):
+                while it < max_iter and not self._stop:
+                    if fault_delay:
+                        time.sleep(fault_delay)
+                    if (it == die_iter and (self.args.rank or 0) == die_rank
+                            and not os.path.exists(die_marker)):
+                        open(die_marker, "w").close()
+                        print(f"FAULT INJECTION: rank {die_rank} dying at "
+                              f"iter {it}", flush=True)
+                        os._exit(3)
+                    t_wait = time.perf_counter()
+                    batch = next(gen)
+                    pmetrics.add("queue_wait",
+                                 time.perf_counter() - t_wait)
+                    t_step = time.perf_counter()
+                    params, st, out = step(params, st, batch,
+                                           solver.step_rng(it))
+                    it += 1
+                    pmetrics.add("step", time.perf_counter() - t_step)
+                    pmetrics.mark_step()
+                    timer.tick()
+                    if display and it % display == 0:
+                        loss = float(jax.device_get(out["loss"]))
+                        lr_now = float(jax.device_get(out["lr"]))
+                        smoothed = loss if smoothed is None else (
+                            0.9 * smoothed + 0.1 * loss)
+                        print(
+                            f"iter {it}/{max_iter} loss={loss:.4f} "
+                            f"(smoothed {smoothed:.4f}) "
+                            f"lr={lr_now:.6f} "
+                            f"[{timer.steps_per_sec:.1f} it/s, "
+                            f"{timer.records_per_sec:.0f} img/s]")
+                        if self.args.metrics and self._is_rank0:
+                            import json
+                            with open(self.args.metrics, "a") as mf:
+                                mf.write(json.dumps(
+                                    {"iter": it, "loss": round(loss, 6),
+                                     "smoothed": round(smoothed, 6),
+                                     "lr": lr_now,
+                                     "steps_per_sec": round(
+                                         timer.steps_per_sec, 2),
+                                     "records_per_sec": round(
+                                         timer.records_per_sec, 1),
+                                     "ts": time.time()}) + "\n")
+                    if interleave and it % test_interval == 0:
+                        for _ in range(test_iter):
+                            vb = val_src.apply_device_stage(
+                                _stage_val(next(val_gen)),
+                                None if val_multiproc else vsh)
+                            vout = eval_step(params, vb)
+                            # pre-reduce each output to a REPLICATED scalar
+                            # (jnp.mean all-reduces a dp-sharded blob): a
+                            # per-example top spanning other hosts' devices
+                            # cannot be device_get directly
+                            val_report.add_batch(
+                                {n: jnp.mean(vout[n]) for n in val_names})
+                        val_report.finish_round()
                         if self._is_rank0:
-                            print(f"snapshot → {m}")
+                            row = val_report.rounds[-1]
+                            print("validation iter %d: %s" % (
+                                it, " ".join(f"{n}={v:.4f}"
+                                             for n, v in row.items())),
+                                flush=True)
+                    if (snap_every and it % snap_every == 0) \
+                            or self._want_snapshot:
+                        signalled = self._want_snapshot
+                        self._want_snapshot = False
+                        # ZeRO multi-host: every rank writes its own state
+                        # shard sidecar (checkpoint.py sharded-state notes);
+                        # rank 0 also writes the model + solverstate.  The
+                        # snap_every path hits the same `it` on every rank
+                        # (lockstep), so the sidecar set is consistent; a
+                        # SIGNAL-triggered snapshot is only consistent if
+                        # the operator signalled ALL ranks in the same
+                        # iteration window — restore fails loudly on a
+                        # partial sidecar set either way.
+                        sharded = checkpoint.state_is_sharded(st)
+                        if signalled and sharded:
+                            print("WARNING: signal-triggered snapshot with "
+                                  "sharded (ZeRO) state — deliver the "
+                                  "signal to every rank promptly or the "
+                                  "sidecar set will be incomplete",
+                                  file=sys.stderr)
+                        lockstep = bool(snap_every
+                                        and it % snap_every == 0)
+                        if not lockstep \
+                                and checkpoint.params_partitioned(params):
+                            # signal-only snapshot with cross-host tp/ep
+                            # params: the dense-export gather is a
+                            # COLLECTIVE — running it on just the
+                            # signalled rank would deadlock the cluster.
+                            # Skip; the next interval boundary snapshots
+                            # in lockstep.
+                            print("WARNING: signal-triggered snapshot "
+                                  "skipped: params are partitioned across "
+                                  "hosts and an unsynchronized gather "
+                                  "would hang — wait for the next "
+                                  "snapshot interval", file=sys.stderr)
+                            continue
+                        # multi-host tp/ep params: COLLECTIVE gather on
+                        # every rank (lockstep boundary) so rank 0 can
+                        # write the dense model; no-op otherwise
+                        export_p = checkpoint.gather_params_if_sharded(
+                            params)
+                        if self._is_rank0 or sharded:
+                            m, s = checkpoint.snapshot(
+                                solver.train_net, export_p, st, self.prefix,
+                                fmt=self.sp.snapshot_format,
+                                solver_type=solver.solver_type,
+                                write_main=self._is_rank0)
+                            if self._is_rank0:
+                                print(f"snapshot → {m}")
+        finally:
+            # stop the ingest threads whatever happens (a step failure
+            # must not leak a reader/pool/stager still decoding at full
+            # speed), then land the step-timeline artifact — partial
+            # runs are exactly when it matters
+            try:
+                gen.close()
+            except Exception:           # noqa: BLE001
+                pass
+            if feed is not None:
+                feed.close()
+            if self._is_rank0 and self.args.pipeline_metrics \
+                    and pmetrics.has_samples():
+                try:
+                    pmetrics.dump(self.args.pipeline_metrics)
+                    print(f"pipeline metrics → "
+                          f"{self.args.pipeline_metrics}")
+                except OSError as e:
+                    # a bad -pipeline_metrics path must not mask the
+                    # real training error propagating through here
+                    print(f"WARNING: could not write pipeline "
+                          f"metrics: {e}", file=sys.stderr)
         if self._is_rank0:
             print(timer.summary())
             if interleave and val_report.rounds:

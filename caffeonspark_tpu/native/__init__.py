@@ -126,6 +126,11 @@ def _bind(lib) -> None:
 
 
 def available() -> bool:
+    """COS_NATIVE=0 forces the cv2/numpy fallback — on few-core hosts
+    cv2's SIMD decode beats libjpeg (see module docstring), and an
+    ingest pool supplies its own inter-batch parallelism."""
+    if os.environ.get("COS_NATIVE", "").lower() in ("0", "false", "no"):
+        return False
     return get_lib() is not None
 
 

@@ -16,10 +16,12 @@ barrier.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import queue
 import threading
+import time
 from typing import (Any, Dict, Iterator, List, Optional,
                     Sequence, Tuple)
 
@@ -29,8 +31,12 @@ _LOG = logging.getLogger(__name__)
 
 from . import checkpoint
 from .config import Config
-from .data.queue_runner import FeedQueue, device_prefetch
+from .data.queue_runner import (DROP_LIMIT_DEFAULT, DROPPED, FeedQueue,
+                                TransformerPool, device_prefetch,
+                                stage_background, stage_depth,
+                                transform_threads, tune_decode_threads)
 from .data.source import STOP_MARK, DataSource
+from .metrics import PipelineMetrics
 from .parallel import ParallelSolver, build_mesh
 from .solver import Solver
 
@@ -127,6 +133,13 @@ class CaffeProcessor:
         self.dropped_batches = 0      # driver reads this to re-sync feeds
         self.dropped_val_batches = 0  # informational (round shrinks)
         self._consecutive_drops = 0
+        self._consecutive_val_drops = 0
+        # pack runs on pool worker threads while validation packs on
+        # the solver thread: all drop accounting shares one lock
+        self._drop_lock = threading.Lock()
+        self.metrics = PipelineMetrics()  # step-timeline (stop() dumps)
+        self._train_pool: Optional[TransformerPool] = None
+        self._val_pool: Optional[TransformerPool] = None
         self._snapshotter = None      # lazy AsyncSnapshotter (-async_snapshot)
         self._val_shardings = None    # set when the val feed splits
         self.params = None
@@ -161,6 +174,8 @@ class CaffeProcessor:
         self._init_params()
         for q in self.queues:       # re-arm after a previous run stopped
             q.reset()
+        self._train_pool = None     # _run_train builds fresh pools
+        self._val_pool = None
         self._thread = threading.Thread(target=self._run_train,
                                         daemon=True)
         self._thread.start()
@@ -196,11 +211,29 @@ class CaffeProcessor:
                 self._snapshotter.wait(timeout=600)
             except BaseException as e:      # noqa: BLE001
                 snap_err = e                # must not mask train error
+        self._dump_metrics()
         CaffeProcessor._instance = None
         if self._error is not None:
             raise self._error
         if snap_err is not None:
             raise snap_err
+
+    def _dump_metrics(self):
+        """Step-timeline dump at shutdown: one INFO line always, plus a
+        JSON artifact when COS_PIPELINE_METRICS names a path."""
+        m = self.metrics
+        if not m.has_samples():
+            return
+        summary = m.summary()
+        _LOG.info("pipeline metrics: %s",
+                  json.dumps(summary, sort_keys=True))
+        path = os.environ.get("COS_PIPELINE_METRICS")
+        if path:
+            try:
+                m.dump(path)
+            except OSError as e:
+                _LOG.warning("could not write pipeline metrics to "
+                             "%s: %s", path, e)
 
     def join(self):
         if self._thread is not None:
@@ -232,37 +265,60 @@ class CaffeProcessor:
                     yield batch
                 buf = []
 
-    MAX_CONSECUTIVE_DROPS = 20
+    MAX_CONSECUTIVE_DROPS = DROP_LIMIT_DEFAULT
 
-    def _pack_or_drop(self, src, buf, *, val: bool = False):
-        """Pack a batch; a bad record (corrupt JPEG, shape mismatch)
-        drops the batch with a warning and training continues — the
-        reference's per-iteration failure tolerance
-        (CaffeProcessor.scala:449-451).  A run of consecutive failures
-        means a systematic config error and aborts instead of spinning
-        forever.  Train and validation drops are counted separately:
-        only TRAIN drops make the driver top up the train feed (a
-        dropped validation batch already advanced the round counter,
-        so topping up train records for it would skew the cadence)."""
-        try:
-            batch = src.next_batch(buf)
-            self._consecutive_drops = 0
-            return batch
-        except Exception as e:
-            self._consecutive_drops += 1
+    def _note_pack_ok(self, *, val: bool = False):
+        with self._drop_lock:
             if val:
+                self._consecutive_val_drops = 0
+            else:
+                self._consecutive_drops = 0
+
+    def _note_pack_drop(self, e: Exception, *, val: bool = False):
+        """Thread-safe drop accounting shared by the transformer pool's
+        workers and the inline validation pack — the reference's
+        per-iteration failure tolerance (CaffeProcessor.scala:449-451).
+        A run of consecutive failures means a systematic config error
+        and aborts (raises) instead of spinning forever.  Train and
+        validation keep SEPARATE consecutive counters: the pools pack
+        concurrently, and a healthy train feed must not keep resetting
+        the streak of a systematically failing validation source (or
+        vice versa).  Drop totals are also separate: only TRAIN drops
+        make the driver top up the train feed (a dropped validation
+        batch already advanced the round counter, so topping up train
+        records for it would skew the cadence)."""
+        with self._drop_lock:
+            if val:
+                self._consecutive_val_drops += 1
+                consecutive = self._consecutive_val_drops
                 self.dropped_val_batches += 1
             else:
+                self._consecutive_drops += 1
+                consecutive = self._consecutive_drops
                 self.dropped_batches += 1
-            _LOG.warning("dropping batch after record error: %s", e)
-            if self._consecutive_drops >= self.MAX_CONSECUTIVE_DROPS:
-                raise RuntimeError(
-                    f"{self._consecutive_drops} consecutive batch "
-                    f"failures — systematic data/config error; last: "
-                    f"{e}") from e
+        self.metrics.incr("dropped_val_batches" if val
+                          else "dropped_batches")
+        _LOG.warning("dropping batch after record error: %s", e)
+        if consecutive >= self.MAX_CONSECUTIVE_DROPS:
+            raise RuntimeError(
+                f"{consecutive} consecutive batch failures — "
+                f"systematic data/config error; last: {e}") from e
+
+    def _pack_or_drop(self, src, buf, *, val: bool = False):
+        """Inline pack with the drop policy (validation rounds and the
+        COS_TRANSFORM_THREADS=0 legacy train path)."""
+        t0 = time.perf_counter()
+        try:
+            batch = src.next_batch(buf)
+        except Exception as e:
+            self._note_pack_drop(e, val=val)   # raises at the limit
             return None
+        self.metrics.add("pack", time.perf_counter() - t0)
+        self._note_pack_ok(val=val)
+        return batch
 
     def _run_train(self):
+        gen = None
         try:
             import jax
             solver, ps = self.solver, self.psolver
@@ -294,16 +350,66 @@ class CaffeProcessor:
                         solver.test_net.dtype):
                     self._val_shardings = ps.input_shardings(
                         solver.test_net)
+            # pipelined ingest (the tentpole): a threaded transformer
+            # pool packs batches off the solver thread, and the device
+            # stager (H2D + jitted device-transform dispatch) runs on
+            # its own background thread — the solver thread only ever
+            # waits on ready, staged batches.  COS_TRANSFORM_THREADS=0
+            # keeps the legacy inline path (pack + stage on the solver
+            # thread).
+            nthreads = transform_threads()
+            src = self.train_source
+            if nthreads > 0 and src is not None:
+                tune_decode_threads(src, nthreads)
+                self._train_pool = TransformerPool(
+                    self.queues[0], src.batch_size,
+                    pack=src.pack_batch, draw_fn=src.make_draw_fn(),
+                    num_threads=nthreads,
+                    on_pack_ok=self._note_pack_ok,
+                    on_pack_error=lambda e: self._note_pack_drop(e),
+                    metrics=self.metrics,
+                    should_stop=lambda: self._stopped).start()
+                batches = iter(self._train_pool)
+            else:
+                batches = self._train_batches()
+            if (nthreads > 0 and self.interleave_validation
+                    and self.val_source is not None
+                    and eval_step is not None):
+                vsrc = self.val_source
+                # one pack worker: validation packs ahead between
+                # rounds and is off the latency-critical path — extra
+                # threads would only pressure the train pool
+                self._val_pool = TransformerPool(
+                    self.queues[1], vsrc.batch_size,
+                    pack=vsrc.pack_batch, draw_fn=vsrc.make_draw_fn(),
+                    num_threads=1,
+                    on_pack_ok=lambda: self._note_pack_ok(val=True),
+                    on_pack_error=lambda e: self._note_pack_drop(
+                        e, val=True),
+                    metrics=self.metrics,
+                    should_stop=lambda: self._stopped).start()
             gen = device_prefetch(
-                combine_batches(self._train_batches(),
-                                max(1, sp.iter_size), tmajor),
-                depth=2, sharding=ps.input_shardings(),
-                device_transforms=dxf)
+                combine_batches(batches, max(1, sp.iter_size), tmajor),
+                depth=stage_depth(), sharding=ps.input_shardings(),
+                device_transforms=dxf,
+                background=nthreads > 0 and stage_background(),
+                metrics=self.metrics)
             params, st = self.params, self.opt_state
-            for batch in gen:
+            m = self.metrics
+            while True:
+                t_wait = time.perf_counter()
+                try:
+                    batch = next(gen)
+                except StopIteration:
+                    break
+                m.add("queue_wait", time.perf_counter() - t_wait)
+                m.gauge("feed_depth", len(self.queues[0]))
+                t_step = time.perf_counter()
                 params, st, out = step(params, st, batch,
                                        solver.step_rng(it))
                 it += 1
+                m.add("step", time.perf_counter() - t_step)
+                m.mark_step()
                 # interleaved validation: rank-0 records, all ranks step
                 if self.interleave_validation and test_interval \
                         and it % test_interval == 0 \
@@ -331,18 +437,36 @@ class CaffeProcessor:
         except BaseException as e:     # surfaced on stop()/join()
             self._error = e
         finally:
-            # unblock feeders spinning in offer() (backpressure release)
+            # tear the pipeline down in dependency order: close the
+            # stager generator first (its finally unblocks a stager
+            # thread stuck on a full handoff queue), then flag the
+            # pools down, then unblock feeders spinning in offer()
+            # (backpressure release)
+            if gen is not None:
+                try:
+                    gen.close()
+                except Exception:       # noqa: BLE001
+                    pass
+            for pool in (self._train_pool, self._val_pool):
+                if pool is not None:
+                    pool.stop(join_timeout=2.0)
             for q in self.queues:
                 q.stop()
+
+    VALIDATION_STALL_TIMEOUT = 30.0
 
     def _run_validation(self, eval_step, params, test_iter: int):
         assert self.val_source is not None
         src = self.val_source
+        if self._val_pool is not None:
+            self._run_validation_pooled(eval_step, params, test_iter)
+            return
         buf: List = []
         done = 0
         while done < test_iter and not self._stopped:
             try:
-                item = self.queues[1].take(timeout=30.0)
+                item = self.queues[1].take(
+                    timeout=self.VALIDATION_STALL_TIMEOUT)
             except queue.Empty:
                 if self._stopped or self.queues[1].stopped:
                     break          # ordinary shutdown mid-validation
@@ -366,6 +490,39 @@ class CaffeProcessor:
                     self.validation.add_batch(out)
                 buf = []
                 done += 1
+        self.validation.finish_round()
+
+    def _run_validation_pooled(self, eval_step, params,
+                               test_iter: int):
+        """Validation round over the queue-1 transformer pool: batches
+        arrive packed (and in feed order), the solver thread only runs
+        eval steps.  A DROPPED slot still advances the round counter —
+        the old inline loop's semantics (the feeder already spent the
+        records)."""
+        src = self.val_source
+        done = 0
+        while done < test_iter and not self._stopped:
+            try:
+                batch = self._val_pool.take(
+                    timeout=self.VALIDATION_STALL_TIMEOUT,
+                    skip_dropped=False)
+            except queue.Empty:
+                if self._stopped or self.queues[1].stopped:
+                    break          # ordinary shutdown mid-validation
+                raise RuntimeError(
+                    f"validation feed stalled: {done}/{test_iter} "
+                    "batches after "
+                    f"{self.VALIDATION_STALL_TIMEOUT:.0f}s — feeder "
+                    "dead or test source exhausted (check test_iter x "
+                    "batch_size vs dataset size)")
+            if batch is None:
+                break              # pool terminal (stop/exhausted)
+            if batch is not DROPPED:
+                batch = src.apply_device_stage(
+                    batch, self._val_shardings)
+                out = eval_step(params, batch)
+                self.validation.add_batch(out)
+            done += 1
         self.validation.finish_round()
 
     def _snapshot(self, final: bool = False, export_params=None):

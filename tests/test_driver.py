@@ -147,9 +147,9 @@ def test_train_with_validation_interleave_device_transform(
     phases = set()
     orig = Transformer.host_stage
 
-    def spy(self, batch):
+    def spy(self, batch, draw=None):
         phases.add(self.train)
-        return orig(self, batch)
+        return orig(self, batch, draw=draw)
 
     monkeypatch.setattr(Transformer, "host_stage", spy)
     tmp, solver = setup

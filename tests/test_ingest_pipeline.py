@@ -1,0 +1,500 @@
+"""Pipelined ingest runtime tests: FeedQueue timeout semantics, the
+ordered TransformerPool (multi-thread ordering, epoch boundaries, one
+terminal per pool, drop-abort), the background device stager's CPU
+aliasing defense, combine_batches remainder logging, PipelineMetrics,
+and the end-to-end pipelined CaffeProcessor train path."""
+
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from caffeonspark_tpu.data.queue_runner import (DROPPED, FeedQueue,
+                                                PipelinedFeed,
+                                                TransformerPool,
+                                                combine_batches,
+                                                device_prefetch)
+from caffeonspark_tpu.data.source import STOP_MARK
+from caffeonspark_tpu.metrics import PipelineMetrics
+
+
+# -- FeedQueue timeout semantics (satellite fix) -----------------------
+
+def test_feed_queue_take_timeout_zero():
+    """A falsy timeout must NOT fall into the forever-blocking branch."""
+    q = FeedQueue(capacity=4)
+    with pytest.raises(queue.Empty):
+        q.take(timeout=0)
+    q.offer(1)
+    assert q.take(timeout=0) == 1
+
+
+def test_feed_queue_offer_deadline():
+    """offer() honors a real deadline instead of one 0.1s slice."""
+    q = FeedQueue(capacity=2)
+    assert q.offer(1) and q.offer(2)
+    t0 = time.monotonic()
+    assert q.offer(3, timeout=0.5) is False
+    dt = time.monotonic() - t0
+    assert 0.4 < dt < 2.0, dt
+    # timeout=0: single non-blocking attempt
+    t0 = time.monotonic()
+    assert q.offer(3, timeout=0) is False
+    assert time.monotonic() - t0 < 0.2
+    q.take()
+    assert q.offer(3, timeout=0) is True
+
+
+def test_feed_queue_offer_unblocks_on_stop():
+    q = FeedQueue(capacity=1)
+    q.offer(1)
+    done = []
+
+    def blocked():
+        done.append(q.offer(2))        # no timeout: spins until stop
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    time.sleep(0.3)
+    q.stop()
+    t.join(timeout=5)
+    assert done == [False]
+
+
+# -- TransformerPool ---------------------------------------------------
+
+def _ids_pack(buf, draw):
+    return {"ids": np.asarray(buf)}
+
+
+def test_transformer_pool_ordered_output_multithread():
+    """Output order == feed order even when workers finish shuffled."""
+    feed = FeedQueue()
+
+    def jittery_pack(buf, draw):
+        time.sleep(0.002 * (buf[0] % 4))
+        return {"ids": np.asarray(buf)}
+
+    pool = TransformerPool(feed, 4, jittery_pack, num_threads=4).start()
+    for i in range(64):
+        feed.offer(i)
+    feed.offer(None)
+    got = [b["ids"].tolist() for b in pool]
+    assert got == [list(range(i, i + 4)) for i in range(0, 64, 4)]
+    pool.join(timeout=5)
+
+
+def test_transformer_pool_epoch_boundary_drops_ragged_tail():
+    m = PipelineMetrics()
+    feed = FeedQueue()
+    pool = TransformerPool(feed, 4, _ids_pack, num_threads=2,
+                           metrics=m).start()
+    for i in range(10):                # 2 full batches + ragged 2
+        feed.offer(i)
+    feed.mark_epoch_end()
+    for i in range(20, 24):            # next epoch: 1 full batch
+        feed.offer(i)
+    feed.offer(None)
+    got = [b["ids"].tolist() for b in pool]
+    assert got == [[0, 1, 2, 3], [4, 5, 6, 7], [20, 21, 22, 23]]
+    assert m.summary()["counters"]["ragged_tail_records"] == 2
+
+
+def test_transformer_pool_single_terminal():
+    """Exactly one terminal condition per pool: iteration ends once,
+    further take() keeps returning None, threads exit."""
+    feed = FeedQueue()
+    pool = TransformerPool(feed, 2, _ids_pack, num_threads=3).start()
+    for i in range(6):
+        feed.offer(i)
+    feed.offer(None)
+    assert len(list(pool)) == 3
+    assert pool.take() is None
+    assert pool.take(timeout=0.1) is None
+    pool.join(timeout=5)
+    assert all(not t.is_alive() for t in pool._threads)
+
+
+def test_transformer_pool_drop_skip_and_abort():
+    """Pack failures drop the slot (train consumers skip, validation
+    counts) and a consecutive run aborts via take()."""
+    feed = FeedQueue()
+
+    def pack(buf, draw):
+        if buf[0] % 8 == 0:
+            raise ValueError(f"bad {buf[0]}")
+        return {"ids": np.asarray(buf)}
+
+    pool = TransformerPool(feed, 4, pack, num_threads=2,
+                           drop_limit=50).start()
+    for i in range(32):
+        feed.offer(i)
+    feed.offer(None)
+    got = [b["ids"][0] for b in pool]
+    assert got == [4, 12, 20, 28]      # slots 0,8,16,24 dropped
+    assert pool.drops == 4
+
+    # skip_dropped=False exposes the DROPPED slot (validation rounds)
+    feed2 = FeedQueue()
+    pool2 = TransformerPool(feed2, 4, pack, num_threads=2,
+                            drop_limit=50).start()
+    for i in range(8):
+        feed2.offer(i)
+    feed2.offer(None)
+    assert pool2.take(timeout=5, skip_dropped=False) is DROPPED
+    assert pool2.take(timeout=5, skip_dropped=False)["ids"][0] == 4
+
+    # consecutive failures abort the pipeline
+    feed3 = FeedQueue()
+
+    def bad_pack(buf, draw):
+        raise ValueError("always")
+
+    pool3 = TransformerPool(feed3, 2, bad_pack, num_threads=2,
+                            drop_limit=3).start()
+    for i in range(12):
+        feed3.offer(i)
+    feed3.offer(None)
+    with pytest.raises(RuntimeError, match="consecutive batch"):
+        for _ in pool3:
+            pass
+    for p in (pool, pool2, pool3):
+        p.stop(join_timeout=5)
+
+
+def test_transformer_pool_ordered_draw_parity(tmp_path):
+    """num_threads > 1 packing reproduces the inline path's
+    augmentation stream exactly (crop offsets + mirror flips pre-drawn
+    in feed order by the dispatcher)."""
+    import cv2
+    from caffeonspark_tpu.data import LmdbWriter, get_source
+    from caffeonspark_tpu.data.synthetic import make_images
+    from caffeonspark_tpu.proto.caffe import Datum, LayerParameter
+
+    imgs, labels = make_images(48, seed=4)
+    recs = []
+    for i in range(48):
+        ok, buf = cv2.imencode(".jpg", (imgs[i, 0] * 255).astype(np.uint8))
+        recs.append((b"%06d" % i,
+                     Datum(encoded=True, data=bytes(buf),
+                           label=int(labels[i])).to_binary()))
+    LmdbWriter(str(tmp_path / "lmdb")).write(recs)
+    lp = LayerParameter.from_text(f'''
+        name: "data" type: "MemoryData" top: "data" top: "label"
+        source_class: "LMDB"
+        transform_param {{ crop_size: 24 mirror: true scale: 0.0039 }}
+        memory_data_param {{ source: "{tmp_path}/lmdb" batch_size: 8
+          channels: 1 height: 28 width: 28 }}''')
+    ref_src = get_source(lp, phase_train=True, seed=9, resize=True)
+    ref = list(ref_src.batches(loop=False, shuffle=False))
+    src = get_source(lp, phase_train=True, seed=9, resize=True)
+    feed = PipelinedFeed(src, loop=False, shuffle=False, num_threads=3)
+    got = list(feed)
+    feed.close()
+    assert len(got) == len(ref) == 6
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(a["data"], b["data"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+
+
+def test_pipelined_feed_small_shard_carries_tail(tmp_path):
+    """A looping feed whose shard is smaller than batch_size must still
+    form batches — epochs stream continuously (batches(loop=True)
+    carry-over semantics), they don't drop the tail per epoch."""
+    from caffeonspark_tpu.data import LmdbWriter, get_source
+    from caffeonspark_tpu.data.synthetic import make_images
+    from caffeonspark_tpu.proto.caffe import Datum, LayerParameter
+
+    imgs, labels = make_images(5, seed=2)       # 5 records, batch 8
+    recs = [(b"%06d" % i,
+             Datum(channels=1, height=28, width=28,
+                   data=(imgs[i, 0] * 255).astype(np.uint8).tobytes(),
+                   label=int(labels[i])).to_binary()) for i in range(5)]
+    LmdbWriter(str(tmp_path / "lmdb")).write(recs)
+    lp = LayerParameter.from_text(f'''
+        name: "data" type: "MemoryData" top: "data" top: "label"
+        source_class: "LMDB"
+        memory_data_param {{ source: "{tmp_path}/lmdb" batch_size: 8
+          channels: 1 height: 28 width: 28 }}''')
+    src = get_source(lp, phase_train=True, seed=0)
+    feed = PipelinedFeed(src, loop=True, shuffle=False, num_threads=2)
+    it = iter(feed)
+    try:
+        batches = [next(it) for _ in range(3)]
+    finally:
+        feed.close()
+    labels_seen = np.concatenate([b["label"] for b in batches])
+    want = np.tile([float(r) for r in labels[:5]], 5)[:24]
+    np.testing.assert_array_equal(labels_seen, want)
+
+
+# -- device stager -----------------------------------------------------
+
+def test_stager_cpu_aliasing_regression():
+    """Reused/pooled pack buffers must survive staging on the CPU
+    backend, where jax.device_put aliases aligned host numpy buffers:
+    the stager's host copy freezes each batch's value at stage time."""
+    buf = np.zeros(8, np.float32)      # one reused pack buffer
+
+    def gen():
+        for i in range(6):
+            buf[:] = i
+            yield {"x": buf}
+
+    staged = list(device_prefetch(gen(), depth=2, background=True))
+    vals = [float(np.asarray(b["x"])[0]) for b in staged]
+    assert vals == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0], vals
+
+    # foreground staging applies the same defense
+    buf[:] = 0
+    staged = list(device_prefetch(gen(), depth=2, background=False))
+    vals = [float(np.asarray(b["x"])[0]) for b in staged]
+    assert vals == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0], vals
+
+
+def test_background_stager_propagates_errors():
+    def gen():
+        yield {"x": np.zeros(2, np.float32)}
+        raise RuntimeError("upstream died")
+
+    g = device_prefetch(gen(), depth=2, background=True)
+    next(g)
+    with pytest.raises(RuntimeError, match="upstream died"):
+        for _ in g:
+            pass
+
+
+def test_background_stager_stops_on_close():
+    def gen():
+        i = 0
+        while True:
+            yield {"x": np.full(2, i, np.float32)}
+            i += 1
+
+    g = device_prefetch(gen(), depth=2, background=True)
+    next(g)
+    g.close()            # must not hang; stager thread winds down
+
+
+# -- combine_batches remainder logging (satellite) ---------------------
+
+def test_combine_batches_logs_dropped_remainder(caplog):
+    batches = [{"x": np.full(2, i, np.float32)} for i in range(5)]
+    with caplog.at_level("INFO",
+                        logger="caffeonspark_tpu.data.queue_runner"):
+        out = list(combine_batches(iter(batches), 2))
+    assert len(out) == 2
+    assert any("dropping 1 trailing" in r.message for r in caplog.records)
+
+
+# -- metrics -----------------------------------------------------------
+
+def test_pipeline_metrics_summary_and_dump(tmp_path):
+    m = PipelineMetrics(capacity=64)
+    for i in range(10):
+        m.add("pack", 0.01 * (i + 1))
+        m.mark_step()
+        m.gauge("feed_depth", i)
+    m.incr("dropped_batches")
+    s = m.summary()
+    assert s["stages"]["pack"]["count"] == 10
+    assert s["stages"]["pack"]["p50_ms"] > 0
+    assert s["stages"]["pack"]["max_ms"] >= s["stages"]["pack"]["p50_ms"]
+    assert s["counters"]["dropped_batches"] == 1
+    assert s["queue_depths"]["feed_depth"]["max"] == 9
+    assert s["steps"] == 10
+    p = m.dump(str(tmp_path / "m.json"))
+    import json
+    loaded = json.load(open(p))
+    assert loaded["stages"]["pack"]["count"] == 10
+
+
+def test_pipeline_metrics_thread_safety():
+    m = PipelineMetrics(capacity=128)
+
+    def pound():
+        for i in range(500):
+            m.add("pack", 0.001)
+            m.incr("n")
+            m.gauge("d", i)
+            m.mark_step()
+
+    ts = [threading.Thread(target=pound) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    s = m.summary()
+    assert s["stages"]["pack"]["count"] == 2000
+    assert s["counters"]["n"] == 2000
+
+
+def test_drop_counters_per_phase():
+    """Concurrent train-pool successes must not reset a systematically
+    failing validation source's consecutive-drop streak (and vice
+    versa) — the abort fires per phase."""
+    proc = CaffeProcessorShim()
+    for i in range(25):
+        proc._note_pack_ok()                 # healthy train feed
+        if i < 19:
+            proc._note_pack_drop(ValueError("bad val"), val=True)
+    with pytest.raises(RuntimeError, match="consecutive"):
+        proc._note_pack_drop(ValueError("bad val"), val=True)
+    assert proc.dropped_val_batches == 20
+    assert proc.dropped_batches == 0
+
+
+class CaffeProcessorShim:
+    """Just the drop-accounting mixin surface of CaffeProcessor,
+    avoiding solver/mesh construction."""
+
+    def __init__(self):
+        import threading
+        from caffeonspark_tpu.metrics import PipelineMetrics
+        self.dropped_batches = 0
+        self.dropped_val_batches = 0
+        self._consecutive_drops = 0
+        self._consecutive_val_drops = 0
+        self._drop_lock = threading.Lock()
+        self.metrics = PipelineMetrics()
+
+    from caffeonspark_tpu.processor import CaffeProcessor
+    MAX_CONSECUTIVE_DROPS = CaffeProcessor.MAX_CONSECUTIVE_DROPS
+    _note_pack_ok = CaffeProcessor._note_pack_ok
+    _note_pack_drop = CaffeProcessor._note_pack_drop
+    del CaffeProcessor
+
+
+# -- end-to-end: pipelined processor train -----------------------------
+
+def test_processor_pipelined_train_end_to_end(tmp_path, monkeypatch):
+    """CaffeOnSpark.train with the pipelined runtime (pool + stager):
+    completes, tolerates a corrupt record via the thread-safe drop
+    path, and the step-timeline metrics carry non-zero queue-wait /
+    pack / stage / step samples."""
+    import cv2
+    from caffeonspark_tpu.caffe_on_spark import CaffeOnSpark
+    from caffeonspark_tpu.config import Config
+    from caffeonspark_tpu.data import LmdbWriter, get_source
+    from caffeonspark_tpu.data.synthetic import make_images
+    from caffeonspark_tpu.processor import CaffeProcessor
+    from caffeonspark_tpu.proto.caffe import Datum
+
+    monkeypatch.setenv("COS_TRANSFORM_THREADS", "2")
+    imgs, labels = make_images(48, seed=6)
+    recs = []
+    for i in range(48):
+        ok, buf = cv2.imencode(".jpg", (imgs[i, 0] * 255).astype(np.uint8))
+        data = b"CORRUPT!" if i == 5 else bytes(buf)
+        recs.append((b"%06d" % i,
+                     Datum(encoded=True, data=data,
+                           label=int(labels[i])).to_binary()))
+    LmdbWriter(str(tmp_path / "lmdb")).write(recs)
+    net = tmp_path / "net.prototxt"
+    net.write_text(f'''
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "LMDB"
+  memory_data_param {{ source: "{tmp_path}/lmdb" batch_size: 16
+    channels: 1 height: 28 width: 28 }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}''')
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f'net: "{net}"\nbase_lr: 0.01\n'
+                      'lr_policy: "fixed"\nmax_iter: 5\n'
+                      'snapshot_prefix: "x"\nrandom_seed: 2\n')
+    conf = Config(["-conf", str(solver), "-train",
+                   "-output", str(tmp_path), "-resize"])
+    cos = CaffeOnSpark()
+    src = get_source(conf.train_data_layer(), phase_train=True,
+                     resize=True)
+    metrics_path = tmp_path / "pipeline_metrics.json"
+    monkeypatch.setenv("COS_PIPELINE_METRICS", str(metrics_path))
+    cos.train(src, conf)
+    proc = CaffeProcessor.instance()
+    assert proc._train_pool is not None, "pool not engaged"
+    assert proc.dropped_batches >= 1
+    s = proc.metrics.summary()
+    for stage in ("queue_wait", "pack", "stage", "step"):
+        assert s["stages"][stage]["count"] > 0, stage
+        assert s["stages"][stage]["total_s"] > 0, stage
+    proc.stop()
+    import json
+    dumped = json.load(open(metrics_path))
+    assert dumped["stages"]["step"]["count"] >= 5
+
+
+def test_processor_inline_fallback(tmp_path, monkeypatch):
+    """COS_TRANSFORM_THREADS=0 keeps the legacy inline path working."""
+    import cv2
+    from caffeonspark_tpu.caffe_on_spark import CaffeOnSpark
+    from caffeonspark_tpu.config import Config
+    from caffeonspark_tpu.data import LmdbWriter, get_source
+    from caffeonspark_tpu.data.synthetic import make_images
+    from caffeonspark_tpu.processor import CaffeProcessor
+    from caffeonspark_tpu.proto.caffe import Datum
+
+    monkeypatch.setenv("COS_TRANSFORM_THREADS", "0")
+    imgs, labels = make_images(32, seed=6)
+    recs = []
+    for i in range(32):
+        ok, buf = cv2.imencode(".jpg", (imgs[i, 0] * 255).astype(np.uint8))
+        recs.append((b"%06d" % i,
+                     Datum(encoded=True, data=bytes(buf),
+                           label=int(labels[i])).to_binary()))
+    LmdbWriter(str(tmp_path / "lmdb")).write(recs)
+    net = tmp_path / "net.prototxt"
+    net.write_text(f'''
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "LMDB"
+  memory_data_param {{ source: "{tmp_path}/lmdb" batch_size: 16
+    channels: 1 height: 28 width: 28 }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}''')
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f'net: "{net}"\nbase_lr: 0.01\n'
+                      'lr_policy: "fixed"\nmax_iter: 3\n'
+                      'snapshot_prefix: "x"\nrandom_seed: 2\n')
+    conf = Config(["-conf", str(solver), "-train",
+                   "-output", str(tmp_path), "-resize"])
+    cos = CaffeOnSpark()
+    src = get_source(conf.train_data_layer(), phase_train=True,
+                     resize=True)
+    cos.train(src, conf)
+    proc = CaffeProcessor.instance()
+    assert proc._train_pool is None
+    assert proc.metrics.summary()["stages"]["step"]["count"] == 3
+    proc.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.bench
+def test_bench_ingest_smoke(tmp_path):
+    """scripts/bench_ingest.py --quick runs end to end and emits a
+    well-formed artifact with per-stage metrics."""
+    import json
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "bench.json"
+    r = subprocess.run(
+        [sys.executable, "scripts/bench_ingest.py", "--quick",
+         "--iters", "8", "--repeats", "1", "--cooldown", "0",
+         "--hw", "96", "--out", str(out)],
+        capture_output=True, text=True, timeout=600, cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.load(open(out))
+    assert rec["bench"] == "ingest_pipeline"
+    for mode in ("inline", "pipelined"):
+        stages = rec[mode]["metrics"]["stages"]
+        for stage in ("queue_wait", "pack", "stage", "step"):
+            assert stages[stage]["count"] > 0, (mode, stage)
