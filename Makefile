@@ -6,8 +6,9 @@ PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build native install test spark-test bench smoke tpu-tests \
-  bench-evidence bench-ingest onchip-artifacts docs clean
+.PHONY: build native install test test-slow spark-test bench smoke \
+  tpu-tests bench-evidence bench-ingest bench-steploop \
+  onchip-artifacts docs clean
 
 build: native install
 
@@ -17,8 +18,13 @@ native:
 install:
 	$(PY) -m pip install -e . --no-deps --no-build-isolation
 
+# tier-1 shape: slow/e2e tests (subprocess fleets, offline-hanging
+# gcsfs, minute-long zoo compiles) run via `make test-slow`, not here
 test:
-	$(CPU_ENV) $(PY) -m pytest tests/ -x -q
+	$(CPU_ENV) $(PY) -m pytest tests/ -x -q -m "not slow"
+
+test-slow:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m "slow"
 
 # real-SparkContext leg (needs pyspark + a JVM) + the multicore 1F1B
 # wall-clock leg (needs >=4 cores): InterleaveTest / PythonApiTest
@@ -37,6 +43,14 @@ bench-ingest:
 	mkdir -p bench_evidence
 	$(CPU_ENV) $(PY) scripts/bench_ingest.py --quick \
 	  --out bench_evidence/bench_ingest_quick.json
+
+# fused multi-step loop (COS_STEPS_PER_LOOP): K=1 vs K=8/32 with the
+# 45 ms per-dispatch floor recipe (best-of-N, pinned single-thread);
+# JSON artifact embeds the per-stage chunk timeline + floor=0 control
+bench-steploop:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_steploop.py \
+	  --out bench_evidence/bench_steploop.json
 
 smoke:
 	BENCH_SMOKE=1 $(PY) bench.py

@@ -57,6 +57,19 @@ def transform_threads(default: int = 2) -> int:
         return default
 
 
+def steps_per_loop(default: int = 1) -> int:
+    """Fused multi-step chunk size K (COS_STEPS_PER_LOOP; 1 = legacy
+    per-step dispatch).  K solver iterations compile into one XLA
+    program (Solver.build_train_step_many) fed by a stacked (K, batch…)
+    block, amortizing the host→device dispatch round-trip — the
+    SparkNet/FireCaffe iterations-per-loop lever."""
+    try:
+        return max(1, int(os.environ.get("COS_STEPS_PER_LOOP",
+                                         str(default))))
+    except ValueError:
+        return default
+
+
 def stage_depth(default: int = 2) -> int:
     """Background-stager handoff depth (COS_STAGE_DEPTH)."""
     try:
@@ -553,6 +566,103 @@ def combine_batches(batches: Iterator[Dict[str, np.ndarray]], k: int,
             "of an iter_size=%d group", len(buf), k)
 
 
+def chunk_schedule(start_iter: int, max_iter: int, k: int,
+                   boundaries=()) -> Iterator[int]:
+    """Per-dispatch step counts for the fused multi-step loop: yields
+    `k` while the next `k` iterations stay inside every configured
+    interval, and falls back to single-step (1) chunks when a boundary
+    (`test_interval`, `snapshot`, `display` — zeros are ignored) or
+    `max_iter` is closer than `k`.  A chunk may END exactly on a
+    boundary (the host-side action runs between dispatches), it never
+    spans one — interleaved validation, snapshot cadence and the
+    display log keep their exact iterations.
+
+    The schedule is a pure function of (start_iter, config), so a run
+    resumed from a snapshot mid-training re-derives the identical
+    chunking from the restored iteration.
+
+    Configured-vs-effective visibility: entering a forced-single
+    region logs ONCE per boundary (not per chunk)."""
+    if k < 1:
+        raise ValueError(f"steps-per-loop k must be >= 1, got {k}")
+    bset = sorted({int(b) for b in boundaries if b and int(b) > 0})
+    it = int(start_iter)
+    in_single_run = False
+    while max_iter <= 0 or it < max_iter:
+        dist = min((b - it % b) for b in bset) if bset else k
+        if max_iter > 0:
+            dist = min(dist, max_iter - it)
+        if dist >= k:
+            in_single_run = False
+            yield k
+            it += k
+        else:
+            if k > 1 and not in_single_run:
+                _LOG.info(
+                    "steps_per_loop: boundary at iter %d forces %d "
+                    "single-step remainder chunk(s) (configured "
+                    "chunk size %d)", it + dist, dist, k)
+                in_single_run = True
+            yield 1
+            it += 1
+
+
+def stack_chunks(batches: Iterator[Dict[str, np.ndarray]],
+                 schedule: Iterator[int], *, metrics=None
+                 ) -> Iterator[tuple]:
+    """Group per-step batches into `(n, block)` chunks following
+    `schedule` (chunk_schedule): n == 1 passes the batch through
+    unstacked (the plain-step path), n > 1 stacks n batches along a
+    new axis 0 into the (K, batch…) block the fused scan step
+    consumes.  `np.stack` copies into a fresh buffer, so chunks are
+    immune to the CPU-backend `device_put` host-buffer aliasing
+    hazard by construction; single-step chunks keep relying on
+    device_prefetch's copy-on-CPU rule.  A stream that ends mid-chunk
+    flushes the leftovers as single-step chunks — the single-step
+    program is already compiled, odd remainder sizes never are."""
+    it = iter(batches)
+    for n in schedule:
+        if n <= 1:
+            try:
+                b = next(it)
+            except StopIteration:
+                return
+            yield 1, b
+            continue
+        buf = []
+        for _ in range(n):
+            try:
+                buf.append(next(it))
+            except StopIteration:
+                break
+        if len(buf) == n:
+            t0 = time.perf_counter()
+            block = {key: np.stack([b[key] for b in buf])
+                     for key in buf[0]}
+            if metrics is not None:
+                metrics.add("stack", time.perf_counter() - t0)
+            yield n, block
+        else:
+            for b in buf:
+                yield 1, b
+            return
+
+
+def chunked_feed(batches: Iterator[Dict[str, np.ndarray]], *,
+                 start_iter: int, max_iter: int, k: int,
+                 boundaries=(), metrics=None) -> Iterator[tuple]:
+    """The (n, batch) stream both train loops consume: K > 1 routes
+    through chunk_schedule + stack_chunks, K == 1 passes singles
+    through — one place for the schedule construction so the
+    CaffeProcessor and mini_cluster trainers cannot drift."""
+    if k > 1:
+        return stack_chunks(
+            batches,
+            chunk_schedule(start_iter, max_iter, k, boundaries),
+            metrics=metrics)
+    return ((1, b) for b in batches)
+
+
 def _resolve_host_copy(host_copy: Optional[bool]) -> bool:
     """Copy numpy buffers before device_put?  On the CPU backend
     jax.device_put ALIASES aligned host buffers (zero-copy), so a
@@ -570,7 +680,8 @@ def _resolve_host_copy(host_copy: Optional[bool]) -> bool:
 def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], *,
                     depth: int = 2, sharding=None,
                     device_transforms=None, background: bool = False,
-                    metrics=None, host_copy: Optional[bool] = None
+                    metrics=None, host_copy: Optional[bool] = None,
+                    chunked: bool = False, chunk_sharding=None
                     ) -> Iterator[Dict[str, jax.Array]]:
     """Asynchronously stage `depth` batches onto the device (the
     double-buffered QueuePair analog). jax transfers are async: calling
@@ -593,6 +704,15 @@ def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], *,
     `host_copy` (see _resolve_host_copy) defends staged batches against
     pack-buffer reuse on the aliasing CPU backend.
 
+    With `chunked=True` the upstream yields `(n, batch)` pairs
+    (stack_chunks): n == 1 batches stage exactly as before under
+    `sharding`, n > 1 blocks stage under `chunk_sharding` (the same
+    per-step specs with an unsharded leading chunk axis) and their
+    device transforms run vmapped over the chunk axis; the generator
+    then yields `(n, staged)`.  Stacked blocks are fresh `np.stack`
+    copies, so the copy-on-CPU aliasing defense applies only to the
+    n == 1 path.
+
     Multi-host: when the mesh spans processes, each process's batch is
     its LOCAL shard of the global batch (per-device batch semantics —
     'batch sizes in prototxt files are per device'); the global array is
@@ -601,10 +721,13 @@ def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], *,
     multiproc = jax.process_count() > 1
     jitted = {k: jax.jit(fn)
               for k, fn in (device_transforms or {}).items()}
+    vjitted = ({k: jax.jit(jax.vmap(fn))
+                for k, fn in (device_transforms or {}).items()}
+               if chunked else {})
     copy_host = _resolve_host_copy(host_copy)
 
-    def put_one(v, sh):
-        if copy_host and isinstance(v, np.ndarray):
+    def put_one(v, sh, copy):
+        if copy and isinstance(v, np.ndarray):
             v = np.array(v, copy=True)
         if sh is None:
             return jax.device_put(v)
@@ -612,27 +735,35 @@ def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], *,
             return jax.make_array_from_process_local_data(sh, v)
         return jax.device_put(v, sh)
 
-    def sh_for(k):
-        if not isinstance(sharding, dict):
-            return sharding
-        if k.endswith(DEVICE_AUX_SUFFIX):
-            # aux rides its top's batch-dim sharding (specs are P("dp"))
-            return sharding.get(k[:-len(DEVICE_AUX_SUFFIX)])
-        return sharding[k]  # unknown top = config error: fail fast
+    def stage_dict(b, sh, fns, copy):
+        def sh_for(k):
+            if not isinstance(sh, dict):
+                return sh
+            if k.endswith(DEVICE_AUX_SUFFIX):
+                # aux rides its top's batch-dim sharding (P("dp") specs)
+                return sh.get(k[:-len(DEVICE_AUX_SUFFIX)])
+            return sh[k]  # unknown top = config error: fail fast
 
-    def put(b):
-        staged = {k: put_one(v, sh_for(k)) for k, v in b.items()}
-        if not jitted:
+        staged = {k: put_one(v, sh_for(k), copy) for k, v in b.items()}
+        if not fns:
             return staged
         out = {}
         for k, v in staged.items():
             if k.endswith(DEVICE_AUX_SUFFIX):
                 continue
             aux = staged.get(k + DEVICE_AUX_SUFFIX)
-            fn = jitted.get(k)
+            fn = fns.get(k)
             out[k] = fn(v, aux) if (fn is not None
                                     and aux is not None) else v
         return out
+
+    def put(item):
+        if not chunked:
+            return stage_dict(item, sharding, jitted, copy_host)
+        n, b = item
+        if n == 1:
+            return 1, stage_dict(b, sharding, jitted, copy_host)
+        return n, stage_dict(b, chunk_sharding, vjitted, False)
 
     def timed_put(b):
         t0 = time.perf_counter()

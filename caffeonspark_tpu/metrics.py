@@ -8,10 +8,14 @@ buffers per stage, O(1) per sample, summarized on demand.
 Stage names used by the runtime:
   queue_wait  solver thread blocked in next(gen) waiting for a batch
   pack        transformer-pool decode/augment/pack of one batch
+  stack       np.stack of K packed batches into one (K, batch…) block
+              (fused multi-step path, COS_STEPS_PER_LOOP > 1)
   stage       device_put / make_array + device-transform dispatch (H2D)
   step        jitted train-step call (on accelerators this is dispatch
               wall-time — the async runtime returns before compute
-              finishes; per-step throughput comes from mark_step())
+              finishes; per-step throughput comes from mark_step());
+              for a fused chunk this is the recovered chunk_time/K
+  scan_step   one fused K-step dispatch (whole-chunk wall time)
 
 Stages are NOT disjoint when staging (and, on the inline path, packing)
 runs synchronously inside next(gen): there queue_wait SUBSUMES the pack
@@ -133,14 +137,31 @@ class PipelineMetrics:
                 g = self._gauges[name] = _Gauge()
             g.observe(value)
 
-    def mark_step(self):
-        """Timestamp one completed solver step (throughput series)."""
+    def mark_step(self, n: int = 1):
+        """Timestamp `n` completed solver steps (throughput series).
+        A fused K-step chunk lands K marks at the same instant — the
+        steady-rate computation only cares about mark COUNT between
+        first and last timestamp, so chunked and per-step runs report
+        comparable steps/sec."""
         with self._lock:
-            if len(self._steps) < self._cap:
-                self._steps.append(time.monotonic())
-            else:
-                self._steps[self._step_i] = time.monotonic()
-                self._step_i = (self._step_i + 1) % self._cap
+            now = time.monotonic()
+            for _ in range(max(1, n)):
+                if len(self._steps) < self._cap:
+                    self._steps.append(now)
+                else:
+                    self._steps[self._step_i] = now
+                    self._step_i = (self._step_i + 1) % self._cap
+
+    def add_chunk(self, n: int, seconds: float):
+        """Fused-chunk accounting: one `scan_step` sample for the whole
+        K-step dispatch, the recovered per-step device time (chunk/K)
+        into the `step` series so per-step percentiles stay comparable
+        with K=1 runs, and K step marks."""
+        self.add("scan_step", seconds)
+        per = seconds / max(1, n)
+        for _ in range(max(1, n)):
+            self.add("step", per)
+        self.mark_step(n)
 
     # -- reading --------------------------------------------------------
     def has_samples(self) -> bool:
@@ -158,7 +179,14 @@ class PipelineMetrics:
         ts = ts[skip:]
         if len(ts) < 2 or ts[-1] <= ts[0]:
             return None
-        return (len(ts) - 1) / (ts[-1] - ts[0])
+        # count only marks strictly after the window start: a fused
+        # chunk lands K marks at ONE timestamp, so (len-1)/span would
+        # count the first chunk's remaining marks as work done inside
+        # the window and overstate the rate; for per-step runs
+        # (distinct timestamps) this is exactly (len-1)/span
+        t0 = ts[0]
+        n_after = sum(1 for t in ts if t > t0)
+        return n_after / (ts[-1] - t0)
 
     def summary(self) -> dict:
         with self._lock:

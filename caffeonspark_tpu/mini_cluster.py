@@ -274,8 +274,10 @@ class MiniCluster:
                             _vsh_for(k), v, global_shape=v.shape)
                         for k, v in b.items()}
         it = int(jax.device_get(st.iter))
-        from .data.queue_runner import (PipelinedFeed, combine_batches,
+        from .data.queue_runner import (PipelinedFeed, chunked_feed,
+                                        combine_batches,
                                         stage_background, stage_depth,
+                                        steps_per_loop,
                                         transform_threads)
         from .metrics import PipelineMetrics
         tmajor = frozenset(
@@ -323,8 +325,27 @@ class MiniCluster:
                            else v.astype(np_dtype) for k, v in b.items()}
 
             batches_it = _cast(batches_it)
+        # fused multi-step loop (COS_STEPS_PER_LOOP=K>1): stack K
+        # batches per dispatch and scan K solver steps on-device;
+        # chunk_schedule falls back to single-step chunks around the
+        # boundaries this loop ACTS on (display log, interleaved
+        # validation, snapshot, max_iter) so every host-side action
+        # keeps its exact iteration — a test_interval with validation
+        # off has no action and must not throttle fusion.  Pick K to
+        # divide the display interval or the display cadence caps the
+        # effective chunk size.
+        k_loop = steps_per_loop()
+        fused_step = ps.train_step_many(k_loop) if k_loop > 1 else None
+        batches_it = chunked_feed(
+            batches_it, start_iter=it, max_iter=max_iter, k=k_loop,
+            boundaries=(display, test_interval if interleave else 0,
+                        snap_every),
+            metrics=pmetrics)
         gen = device_prefetch(batches_it, depth=stage_depth(),
                               sharding=ps.input_shardings(),
+                              chunked=True,
+                              chunk_sharding=(ps.chunk_input_shardings()
+                                              if k_loop > 1 else None),
                               device_transforms=dxf,
                               background=nthreads > 0
                               and stage_background(),
@@ -352,26 +373,43 @@ class MiniCluster:
                 while it < max_iter and not self._stop:
                     if fault_delay:
                         time.sleep(fault_delay)
-                    if (it == die_iter and (self.args.rank or 0) == die_rank
+                    # >= not ==: with COS_STEPS_PER_LOOP>1 the counter
+                    # advances in chunks and may never equal die_iter —
+                    # die at the first dispatch at-or-after it (the
+                    # marker file keeps this one-shot)
+                    if (die_iter >= 0 and it >= die_iter
+                            and (self.args.rank or 0) == die_rank
                             and not os.path.exists(die_marker)):
                         open(die_marker, "w").close()
                         print(f"FAULT INJECTION: rank {die_rank} dying at "
                               f"iter {it}", flush=True)
                         os._exit(3)
                     t_wait = time.perf_counter()
-                    batch = next(gen)
+                    n, batch = next(gen)
                     pmetrics.add("queue_wait",
                                  time.perf_counter() - t_wait)
                     t_step = time.perf_counter()
-                    params, st, out = step(params, st, batch,
-                                           solver.step_rng(it))
-                    it += 1
-                    pmetrics.add("step", time.perf_counter() - t_step)
-                    pmetrics.mark_step()
-                    timer.tick()
+                    if n == 1:
+                        params, st, out = step(params, st, batch,
+                                               solver.step_rng(it))
+                        it += 1
+                        pmetrics.add("step",
+                                     time.perf_counter() - t_step)
+                        pmetrics.mark_step()
+                    else:
+                        params, st, out = fused_step(params, st, batch)
+                        it += n
+                        pmetrics.add_chunk(
+                            n, time.perf_counter() - t_step)
+                    timer.tick(n)
                     if display and it % display == 0:
-                        loss = float(jax.device_get(out["loss"]))
-                        lr_now = float(jax.device_get(out["lr"]))
+                        # fused chunks stack outputs (K, …); the chunk
+                        # schedule ends chunks ON display boundaries,
+                        # so the last slice is this iteration's value
+                        loss = float(jax.device_get(
+                            out["loss"] if n == 1 else out["loss"][-1]))
+                        lr_now = float(jax.device_get(
+                            out["lr"] if n == 1 else out["lr"][-1]))
                         smoothed = loss if smoothed is None else (
                             0.9 * smoothed + 0.1 * loss)
                         print(
@@ -535,6 +573,7 @@ class MiniCluster:
                                            export_p)
             print(f"final model → {model_path}")
         self.final_params = params
+        self.final_state = st
         # only rank 0 wrote the file; other ranks must not hand out a
         # path that does not exist
         return model_path if self._is_rank0 else None

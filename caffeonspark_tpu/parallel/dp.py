@@ -164,6 +164,7 @@ class ParallelSolver:
             self.state_sharding = self.param_sharding
         self.repl = replicated(mesh)
         self._step = None
+        self._step_many: Dict[int, object] = {}
         self._eval = None
 
     # ------------------------------------------------------------------
@@ -182,21 +183,34 @@ class ParallelSolver:
         return OptState(iter=jax.device_put(st.iter, self.repl),
                         history=hist, history2=hist2)
 
-    def input_shardings(self, net: Optional[Net] = None) -> Dict[str, NamedSharding]:
-        """Batch-sharded over dp; time-major (T, B, ·) tops shard batch
-        on axis 1 and — when the mesh has an sp axis — their TIME axis
-        over sp (sequence parallelism: attention/scan math under GSPMD
-        partitions along T; see examples/long_context.py)."""
+    def _input_specs(self, net: Optional[Net] = None) -> Dict[str, P]:
+        """Per-input PartitionSpec: batch sharded over dp; time-major
+        (T, B, ·) tops shard batch on axis 1 and — when the mesh has an
+        sp axis — their TIME axis over sp (sequence parallelism:
+        attention/scan math under GSPMD partitions along T; see
+        examples/long_context.py)."""
         net = net or self.solver.train_net
         has_sp = dict(self.mesh.shape).get("sp", 1) > 1
         out = {}
         for name, shape, kind in net.input_specs:
             if kind.endswith(":T"):
-                spec = P("sp", "dp") if has_sp else P(None, "dp")
+                out[name] = P("sp", "dp") if has_sp else P(None, "dp")
             else:
-                spec = P("dp")
-            out[name] = NamedSharding(self.mesh, spec)
+                out[name] = P("dp")
         return out
+
+    def input_shardings(self, net: Optional[Net] = None) -> Dict[str, NamedSharding]:
+        return {name: NamedSharding(self.mesh, spec)
+                for name, spec in self._input_specs(net).items()}
+
+    def chunk_input_shardings(self, net: Optional[Net] = None
+                              ) -> Dict[str, NamedSharding]:
+        """Shardings for the stacked (K, batch…) input blocks of the
+        fused multi-step path: the leading chunk axis is scanned over
+        on every device (unsharded), each per-step slice keeps its
+        input_shardings spec."""
+        return {name: NamedSharding(self.mesh, P(*((None,) + tuple(spec))))
+                for name, spec in self._input_specs(net).items()}
 
     def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Array]:
         sh = self.input_shardings()
@@ -225,6 +239,28 @@ class ParallelSolver:
                                  in_shardings=in_sh,
                                  out_shardings=out_sh)
         return self._step
+
+    def train_step_many(self, k: int):
+        """Jitted fused K-step SPMD program (Solver.build_train_step_many
+        under the mesh): donated params/opt, chunk-stacked dp-sharded
+        inputs, per-step rng folded in on-device.  Composes with TP and
+        ZeRO-1 exactly like the single step — the scan body IS that
+        step, so GSPMD inserts the same collectives per iteration."""
+        if k not in self._step_many:
+            base = self._install_flash_mesh(
+                self.solver.build_train_step_many(k))
+            in_sh = (
+                self.param_sharding,
+                OptState(iter=self.repl,
+                         history=self.state_sharding,
+                         history2=self.state_sharding),
+                self.chunk_input_shardings(),
+            )
+            out_sh = (in_sh[0], in_sh[1], None)
+            self._step_many[k] = jax.jit(base, donate_argnums=(0, 1),
+                                         in_shardings=in_sh,
+                                         out_shardings=out_sh)
+        return self._step_many[k]
 
     def _install_flash_mesh(self, fn):
         """A bare pallas_call cannot be GSPMD-partitioned, but attention

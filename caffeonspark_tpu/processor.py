@@ -32,8 +32,9 @@ _LOG = logging.getLogger(__name__)
 from . import checkpoint
 from .config import Config
 from .data.queue_runner import (DROP_LIMIT_DEFAULT, DROPPED, FeedQueue,
-                                TransformerPool, device_prefetch,
-                                stage_background, stage_depth,
+                                TransformerPool, chunked_feed,
+                                device_prefetch, stage_background,
+                                stage_depth, steps_per_loop,
                                 transform_threads, tune_decode_threads)
 from .data.source import STOP_MARK, DataSource
 from .metrics import PipelineMetrics
@@ -388,9 +389,34 @@ class CaffeProcessor:
                         e, val=True),
                     metrics=self.metrics,
                     should_stop=lambda: self._stopped).start()
-            gen = device_prefetch(
+            # fused multi-step loop (COS_STEPS_PER_LOOP=K>1): K packed
+            # batches stack into one (K, batch…) block and one XLA
+            # dispatch runs K solver iterations (LR schedule, iter
+            # counter and rng advance on-device).  chunk_schedule keeps
+            # every chunk inside the boundaries this loop ACTS on —
+            # the interleaved-validation interval and the snapshot
+            # cadence (single-step remainders otherwise), so both keep
+            # their exact iterations; an interval with no action here
+            # (display — this loop never logs it; test_interval with
+            # validation off) must NOT throttle fusion.  K=1 is the
+            # legacy per-step path.
+            k_loop = steps_per_loop()
+            fused_step = (ps.train_step_many(k_loop)
+                          if k_loop > 1 else None)
+            will_validate = (self.interleave_validation and test_interval
+                             and eval_step is not None and test_iter)
+            feed = chunked_feed(
                 combine_batches(batches, max(1, sp.iter_size), tmajor),
-                depth=stage_depth(), sharding=ps.input_shardings(),
+                start_iter=it, max_iter=max_iter, k=k_loop,
+                boundaries=(test_interval if will_validate else 0,
+                            snap),
+                metrics=self.metrics)
+            gen = device_prefetch(
+                feed, depth=stage_depth(),
+                sharding=ps.input_shardings(),
+                chunked=True,
+                chunk_sharding=(ps.chunk_input_shardings()
+                                if k_loop > 1 else None),
                 device_transforms=dxf,
                 background=nthreads > 0 and stage_background(),
                 metrics=self.metrics)
@@ -399,17 +425,22 @@ class CaffeProcessor:
             while True:
                 t_wait = time.perf_counter()
                 try:
-                    batch = next(gen)
+                    n, batch = next(gen)
                 except StopIteration:
                     break
                 m.add("queue_wait", time.perf_counter() - t_wait)
                 m.gauge("feed_depth", len(self.queues[0]))
                 t_step = time.perf_counter()
-                params, st, out = step(params, st, batch,
-                                       solver.step_rng(it))
-                it += 1
-                m.add("step", time.perf_counter() - t_step)
-                m.mark_step()
+                if n == 1:
+                    params, st, out = step(params, st, batch,
+                                           solver.step_rng(it))
+                    it += 1
+                    m.add("step", time.perf_counter() - t_step)
+                    m.mark_step()
+                else:
+                    params, st, out = fused_step(params, st, batch)
+                    it += n
+                    m.add_chunk(n, time.perf_counter() - t_step)
                 # interleaved validation: rank-0 records, all ranks step
                 if self.interleave_validation and test_interval \
                         and it % test_interval == 0 \

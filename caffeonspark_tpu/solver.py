@@ -156,6 +156,7 @@ class Solver:
 
         self._lr_mults, self._decay_mults = self._collect_mults()
         self._jit_train_step = None
+        self._jit_train_step_many: Dict[int, object] = {}
         self._jit_eval_step = None
 
     # ------------------------------------------------------------------
@@ -387,6 +388,54 @@ class Solver:
             self._jit_train_step = jax.jit(self.train_step_fn(),
                                            donate_argnums=(0, 1))
         return self._jit_train_step
+
+    # ------------------------------------------------------------------
+    def build_train_step_many(self, k: int):
+        """Fused K-step train step: `jax.lax.scan` over a stacked
+        `(K, batch…)` input block (axis 0 = the chunk axis, prepended
+        to every input's per-step shape — time-major tops become
+        (K, T, B, …)).
+
+            (params, opt_state, stacked_inputs) -->
+                (params', opt_state', stacked_outputs)
+
+        One XLA program runs K solver iterations without returning to
+        Python: the LR schedule, the iteration counter, gradient
+        clipping and iter_size accumulation are already traced-friendly
+        and advance on-device through the scan carry.  The per-step
+        dropout/augment rng is derived INSIDE the scan as
+        `fold_in(self.key, opt_state.iter)` — bit-identical to the
+        host-side `step_rng(it)` stream, so a fused chunk reproduces K
+        inline steps exactly (tests/test_steploop.py pins byte parity).
+        Outputs come back stacked (K, …) per blob; `outputs['lr'][i]`
+        is iteration i's learning rate."""
+        if k < 1:
+            raise ValueError(f"steps-per-loop k must be >= 1, got {k}")
+        step = self.train_step_fn()
+        key = self.key
+
+        def fused(params: Params, state: OptState,
+                  stacked: Dict[str, Array]):
+            def body(carry, xs):
+                p, s = carry
+                rng = jax.random.fold_in(key, s.iter)
+                p2, s2, out = step(p, s, xs, rng)
+                return (p2, s2), out
+
+            (p, s), outs = jax.lax.scan(body, (params, state), stacked,
+                                        length=k)
+            return p, s, outs
+
+        return fused
+
+    def jit_train_step_many(self, k: int):
+        """Jitted fused K-step program, cached per k (the runtime only
+        ever compiles the configured K; boundary remainders reuse the
+        single-step program instead of compiling odd sizes)."""
+        if k not in self._jit_train_step_many:
+            self._jit_train_step_many[k] = jax.jit(
+                self.build_train_step_many(k), donate_argnums=(0, 1))
+        return self._jit_train_step_many[k]
 
     # ------------------------------------------------------------------
     def eval_step_fn(self):

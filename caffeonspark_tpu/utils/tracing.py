@@ -28,8 +28,11 @@ class StepTimer:
     def start(self) -> None:
         self._t0 = self._last = time.perf_counter()
 
-    def tick(self) -> float:
-        """Call once per completed step; returns this step's seconds."""
+    def tick(self, n: int = 1) -> float:
+        """Call once per completed dispatch covering `n` solver steps
+        (n > 1 for a fused K-step chunk: the elapsed time is averaged
+        over the chunk so it/s stays per-STEP); returns the elapsed
+        seconds for the whole dispatch."""
         now = time.perf_counter()
         if self._last is None:
             self.start()
@@ -37,9 +40,11 @@ class StepTimer:
             return 0.0
         dt = now - self._last
         self._last = now
-        self.steps += 1
-        self.step_time = dt if self.step_time is None else (
-            (1 - self.ema) * self.step_time + self.ema * dt)
+        n = max(1, n)
+        self.steps += n
+        per = dt / n
+        self.step_time = per if self.step_time is None else (
+            (1 - self.ema) * self.step_time + self.ema * per)
         return dt
 
     @property

@@ -49,7 +49,9 @@ def test_crashing_worker_fails_fast_with_claimed_block(tmp_path):
     rc, rec = _run({"JAX_PLATFORMS": "no_such_platform",
                     "BENCH_DEADLINE": "600",
                     "BENCH_EVIDENCE_DIR": str(tmp_path)}, timeout=300)
-    assert rc == 1
+    # failed measurement, successful harness run: rc 0, record carries
+    # the error (BENCH_r05 driver contract)
+    assert rc == 0
     assert rec["value"] == 0.0
     assert rec["attempts"], "failure record must carry the attempt log"
     assert all(a["rc"] != "timeout" for a in rec["attempts"])
@@ -140,7 +142,10 @@ def test_env_preflight_fails_without_spawning_worker():
     t0 = time.monotonic()
     rc, rec = _run({"JAX_PLATFORMS": "cpu", "BENCH_PIPELINE": "1",
                     "BENCH_MODEL": "lstm"}, timeout=60)
-    assert rc == 1
+    # rc is 0 even for a failed MEASUREMENT (BENCH_r05 driver contract:
+    # one parseable JSON document on stdout, rc=0; the record itself
+    # carries value 0 + error)
+    assert rc == 0
     assert time.monotonic() - t0 < 30
     assert rec["value"] == 0.0
     assert "not applicable" in rec["error"]

@@ -13,6 +13,11 @@ import sys
 import numpy as np
 import pytest
 
+# slow/e2e: each test boots a 2-process jax.distributed cluster over
+# localhost (subprocess spawn + backend init + lockstep train) — tens
+# of seconds per test on the CI box.  Run with `-m slow`.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -24,6 +24,12 @@ import sys
 import time
 
 import numpy as np
+import pytest
+
+# slow/e2e: 2-4 OS processes per test joining a jax.distributed
+# cluster, with kill/relaunch choreography — tens of seconds each on
+# the CI box.  Run with `-m slow`.
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

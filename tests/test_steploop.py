@@ -1,0 +1,369 @@
+"""Fused multi-step train loop (COS_STEPS_PER_LOOP): chunk scheduling,
+stacking, LR-policy parity, and the headline trajectory-parity gates —
+a K=8 fused run must produce byte-identical params and optimizer state
+vs the K=1 per-step path, including runs that cross snapshot /
+test_interval boundaries and snapshot/resume mid-chunk-schedule."""
+
+import glob
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from caffeonspark_tpu.data import LmdbWriter
+from caffeonspark_tpu.data.queue_runner import (chunk_schedule,
+                                                stack_chunks,
+                                                steps_per_loop)
+from caffeonspark_tpu.data.synthetic import make_images
+from caffeonspark_tpu.metrics import PipelineMetrics
+from caffeonspark_tpu.proto import NetParameter, SolverParameter
+from caffeonspark_tpu.proto.caffe import Datum
+from caffeonspark_tpu.solver import Solver
+
+TINY_NET = """
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 8 channels: 1 height: 4 width: 4 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  param { lr_mult: 1 } param { lr_mult: 2 }
+  inner_product_param { num_output: 4
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }
+"""
+
+
+def _tree_bytes(tree):
+    """Flatten a {layer: {blob: array}} tree to a bytes signature."""
+    out = []
+    for ln in sorted(tree):
+        for bn in sorted(tree[ln]):
+            out.append((ln, bn,
+                        np.asarray(jax.device_get(tree[ln][bn])).tobytes()))
+    return out
+
+
+def _rand_batches(n, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"data": rng.rand(batch, 1, 4, 4).astype(np.float32),
+             "label": rng.randint(0, 4, batch).astype(np.float32)}
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------- units
+
+def test_steps_per_loop_knob(monkeypatch):
+    monkeypatch.delenv("COS_STEPS_PER_LOOP", raising=False)
+    assert steps_per_loop() == 1
+    monkeypatch.setenv("COS_STEPS_PER_LOOP", "8")
+    assert steps_per_loop() == 8
+    monkeypatch.setenv("COS_STEPS_PER_LOOP", "0")
+    assert steps_per_loop() == 1          # clamped to legacy
+    monkeypatch.setenv("COS_STEPS_PER_LOOP", "nope")
+    assert steps_per_loop() == 1
+
+
+def test_chunk_schedule_respects_boundaries():
+    # boundaries at 12 (test_interval) and 16 (snapshot): chunks of 8
+    # where they fit, single-step remainders up to each boundary
+    s = list(chunk_schedule(0, 24, 8, (12, 16, 0)))
+    assert sum(s) == 24
+    assert s == [8, 1, 1, 1, 1, 1, 1, 1, 1, 8]
+    # no chunk crosses a multiple of 12 or 16
+    it = 0
+    for n in s:
+        for b in (12, 16):
+            assert (it % b) + n <= b or (it % b) == 0 and n <= b
+        assert it // 12 == (it + n - 1) // 12 or (it + n) % 12 == 0
+        it += n
+
+    # max_iter is itself a boundary
+    assert sum(chunk_schedule(0, 10, 8, ())) == 10
+    assert list(chunk_schedule(0, 10, 8, ())) == [8, 1, 1]
+    # resume mid-schedule re-derives the tail of the schedule
+    assert list(chunk_schedule(16, 24, 8, (12, 16))) == [8]
+    assert list(chunk_schedule(9, 24, 8, (12, 16)))[:3] == [1, 1, 1]
+    # K=1 legacy: all singles, boundaries irrelevant
+    assert list(chunk_schedule(0, 5, 1, (2,))) == [1] * 5
+
+
+def test_chunk_schedule_logs_once_per_boundary(caplog):
+    with caplog.at_level(logging.INFO,
+                         logger="caffeonspark_tpu.data.queue_runner"):
+        list(chunk_schedule(0, 24, 8, (12,)))
+    msgs = [r for r in caplog.records
+            if "single-step remainder" in r.getMessage()]
+    # two forced-single regions (before iter 12 and before iter 24),
+    # ONE log line each — not one per chunk
+    assert len(msgs) == 2, [m.getMessage() for m in msgs]
+    assert "configured chunk size 8" in msgs[0].getMessage()
+
+
+def test_stack_chunks_stacks_and_flushes():
+    batches = _rand_batches(7)
+    m = PipelineMetrics()
+    out = list(stack_chunks(iter(batches), iter([4, 4, 4]), metrics=m))
+    # one full chunk of 4, then the 3 leftovers flush as singles
+    assert [n for n, _ in out] == [4, 1, 1, 1]
+    n0, block = out[0]
+    assert block["data"].shape == (4, 8, 1, 4, 4)
+    np.testing.assert_array_equal(block["data"][2],
+                                  batches[2]["data"])
+    np.testing.assert_array_equal(out[1][1]["data"], batches[4]["data"])
+    assert m.summary()["stages"]["stack"]["count"] == 1
+    # stacked blocks are fresh copies (CPU device_put aliasing defense)
+    assert not np.shares_memory(block["data"], batches[0]["data"])
+
+
+def test_metrics_chunk_accounting():
+    m = PipelineMetrics()
+    m.add_chunk(8, 0.4)
+    m.mark_step(2)
+    s = m.summary()
+    assert s["stages"]["scan_step"]["count"] == 1
+    assert s["stages"]["step"]["count"] == 8
+    assert s["stages"]["step"]["mean_ms"] == pytest.approx(50.0)
+    assert s["steps"] == 10
+
+
+# ------------------------------------------------------- solver parity
+
+@pytest.mark.parametrize("policy", [
+    "lr_policy: 'fixed'",
+    "lr_policy: 'step' gamma: 0.5 stepsize: 2",
+    "lr_policy: 'exp' gamma: 0.9",
+    "lr_policy: 'inv' gamma: 0.1 power: 0.75",
+    "lr_policy: 'multistep' gamma: 0.1 stepvalue: 2 stepvalue: 5",
+    "lr_policy: 'poly' power: 1.5 max_iter: 6",
+    "lr_policy: 'sigmoid' gamma: 0.5 stepsize: 3",
+])
+def test_fused_lr_sequence_matches_inline(policy):
+    """Satellite gate: for every lr_policy the per-iteration LR
+    sequence INSIDE a scanned chunk must equal the K=1 sequence
+    exactly — the schedule advances from the on-device iter counter."""
+    k = 6
+    sp_txt = f"base_lr: 0.1 momentum: 0.9 {policy} random_seed: 5"
+    if "max_iter" not in sp_txt:
+        sp_txt += " max_iter: 6"
+    npm = NetParameter.from_text(TINY_NET)
+    batches = _rand_batches(k, seed=3)
+
+    a = Solver(SolverParameter.from_text(sp_txt), npm)
+    pa, sta = a.init()
+    step = a.jit_train_step()
+    inline_lrs = []
+    for i, b in enumerate(batches):
+        pa, sta, out = step(pa, sta,
+                            {kk: jnp.asarray(v) for kk, v in b.items()},
+                            a.step_rng(i))
+        inline_lrs.append(float(out["lr"]))
+
+    b_ = Solver(SolverParameter.from_text(sp_txt), npm)
+    pb, stb = b_.init()
+    fused = b_.jit_train_step_many(k)
+    block = {kk: jnp.asarray(np.stack([bb[kk] for bb in batches]))
+             for kk in batches[0]}
+    pb, stb, outs = fused(pb, stb, block)
+    fused_lrs = [float(x) for x in np.asarray(outs["lr"])]
+    assert fused_lrs == inline_lrs, (policy, fused_lrs, inline_lrs)
+    assert _tree_bytes(pa) == _tree_bytes(pb)
+
+
+def test_fused_step_byte_parity_with_clip_and_iter_size():
+    """K=8 fused == 8 inline steps bit-for-bit: params, momentum,
+    iter counter — with clip_gradients and iter_size accumulation in
+    the step (both already traced-friendly)."""
+    sp_txt = ("base_lr: 0.05 momentum: 0.9 lr_policy: 'step' "
+              "gamma: 0.5 stepsize: 3 clip_gradients: 1.0 "
+              "iter_size: 2 max_iter: 100 random_seed: 7")
+    npm = NetParameter.from_text(TINY_NET)
+    batches = _rand_batches(8, batch=16, seed=11)  # iter_size 2 x B 8
+
+    a = Solver(SolverParameter.from_text(sp_txt), npm)
+    pa, sta = a.init()
+    step = a.jit_train_step()
+    for i, b in enumerate(batches):
+        pa, sta, _ = step(pa, sta,
+                          {k: jnp.asarray(v) for k, v in b.items()},
+                          a.step_rng(i))
+
+    b_ = Solver(SolverParameter.from_text(sp_txt), npm)
+    pb, stb = b_.init()
+    fused = b_.jit_train_step_many(8)
+    block = {k: jnp.asarray(np.stack([bb[k] for bb in batches]))
+             for k in batches[0]}
+    pb, stb, _ = fused(pb, stb, block)
+
+    assert int(jax.device_get(stb.iter)) == 8
+    assert _tree_bytes(pa) == _tree_bytes(pb)
+    assert _tree_bytes(sta.history) == _tree_bytes(stb.history)
+    assert _tree_bytes(sta.history2) == _tree_bytes(stb.history2)
+
+
+# ------------------------------------------------- e2e (mini_cluster)
+
+def _write_lmdb(path, n, seed, hw=8):
+    imgs, labels = make_images(n, channels=1, height=hw, width=hw,
+                               seed=seed)
+    recs = [(b"%08d" % i,
+             Datum(channels=1, height=hw, width=hw,
+                   data=(imgs[i, 0] * 255).astype(np.uint8).tobytes(),
+                   label=int(labels[i])).to_binary()) for i in range(n)]
+    LmdbWriter(str(path)).write(recs)
+
+
+E2E_NET = """
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  include {{ phase: TRAIN }} source_class: "LMDB"
+  memory_data_param {{ source: "{train}" batch_size: 8
+    channels: 1 height: 8 width: 8 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  include {{ phase: TEST }} source_class: "LMDB"
+  memory_data_param {{ source: "{test}" batch_size: 8
+    channels: 1 height: 8 width: 8 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+  inner_product_param {{ num_output: 16
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }}
+layer {{ name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "accuracy" type: "Accuracy" bottom: "ip2"
+  bottom: "label" top: "accuracy" include {{ phase: TEST }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
+  bottom: "label" top: "loss" }}
+"""
+
+E2E_SOLVER = """
+net: "{net}"
+test_iter: 2
+test_interval: 12
+base_lr: 0.05
+momentum: 0.9
+weight_decay: 0.0005
+lr_policy: "step"
+gamma: 0.5
+stepsize: 7
+display: 0
+max_iter: 24
+snapshot: 16
+snapshot_prefix: "steploop"
+snapshot_after_train: false
+random_seed: 42
+"""
+
+
+@pytest.fixture()
+def e2e_setup(tmp_path):
+    _write_lmdb(tmp_path / "train_lmdb", 64, seed=5)
+    _write_lmdb(tmp_path / "test_lmdb", 16, seed=99)
+    net = tmp_path / "net.prototxt"
+    net.write_text(E2E_NET.format(train=tmp_path / "train_lmdb",
+                                  test=tmp_path / "test_lmdb"))
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(E2E_SOLVER.format(net=net))
+    return tmp_path, solver
+
+
+def _mini_train(solver, outdir, k, iterations=None, snapshot=None):
+    from caffeonspark_tpu.mini_cluster import MiniCluster, \
+        build_argparser
+    os.environ["COS_STEPS_PER_LOOP"] = str(k)
+    try:
+        argv = ["-solver", str(solver), "-output", str(outdir),
+                "-model", os.path.join(str(outdir), f"k{k}.caffemodel")]
+        if iterations is not None:
+            argv += ["-iterations", str(iterations)]
+        if snapshot is not None:
+            argv += ["-snapshot", snapshot]
+        mc = MiniCluster(build_argparser().parse_args(argv))
+        mc.train()
+        return mc
+    finally:
+        os.environ.pop("COS_STEPS_PER_LOOP", None)
+
+
+def test_e2e_trajectory_parity_k8_vs_k1(e2e_setup):
+    """Acceptance gate: K=8 fused over 3 epochs of the synthetic LMDB
+    (64 records / batch 8 / 24 iters) crossing a test_interval (12)
+    AND a snapshot (16) boundary produces byte-identical final params
+    and optimizer state vs K=1."""
+    tmp, solver = e2e_setup
+    out1 = tmp / "k1"; out1.mkdir()
+    out8 = tmp / "k8"; out8.mkdir()
+    mc1 = _mini_train(solver, out1, k=1)
+    mc8 = _mini_train(solver, out8, k=8)
+    assert _tree_bytes(mc1.final_params) == _tree_bytes(mc8.final_params)
+    assert (_tree_bytes(mc1.final_state.history)
+            == _tree_bytes(mc8.final_state.history))
+    assert (_tree_bytes(mc1.final_state.history2)
+            == _tree_bytes(mc8.final_state.history2))
+    assert (int(jax.device_get(mc1.final_state.iter))
+            == int(jax.device_get(mc8.final_state.iter)) == 24)
+    # the written models agree byte-for-byte too
+    m1 = open(out1 / "k1.caffemodel", "rb").read()
+    m8 = open(out8 / "k8.caffemodel", "rb").read()
+    assert m1 == m8
+    # both ran the interleaved validation round at iter 12 and 24
+    assert (out8 / "validation.json").exists()
+    assert (open(out1 / "validation.json").read()
+            == open(out8 / "validation.json").read())
+
+
+def test_e2e_snapshot_resume_mid_chunk_schedule(e2e_setup):
+    """Acceptance gate: stopping at the snapshot boundary (iter 16,
+    mid-chunk-schedule) and resuming with K=8 matches the K=1
+    stop/resume trajectory byte-for-byte."""
+    tmp, solver = e2e_setup
+
+    def run_with_resume(k):
+        outdir = tmp / f"resume_k{k}"
+        outdir.mkdir()
+        _mini_train(solver, outdir, k=k, iterations=16)
+        states = sorted(glob.glob(str(outdir / "*.solverstate*")))
+        assert states, "snapshot at iter 16 must have been written"
+        return _mini_train(solver, outdir, k=k, snapshot=states[-1])
+
+    mc1 = run_with_resume(1)
+    mc8 = run_with_resume(8)
+    assert (int(jax.device_get(mc1.final_state.iter))
+            == int(jax.device_get(mc8.final_state.iter)) == 24)
+    assert _tree_bytes(mc1.final_params) == _tree_bytes(mc8.final_params)
+    assert (_tree_bytes(mc1.final_state.history)
+            == _tree_bytes(mc8.final_state.history))
+
+
+def test_processor_steploop_parity(e2e_setup, monkeypatch):
+    """The CaffeProcessor (Spark executor) path honors
+    COS_STEPS_PER_LOOP with the same byte-parity guarantee — driven
+    through the CaffeOnSpark facade so feeding, pools and the chunked
+    stager all engage."""
+    from caffeonspark_tpu.caffe_on_spark import CaffeOnSpark
+    from caffeonspark_tpu.config import Config
+    from caffeonspark_tpu.data import get_source
+    from caffeonspark_tpu.processor import CaffeProcessor
+
+    tmp, solver = e2e_setup
+    finals = {}
+    for k in (1, 4):
+        outdir = tmp / f"proc_k{k}"
+        outdir.mkdir()
+        monkeypatch.setenv("COS_STEPS_PER_LOOP", str(k))
+        conf = Config(["-conf", str(solver), "-train",
+                       "-output", str(outdir)])
+        cos = CaffeOnSpark()
+        src = get_source(conf.train_data_layer(), phase_train=True,
+                         seed=1)
+        cos.train(src, conf)
+        proc = CaffeProcessor.instance()
+        finals[k] = (_tree_bytes(proc.params),
+                     _tree_bytes(proc.opt_state.history),
+                     int(jax.device_get(proc.opt_state.iter)))
+        proc.stop()
+    monkeypatch.delenv("COS_STEPS_PER_LOOP")
+    assert finals[1][2] == finals[4][2] == 24
+    assert finals[1] == finals[4]

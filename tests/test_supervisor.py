@@ -31,6 +31,7 @@ def test_find_latest_snapshot(tmp_path):
     assert m.endswith("m_iter_16.caffemodel")
 
 
+@pytest.mark.slow  # spawns a mini-cluster subprocess fleet (12-24 s)
 def test_supervisor_recovers_from_rank_death(tmp_path):
     from caffeonspark_tpu.data import LmdbWriter
     from caffeonspark_tpu.data.synthetic import make_images
@@ -116,6 +117,7 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
     return solver
 
 
+@pytest.mark.slow  # spawns a mini-cluster subprocess fleet (12-24 s)
 def test_per_host_supervisors_complete_pod_job(tmp_path):
     """The multi-host shape from docs/deploy.md on localhost: TWO
     supervisor processes, each hosting ONE rank of a cluster=2 job,
@@ -152,6 +154,7 @@ def test_per_host_supervisors_complete_pod_job(tmp_path):
     assert (out / "sv_iter_12.caffemodel").exists()
 
 
+@pytest.mark.slow  # spawns a mini-cluster subprocess fleet (12-24 s)
 def test_stall_timeout_detects_remote_death(tmp_path):
     """cluster=2 but only rank 0 exists (the 'remote host died before
     joining' case): rank 0 blocks in the rendezvous, no snapshots

@@ -2,6 +2,8 @@
 the ImageNet-class families (shape-only — forwards at these sizes are
 bench/TPU territory)."""
 
+import pytest
+
 from caffeonspark_tpu.models import (caffenet, googlenet, lenet,
                                      resnet50, transformer_lm, vgg16)
 from caffeonspark_tpu.net import Net
@@ -50,6 +52,7 @@ def test_vgg16_train_step():
     assert np.isfinite(float(out["loss"]))
 
 
+@pytest.mark.slow  # ~30 s CPU compile+step: keep tier-1 inside its budget
 def test_resnet50_shapes():
     import jax.numpy as jnp
     import numpy as np
@@ -150,6 +153,7 @@ def test_googlenet_shapes():
     assert "loss3/classifier" in net.param_layout
 
 
+@pytest.mark.slow  # ~47 s CPU compile+step: keep tier-1 inside its budget
 def test_googlenet_train_step():
     """One real fwd+bwd+update step through the TRAIN phase incl. the
     aux loss heads (loss1/loss2 weighted 0.3, loss3 1.0 — the published
