@@ -7,7 +7,7 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: build native install test test-slow spark-test bench smoke \
-  tpu-tests bench-evidence bench-ingest bench-steploop \
+  tpu-tests bench-evidence bench-ingest bench-steploop bench-serving \
   onchip-artifacts docs clean
 
 build: native install
@@ -51,6 +51,13 @@ bench-steploop:
 	mkdir -p bench_evidence
 	$(CPU_ENV) $(PY) scripts/bench_steploop.py \
 	  --out bench_evidence/bench_steploop.json
+
+# online serving: dynamic micro-batching vs batch=1 dispatch across
+# offered loads; JSON artifact with p50/p99 latency + rows/s per cell
+bench-serving:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_serving.py \
+	  --out bench_evidence/bench_serving.json
 
 smoke:
 	BENCH_SMOKE=1 $(PY) bench.py

@@ -363,9 +363,41 @@ def _cli_spark_context(conf: Config):
     return SparkContext.getOrCreate()
 
 
+def serve_main(conf: Config) -> int:
+    """-serve mode: online inference over the serving subsystem.  Runs
+    until interrupted; drains in-flight requests on shutdown and dumps
+    serving metrics to COS_SERVE_METRICS (same JSON format as the
+    pipeline metrics) when set."""
+    from .serving import InferenceService, ServingHTTPServer
+    svc = InferenceService(conf)   # loads -weights, else -model
+    svc.start()
+    httpd = ServingHTTPServer(svc, host=conf.serveHost,
+                              port=conf.servePort)
+    print(json.dumps({"serving": True, "port": httpd.port,
+                      "model_version": svc.registry.version,
+                      "buckets": list(svc.batcher.buckets)}),
+          flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        svc.stop(drain=True)
+        path = os.environ.get("COS_SERVE_METRICS")
+        if path:
+            with open(path, "w") as f:
+                json.dump(svc.metrics_summary(), f, indent=2,
+                          sort_keys=True)
+                f.write("\n")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     conf = Config(argv if argv is not None else sys.argv[1:])
     conf.validate()
+    if getattr(conf, "serve", False):
+        return serve_main(conf)
     cos = CaffeOnSpark(_cli_spark_context(conf))
 
     if conf.isTraining:

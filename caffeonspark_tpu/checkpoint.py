@@ -186,6 +186,53 @@ def copy_layers(net: Net, params: Params, weights_path: str, *,
     return out
 
 
+def _resolve_learned_net(state_path: str) -> str:
+    """A .solverstate names its model via learned_net; resolve it next
+    to the state file the way resume does (CaffeNet.cpp:334-365
+    setLearnedNet* analog) so serving can be pointed at either file."""
+    if state_path.endswith(".h5"):
+        import h5py
+        local = state_path
+        if fsutils.is_remote(state_path):
+            import tempfile
+            with tempfile.TemporaryDirectory() as td:
+                local = fsutils.download(state_path,
+                                         os.path.join(td, "s.h5"))
+                with h5py.File(local, "r") as f:
+                    learned = str(f.attrs.get("learned_net", ""))
+        else:
+            with h5py.File(fsutils.strip_local(state_path), "r") as f:
+                learned = str(f.attrs.get("learned_net", ""))
+    else:
+        st = SolverState.from_binary(fsutils.read_bytes(state_path))
+        learned = st.learned_net
+    if learned:
+        cand = fsutils.join(fsutils.dirname(state_path),
+                            fsutils.basename(learned))
+        if fsutils.exists(cand):
+            return cand
+    raise ValueError(
+        f"{state_path}: cannot resolve the model file from "
+        f"learned_net={learned!r} — point serving at the "
+        ".caffemodel directly")
+
+
+def load_serving_params(net: Net, model_path: str, *,
+                        strict: bool = False) -> Params:
+    """Snapshot → dense inference params WITHOUT an optimizer or a
+    training run (the serving registry's loader): filler-init the net,
+    then copy_layers from the snapshot (finetune semantics — layers
+    absent from the file keep their init, exactly like -weights).
+    Accepts .caffemodel[.h5] directly; a .solverstate[.h5] resolves
+    its learned_net pointer first."""
+    import jax
+    path = model_path
+    if ".solverstate" in fsutils.basename(path):
+        path = _resolve_learned_net(path)
+    params = net.init(jax.random.key(0))
+    return copy_layers(net, params, path, strict=strict)
+
+
 # ---------------------------------------------------------------------------
 # HDF5 variants (snapshot_format: HDF5)
 # ---------------------------------------------------------------------------

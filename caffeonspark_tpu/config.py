@@ -78,6 +78,17 @@ def build_argparser() -> argparse.ArgumentParser:
       help="vocabulary dir")
     a("-embeddingDFDir", dest="embeddingDFDir", default="",
       help="embedding dataframe dir")
+    # online serving mode (serving subsystem, not in the reference)
+    a("-serve", dest="serve", action="store_true",
+      help="online inference serving: dynamic micro-batching over a "
+           "JSON HTTP front end (weights from -model/-weights; knobs "
+           "COS_SERVE_MAX_BATCH / COS_SERVE_MAX_WAIT_MS / "
+           "COS_SERVE_QUEUE_DEPTH)")
+    a("-servePort", dest="servePort", type=int, default=0,
+      help="serving HTTP port (0 = ephemeral, printed at startup)")
+    a("-serveHost", dest="serveHost", default="127.0.0.1",
+      help="serving bind address (loopback by default; the unauth'd "
+           "/v1/reload endpoint makes wider binds an explicit opt-in)")
     # mesh extensions (not in the reference)
     a("-mesh", dest="mesh", default="",
       help="mesh spec dp[,tp[,sp[,ep]]] per process")
@@ -162,3 +173,11 @@ class Config:
                 "-snapshot requires -weights (state without model)")
         if self.isTraining and self.train_data_layer_id < 0:
             raise ValueError("no TRAIN-phase data layer in net prototxt")
+        if getattr(self, "serve", False):
+            if self.netParam is None:
+                raise ValueError("-serve needs -conf (solver prototxt "
+                                 "resolving a net)")
+            if not (self.modelPath or self.snapshotModelFile
+                    or self.snapshotStateFile):
+                raise ValueError("-serve needs trained weights: "
+                                 "-model, -weights, or -snapshot")

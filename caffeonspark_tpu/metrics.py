@@ -5,7 +5,12 @@ wall-time goes (queue wait vs transform vs H2D vs solver); this module
 gives the TPU pipeline that visibility cheaply: lock-guarded ring
 buffers per stage, O(1) per sample, summarized on demand.
 
-Stage names used by the runtime:
+The serving subsystem records its stages (latency / assemble / pack /
+fwd / time_to_first_flush series, queue_depth / batch_fill gauges,
+served/rejected/expired counters) through the same classes, so
+serving metrics dump in this exact JSON format.
+
+Stage names used by the training runtime:
   queue_wait  solver thread blocked in next(gen) waiting for a batch
   pack        transformer-pool decode/augment/pack of one batch
   stack       np.stack of K packed batches into one (K, batch…) block
@@ -76,6 +81,7 @@ class _Series:
             if self.count else 0.0,
             "p50_ms": round(1e3 * pct(0.50), 4),
             "p95_ms": round(1e3 * pct(0.95), 4),
+            "p99_ms": round(1e3 * pct(0.99), 4),
             "max_ms": round(1e3 * self.max, 4),
         }
 

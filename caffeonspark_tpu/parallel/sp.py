@@ -340,12 +340,20 @@ def _make_ring_flash_cross(axis_name: str, causal: bool, bq: int,
         # test shape, which exp() turns into an 8e-4 p-inconsistency
         # against the kernel's lse and a >1e-2 dq violation on sharp
         # causal rows.  HIGHEST (multi-pass f32) recovers the kernel's
-        # accuracy (p error 2e-4 measured on chip).  Only f32 operands
-        # need it: bf16 activations upcast to f32 re-round LOSSLESSLY
-        # under a DEFAULT bf16 pass, so they keep the fast multiply.
+        # accuracy (p error 2e-4 measured on chip).  The
+        # lossless-re-round argument (bf16 activations upcast to f32
+        # round-trip exactly through a DEFAULT bf16 pass) applies ONLY
+        # to einsums whose f32 operands are such upcasts — the score
+        # and dp products below.  `p` (exp of shifted scores) and `ds`
+        # are GENUINELY f32-valued intermediates with no bf16
+        # preimage, so every einsum consuming them runs HIGHEST
+        # unconditionally; rounding them through a bf16 MXU pass would
+        # leave the bf16-input backward less accurate than the forward
+        # kernel it must match (ADVICE r05).
         hi = (jax.lax.Precision.HIGHEST
               if any(a.dtype == jnp.float32 for a in (q, k, v))
               else jax.lax.Precision.DEFAULT)
+        hi_pd = jax.lax.Precision.HIGHEST   # p/ds-consuming einsums
 
         def pair(vq, vdo, vlse, vdelta, j):
             """Visitor q-group (home shard j) against the resident K/V:
@@ -360,11 +368,12 @@ def _make_ring_flash_cross(axis_name: str, causal: bool, bq: int,
             dp = jnp.einsum("bhqd,bhkd->bhqk", vdo, vf, precision=hi)
             ds = p * (dp - vdelta[..., None])
             dqh = jnp.einsum("bhqk,bhkd->bhqd", ds, kf,
-                             precision=hi) * scale
+                             precision=hi_pd) * scale
             dkh = jnp.einsum("bhqk,bhqd->bhkd", ds,
                              vq.astype(jnp.float32),
-                             precision=hi) * scale
-            dvh = jnp.einsum("bhqk,bhqd->bhkd", p, vdo, precision=hi)
+                             precision=hi_pd) * scale
+            dvh = jnp.einsum("bhqk,bhqd->bhkd", p, vdo,
+                             precision=hi_pd)
             return dqh, dkh, dvh
 
         def maybe_pair(vq, vdo, vlse, vdelta, j):

@@ -619,23 +619,15 @@ class CaffeProcessor:
 
     def _feature_fwd(self, blob_names: Tuple[str, ...]):
         """Jitted predict(blobNames) closure, cached per blob set — the
-        daemon's chunked EXTRACT requests must not retrace per chunk."""
-        import jax
-        cache = getattr(self, "_fwd_cache", None)
-        if cache is None:
-            cache = self._fwd_cache = {}
-        if blob_names not in cache:
-            net = self.solver.test_net or self.solver.train_net
-
-            # predict(blobNames) semantics (CaffeNet.cpp:677-697):
-            # forward, then read ANY named blob — not just net outputs
-            @jax.jit
-            def fwd(params, inputs):
-                blobs, _ = net.apply(params, inputs, train=False)
-                return {bn: blobs[bn] for bn in blob_names}
-
-            cache[blob_names] = fwd
-        return cache[blob_names]
+        daemon's chunked EXTRACT requests must not retrace per chunk.
+        The builder lives in serving/forward.py (shared with the online
+        serving subsystem, which needs it without a training run)."""
+        from .serving.forward import BlobForward
+        net = self.solver.test_net or self.solver.train_net
+        fwd = getattr(self, "_blob_forward", None)
+        if fwd is None or fwd.net is not net:
+            fwd = self._blob_forward = BlobForward(net)
+        return fwd(blob_names)
 
     def extract_rows(self, records, blob_names: Sequence[str],
                      source: Optional[DataSource] = None
@@ -643,7 +635,6 @@ class CaffeProcessor:
         """features()/predict core over an arbitrary record stream —
         the Spark path hands partition records in over the feed daemon
         (OP_EXTRACT) while the local path streams source.records()."""
-        import jax
         self._init_params()
         source = source or self.feature_source()
         assert source is not None, "no data layer to decode records with"
@@ -657,10 +648,12 @@ class CaffeProcessor:
         buf: List = []
         ids: List[str] = []
 
+        from .serving.forward import fetch_rows
+
         def flush(real: int):
-            """Run one batch and emit `real` rows (one device_get per
-            blob, not per row — aggregated scalar outputs like Accuracy
-            repeat per row, CaffeOnSpark.scala:499-507)."""
+            """Run one batch and emit `real` rows (row extraction
+            shared with serving via fetch_rows — one device_get per
+            blob, not per row)."""
             nonlocal buf, ids
             bs = len(buf)
             # a split-enabled source (train-then-features on the same
@@ -670,18 +663,7 @@ class CaffeProcessor:
             out = fwd(self.params,
                       source.apply_device_stage(source.next_batch(buf),
                                                 feat_shardings))
-            fetched = {bn: np.asarray(jax.device_get(out[bn]))
-                       for bn in blob_names}
-            for i in range(real):
-                row: Dict[str, Any] = {"SampleID": ids[i]}
-                for bn, arr in fetched.items():
-                    if arr.ndim == 0:
-                        row[bn] = [float(arr)]
-                    else:
-                        per = arr.reshape(bs, -1) if arr.shape[0] == bs \
-                            else np.repeat(arr.reshape(1, -1), bs, 0)
-                        row[bn] = [float(x) for x in per[i]]
-                rows.append(row)
+            rows.extend(fetch_rows(out, blob_names, ids, real, bs))
             buf, ids = [], []
 
         for rec in records:

@@ -1,0 +1,32 @@
+"""Online inference serving subsystem.
+
+Turns a trained snapshot into a low-latency online service: a model
+registry (snapshot load + hot-swap), a dynamic micro-batcher (bounded
+queue, per-request deadlines, bucketed batch shapes so XLA compiles a
+small fixed program set), a backpressure/robustness layer (queue-full
+fast-reject, deadline salvage, graceful drain), serving metrics in the
+PipelineMetrics JSON format, and a stdlib HTTP JSON front end.
+
+The batch `-features` mode forwards a finite record set through the
+net; this package answers requests as they ARRIVE, amortizing the
+fixed per-dispatch cost over dynamically formed micro-batches
+(FireCaffe's bigger-effective-batch argument applied to serving — see
+docs/architecture.md §serving).
+"""
+
+from .batcher import (DeadlineExceeded, MicroBatcher, PendingResult,
+                      QueueFullError, ServingStopped, bucket_for,
+                      make_buckets, serve_max_batch, serve_max_wait_ms,
+                      serve_queue_depth)
+from .forward import BlobForward, fetch_rows
+from .registry import ModelRegistry, ModelVersion, build_serving_net
+from .service import Client, InferenceService
+from .http_server import ServingHTTPServer
+
+__all__ = [
+    "BlobForward", "Client", "DeadlineExceeded", "InferenceService",
+    "MicroBatcher", "ModelRegistry", "ModelVersion", "PendingResult",
+    "QueueFullError", "ServingHTTPServer", "ServingStopped",
+    "bucket_for", "build_serving_net", "fetch_rows", "make_buckets",
+    "serve_max_batch", "serve_max_wait_ms", "serve_queue_depth",
+]

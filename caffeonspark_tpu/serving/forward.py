@@ -1,0 +1,67 @@
+"""Blob-forward builder: the predict(blobNames) closure factory.
+
+Lifted out of `CaffeProcessor._feature_fwd` so an online service can
+build the jitted forward from a Net + params WITHOUT a training run
+(no Solver thread, no feed queues).  The processor's feature path and
+the serving subsystem share this one implementation, which is what
+makes the serving-vs-extract parity gate (tests/test_serving.py) hold
+by construction: same program, same row extraction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from ..net import Net
+
+
+class BlobForward:
+    """Jitted predict(blobNames) closures for one Net, cached per blob
+    set — chunked EXTRACT requests and per-bucket serving flushes must
+    not retrace per call.  Programs are params-agnostic, so a model
+    hot-swap reuses every compiled bucket program."""
+
+    def __init__(self, net: Net):
+        self.net = net
+        self._cache: Dict[Tuple[str, ...], Any] = {}
+
+    def __call__(self, blob_names: Tuple[str, ...]):
+        import jax
+        if blob_names not in self._cache:
+            net = self.net
+
+            # predict(blobNames) semantics (CaffeNet.cpp:677-697):
+            # forward, then read ANY named blob — not just net outputs
+            @jax.jit
+            def fwd(params, inputs):
+                blobs, _ = net.apply(params, inputs, train=False)
+                return {bn: blobs[bn] for bn in blob_names}
+
+            self._cache[blob_names] = fwd
+        return self._cache[blob_names]
+
+
+def fetch_rows(out: Dict[str, Any], blob_names: Sequence[str],
+               ids: Sequence[str], real: int, bs: int
+               ) -> List[Dict[str, Any]]:
+    """Forward outputs → `real` SampleID rows (one device_get per blob,
+    not per row — aggregated scalar outputs like Accuracy repeat per
+    row, CaffeOnSpark.scala:499-507).  `bs` is the executed batch
+    size; rows past `real` are padding and are dropped."""
+    import jax
+    fetched = {bn: np.asarray(jax.device_get(out[bn]))
+               for bn in blob_names}
+    rows: List[Dict[str, Any]] = []
+    for i in range(real):
+        row: Dict[str, Any] = {"SampleID": ids[i]}
+        for bn, arr in fetched.items():
+            if arr.ndim == 0:
+                row[bn] = [float(arr)]
+            else:
+                per = arr.reshape(bs, -1) if arr.shape[0] == bs \
+                    else np.repeat(arr.reshape(1, -1), bs, 0)
+                row[bn] = [float(x) for x in per[i]]
+        rows.append(row)
+    return rows

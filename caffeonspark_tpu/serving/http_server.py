@@ -1,0 +1,160 @@
+"""Stdlib JSON front end for the serving subsystem.
+
+`http.server.ThreadingHTTPServer` — zero new dependencies, one thread
+per connection; each handler thread submits to the micro-batcher and
+blocks on its PendingResult, so concurrent HTTP requests coalesce
+into bucketed flushes exactly like in-process clients.
+
+Routes:
+  POST /v1/predict   {"records": [{"id", "label", "data"|"image_b64"},
+                      ...]} or a single record object; → {"rows": [...],
+                      "model_version": N}
+  POST /v1/reload    {"model": "<snapshot path>"} → hot-swap
+  GET  /healthz      liveness + current model version
+  GET  /metrics      serving metrics (PipelineMetrics JSON)
+
+Status mapping: 429 queue-full fast-reject, 504 deadline exceeded,
+400 malformed request, 503 model failure.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .batcher import DeadlineExceeded, QueueFullError, ServingStopped
+
+_LOG = logging.getLogger(__name__)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # self.server is the ServingHTTPServer below
+    def _send(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):      # route to logging, not stderr
+        _LOG.debug("http: " + fmt, *args)
+
+    def _read_json(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n) if n else b"{}"
+        return json.loads(raw.decode())
+
+    def do_GET(self):
+        svc = self.server.service
+        if self.path == "/healthz":
+            try:
+                version = svc.registry.current().version
+            except RuntimeError:
+                self._send(503, {"ok": False, "error": "no model loaded"})
+                return
+            self._send(200, {"ok": True, "model_version": version,
+                             "queue_depth": len(svc.batcher)})
+        elif self.path == "/metrics":
+            self._send(200, svc.metrics_summary())
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        svc = self.server.service
+        if self.path == "/v1/predict":
+            self._predict(svc)
+        elif self.path == "/v1/reload":
+            try:
+                req = self._read_json()
+                version = svc.reload(req["model"])
+            except (KeyError, ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"error": str(e)})
+            except Exception as e:        # noqa: BLE001 — bad snapshot
+                self._send(503, {"error": str(e)})
+            else:
+                self._send(200, {"ok": True, "model_version": version})
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+    def _predict(self, svc):
+        try:
+            req = self._read_json()
+            if not isinstance(req, dict):
+                raise ValueError("request body must be a JSON object")
+            records = req.get("records", [req] if ("data" in req
+                                                  or "image_b64" in req)
+                              else None)
+            if not records or not isinstance(records, list):
+                raise ValueError("need 'records' (list) or a single "
+                                 "record with 'data'/'image_b64'")
+            for r in records:
+                if not isinstance(r, dict):
+                    raise ValueError("each record must be a JSON "
+                                     "object")
+                if "image_b64" in r:
+                    r["image"] = base64.b64decode(r.pop("image_b64"))
+            timeout_ms = req.get("timeout_ms")
+            # all-or-nothing: queue-full must not strand an already-
+            # submitted prefix that still executes after the 429
+            pending = svc.submit_many(records, timeout_ms=timeout_ms)
+        except QueueFullError as e:
+            self._send(429, {"error": str(e)})
+            return
+        except ServingStopped as e:
+            self._send(503, {"error": str(e)})
+            return
+        except (ValueError, json.JSONDecodeError, TypeError) as e:
+            self._send(400, {"error": str(e)})
+            return
+        try:
+            rows = [p.wait(svc.http_wait_s) for p in pending]
+        except DeadlineExceeded as e:
+            self._send(504, {"error": str(e)})
+            return
+        except BaseException as e:        # noqa: BLE001 — model fault
+            self._send(503, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._send(200, {"rows": rows,
+                         "model_version": pending[-1].model_version})
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    """Bind-and-go wrapper; port 0 picks an ephemeral port (read it
+    back from `.port`).  Binds loopback by DEFAULT — /v1/reload loads
+    arbitrary filesystem paths with no auth, so exposing it beyond the
+    host (`-serveHost 0.0.0.0` behind a fronting proxy) must be an
+    explicit operator decision."""
+
+    daemon_threads = True
+
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
+                 http_wait_s: float = 120.0):
+        super().__init__((host, port), _Handler)
+        self.service = service
+        service.http_wait_s = http_wait_s
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def start_background(self) -> "ServingHTTPServer":
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        name="cos-serve-http",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.server_close()

@@ -1,0 +1,117 @@
+"""Model registry: snapshot → servable model, with hot-swap.
+
+Loads learned params from a snapshot file via `checkpoint` (the same
+codec training writes), keeps them behind an immutable `ModelVersion`,
+and supports swapping to a newer snapshot without dropping in-flight
+requests: the batcher snapshots `current()` ONCE per flush, so every
+request in a flush is answered by exactly one version — old or new,
+never mixed (tests/test_serving.py pins this).
+
+The registry is constructible without a training run: it builds the
+TEST-phase net directly from the NetParameter (no Solver, no feed
+pipeline) and shares one `BlobForward` across versions, so a swap
+costs a param load — never a recompile.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import NamedTuple, Optional
+
+from .. import checkpoint
+from ..net import Net, Params
+from ..proto import NetParameter, NetState, Phase, SolverParameter
+from .forward import BlobForward
+
+_LOG = logging.getLogger(__name__)
+
+
+def build_serving_net(net_param: NetParameter,
+                      solver_param: Optional[SolverParameter] = None,
+                      dtype=None) -> Net:
+    """TEST-phase net for inference (Solver's test_net construction
+    without the Solver): honors the solver's test_state stage/level
+    rules when given, falls back to the TRAIN-phase graph when the
+    prototxt has no TEST-phase compute layers."""
+    import jax.numpy as jnp
+    dtype = dtype or jnp.float32
+    test_state = NetState(phase=Phase.TEST)
+    if solver_param is not None and solver_param.test_state:
+        test_state = solver_param.test_state[0].clone()
+        test_state.phase = Phase.TEST
+    try:
+        net = Net(net_param, test_state, dtype=dtype)
+        if net.compute_layers:
+            return net
+    except Exception as e:      # noqa: BLE001 — TRAIN-only prototxt
+        _LOG.debug("TEST-phase net construction failed (%s); "
+                   "serving the TRAIN-phase graph", e)
+    train_state = NetState(phase=Phase.TRAIN)
+    return Net(net_param, train_state, dtype=dtype)
+
+
+class ModelVersion(NamedTuple):
+    """One immutable servable model.  Requests hold the version they
+    were answered by; the registry never mutates a published tuple."""
+    version: int
+    path: str
+    params: Params
+
+
+class ModelRegistry:
+    """Versioned param store + shared forward-program cache."""
+
+    def __init__(self, net: Net):
+        self.net = net
+        self.forward = BlobForward(net)
+        self._lock = threading.Lock()
+        self._current: Optional[ModelVersion] = None
+        self._version = 0
+
+    @classmethod
+    def from_conf(cls, conf) -> "ModelRegistry":
+        if conf.netParam is None:
+            raise ValueError("serving needs -conf (solver prototxt "
+                             "resolving a net)")
+        return cls(build_serving_net(conf.netParam,
+                                     conf.solverParameter))
+
+    # ------------------------------------------------------------------
+    def load(self, model_path: str) -> ModelVersion:
+        """Load a snapshot (.caffemodel[.h5] or .solverstate[.h5] whose
+        learned_net pointer resolves) and publish it as the current
+        version.  In-flight flushes keep serving the version they
+        snapshotted; new flushes pick this one up."""
+        params = checkpoint.load_serving_params(self.net, model_path)
+        with self._lock:
+            self._version += 1
+            mv = ModelVersion(self._version, model_path, params)
+            self._current = mv
+        _LOG.info("model registry: version %d <- %s",
+                  mv.version, model_path)
+        return mv
+
+    def publish(self, params: Params, path: str = "<in-memory>"
+                ) -> ModelVersion:
+        """Install already-materialized params (tests, co-located
+        trainers handing over fresh weights without a file round-trip)."""
+        with self._lock:
+            self._version += 1
+            mv = ModelVersion(self._version, path, params)
+            self._current = mv
+        return mv
+
+    def current(self) -> ModelVersion:
+        with self._lock:
+            mv = self._current
+        if mv is None:
+            raise RuntimeError("model registry is empty — load a "
+                               "snapshot (-model/-weights) before "
+                               "serving")
+        return mv
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version

@@ -1,0 +1,243 @@
+"""InferenceService: registry + micro-batcher + pack/forward glue.
+
+One service owns: a ModelRegistry (which net, which params), a
+test-phase DataSource used ONLY as the record decoder/transformer
+(its backing store is never read — requests carry their own
+payloads), and a MicroBatcher whose flush hook packs the coalesced
+records exactly the way `extract_features` packs them.  That shared
+path (DataSource.next_batch + BlobForward + fetch_rows) is what makes
+serving output byte-equal to the batch extract path for the same
+records at the same batch shape.
+
+`Client` is the in-process front end (tests, co-located apps);
+`http_server.ServingHTTPServer` speaks JSON over stdlib http.server
+for everything else.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.source import DataSource, ImageRecord, get_source
+from ..metrics import PipelineMetrics
+from .batcher import MicroBatcher, PendingResult
+from .forward import fetch_rows
+from .registry import ModelRegistry
+
+_LOG = logging.getLogger(__name__)
+
+
+def coerce_record(rec, dims: Tuple[int, int, int]) -> ImageRecord:
+    """Accept the native 7-tuple, or a {id,label,data|image} dict (the
+    HTTP front end's JSON shape) → ImageRecord.  `data` is a nested or
+    flat float list/array reshaped to the layer's (C,H,W); `image` is
+    encoded bytes (JPEG/PNG)."""
+    if isinstance(rec, tuple):
+        return rec
+    if not isinstance(rec, dict):
+        raise ValueError(f"unsupported record type {type(rec).__name__}")
+    c, h, w = dims
+    rid = str(rec.get("id", ""))
+    label = float(rec.get("label", 0.0))
+    if "image" in rec:
+        payload = rec["image"]
+        if not isinstance(payload, (bytes, bytearray)):
+            raise ValueError("record 'image' must be bytes "
+                             "(the HTTP layer base64-decodes)")
+        return (rid, label, c, h, w, True, bytes(payload))
+    if "data" not in rec:
+        raise ValueError("record needs 'data' (pixels) or 'image' "
+                         "(encoded bytes)")
+    arr = np.asarray(rec["data"], np.float32).reshape(c, h, w)
+    return (rid, label, c, h, w, False, arr)
+
+
+class InferenceService:
+    """Online serving facade over a Config (same -conf the trainer
+    uses): builds the net + registry, loads the snapshot named by
+    -model/-weights, and answers coalesced requests."""
+
+    http_wait_s = 120.0       # front-end result wait (HTTP layer tunes)
+
+    def __init__(self, conf, *, blob_names: Optional[Sequence[str]] = None,
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 default_timeout_ms: Optional[float] = None,
+                 metrics: Optional[PipelineMetrics] = None):
+        self.conf = conf
+        self.registry = ModelRegistry.from_conf(conf)
+        model = (getattr(conf, "snapshotModelFile", "")
+                 or getattr(conf, "modelPath", ""))
+        if model:
+            self.registry.load(model)
+        self.source = self._build_source(conf)
+        if blob_names is None:
+            # -features picks the served blobs exactly like the batch
+            # extract path; default is the net's outputs (+ -label)
+            feats = getattr(conf, "features", "")
+            names = [b.strip() for b in feats.split(",")
+                     if b.strip()] if feats else \
+                list(self.registry.net.output_blobs)
+            label = getattr(conf, "label", "")
+            if label and label not in names:
+                names.append(label)
+            blob_names = names
+        self.blob_names: Tuple[str, ...] = tuple(blob_names)
+        self.metrics = metrics or PipelineMetrics()
+        self.batcher = MicroBatcher(
+            self._run_batch, max_batch=max_batch,
+            max_wait_ms=max_wait_ms, queue_depth=queue_depth,
+            default_timeout_ms=default_timeout_ms,
+            metrics=self.metrics)
+        self._started = False
+        self._dims = None        # lazy (C,H,W) for dict-record coercion
+
+    @staticmethod
+    def _build_source(conf) -> DataSource:
+        """Test-phase decoder (never the train transformer — random
+        crop/mirror would make predictions nondeterministic, the
+        feature_source rule)."""
+        layer = conf.test_data_layer() or conf.train_data_layer()
+        if layer is None:
+            raise ValueError("serving needs a data layer in the net "
+                             "prototxt (record geometry + transform)")
+        return get_source(layer, phase_train=False, rank=0, num_ranks=1,
+                          resize=getattr(conf, "resize", False))
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self, warmup: bool = True) -> "InferenceService":
+        """Warm every bucket's program BEFORE traffic (eager XLA
+        pre-compile: without it the first request of each batch shape
+        pays whole-program compilation in its latency), then start the
+        dispatcher."""
+        assert not self._started, "service already started"
+        if warmup:
+            self.warmup()
+        self.batcher.start()
+        self._started = True
+        return self
+
+    def warmup(self):
+        model = self.registry.current()
+        try:
+            c, h, w = self.source.image_dims()
+        except Exception as e:       # noqa: BLE001 — geometry-less
+            _LOG.warning("serving warmup skipped (no static record "
+                         "geometry): %s", e)
+            return
+        dummy: ImageRecord = ("_warmup", 0.0, c, h, w, False,
+                              np.zeros((c, h, w), np.float32))
+        fwd = self.registry.forward(self.blob_names)
+        for bucket in self.batcher.buckets:
+            t0 = time.monotonic()
+            batch = self.source.next_batch([dummy] * bucket)
+            batch = self.source.apply_device_stage(batch)
+            out = fwd(model.params, batch)
+            fetch_rows(out, self.blob_names, ["_warmup"] * bucket,
+                       real=1, bs=bucket)
+            self.metrics.add("warmup_compile", time.monotonic() - t0)
+        _LOG.info("serving warmup: %d bucket programs compiled %s",
+                  len(self.batcher.buckets), list(self.batcher.buckets))
+
+    def stop(self, drain: bool = True):
+        if self._started:
+            self.batcher.stop(drain=drain)
+            self._started = False
+
+    # -- model hook ---------------------------------------------------
+    def _run_batch(self, records: List[Any], bucket: int
+                   ) -> Tuple[List[Dict[str, Any]], int]:
+        """One flush: pad to the bucket (repeat-last, the same rule as
+        extract_rows' ragged tail), pack through the test-phase
+        transformer, one jitted forward, per-request rows.  The model
+        is snapshotted ONCE here — every row of this flush comes from
+        one version."""
+        model = self.registry.current()
+        m = self.metrics
+        buf: List[ImageRecord] = list(records)  # coerced at submit()
+        ids = [str(r[0]) if r[0] != "" else str(i)
+               for i, r in enumerate(buf)]
+        real = len(buf)
+        buf = buf + [buf[-1]] * (bucket - real)
+        t0 = time.monotonic()
+        batch = self.source.next_batch(buf)
+        m.add("pack", time.monotonic() - t0)
+        batch = self.source.apply_device_stage(batch)
+        fwd = self.registry.forward(self.blob_names)
+        t0 = time.monotonic()
+        out = fwd(model.params, batch)
+        rows = fetch_rows(out, self.blob_names, ids, real=real,
+                          bs=bucket)
+        m.add("fwd", time.monotonic() - t0)
+        return rows, model.version
+
+    # -- request API --------------------------------------------------
+    def _record_dims(self) -> Tuple[int, int, int]:
+        if self._dims is None:
+            try:
+                self._dims = self.source.image_dims()
+            except Exception as e:    # noqa: BLE001 — geometry-less
+                raise ValueError(
+                    "dict records need the data layer's static (C,H,W) "
+                    f"geometry, which this source does not expose: {e}"
+                    ) from None
+        return self._dims
+
+    def submit(self, record, timeout_ms: Optional[float] = None
+               ) -> PendingResult:
+        """Coercion/validation happens HERE, per request — a malformed
+        record must be the submitter's error (HTTP 400), never a flush
+        failure that poisons every co-batched request."""
+        if not isinstance(record, tuple):
+            record = coerce_record(record, self._record_dims())
+        return self.batcher.submit(record, timeout_ms=timeout_ms)
+
+    def submit_many(self, records: Sequence[Any],
+                    timeout_ms: Optional[float] = None
+                    ) -> List[PendingResult]:
+        """Coerce EVERY record first (a malformed one rejects the list
+        before anything is enqueued), then enqueue all-or-nothing — a
+        partially-admitted list would execute abandoned rows after its
+        caller was told to retry."""
+        coerced = [r if isinstance(r, tuple)
+                   else coerce_record(r, self._record_dims())
+                   for r in records]
+        return self.batcher.submit_many(coerced, timeout_ms=timeout_ms)
+
+    def reload(self, model_path: str) -> int:
+        """Hot-swap to a newer snapshot; in-flight flushes finish on
+        the version they started with."""
+        return self.registry.load(model_path).version
+
+    def metrics_summary(self) -> dict:
+        out = self.metrics.summary()
+        out["model_version"] = self.registry.version
+        out["buckets"] = list(self.batcher.buckets)
+        return out
+
+
+class Client:
+    """In-process client: submit-and-wait over an InferenceService."""
+
+    def __init__(self, service: InferenceService):
+        self.service = service
+
+    def predict_one(self, record, timeout_ms: Optional[float] = None,
+                    wait_s: float = 120.0) -> Dict[str, Any]:
+        return self.service.submit(record,
+                                   timeout_ms=timeout_ms).wait(wait_s)
+
+    def predict(self, records: Sequence[Any],
+                timeout_ms: Optional[float] = None,
+                wait_s: float = 120.0) -> List[Dict[str, Any]]:
+        """Submit every record BEFORE waiting, so the batcher can
+        coalesce the whole set into as few flushes as the buckets
+        allow."""
+        pending = [self.service.submit(r, timeout_ms=timeout_ms)
+                   for r in records]
+        return [p.wait(wait_s) for p in pending]

@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Online serving benchmark: dynamic micro-batching vs batch=1
+dispatch (BENCH-style JSON artifact).
+
+Drives the REAL serving stack (InferenceService → MicroBatcher →
+bucketed jitted forward) with closed-loop client threads at several
+offered-load levels, once per bucket configuration:
+
+  serve_b1    max_batch=1 — every request is its own dispatch; the
+              per-request cost is the full fixed pack+dispatch+fetch
+              overhead ("RPC Considered Harmful" worst case)
+  serve_b8    max_batch=8 — micro-batching amortizes the fixed cost
+              over up to 8 coalesced requests
+  serve_b64   max_batch=64 — deeper amortization (quick mode: b32)
+
+Per (config, offered-load) cell: sustained throughput (rows/s
+completed over the measurement window) and client-observed p50/p99
+latency from the service's own metrics (the same PipelineMetrics
+JSON the trainer dumps).  The headline `speedup_at_saturation` is
+max-load batched throughput / max-load batch=1 throughput — the
+dynamic-batching win the serving subsystem exists to capture.
+
+Environment pins (box-cpu-contention recipe, same as
+bench_steploop.py): XLA CPU single intra-op thread, best-of-N trials
+per cell to damp neighbor-tenant CPU-share swings.
+
+Usage:
+  python scripts/bench_serving.py [--quick] [--out PATH]
+"""
+
+import argparse
+import json
+import os
+import platform
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_FLAG = "--xla_cpu_multi_thread_eigen=false"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " " + _FLAG).strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+NET_TMPL = """
+name: "servenet"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param {{ source: "{root}/unused_lmdb" batch_size: 64
+    channels: 3 height: 24 width: 24 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param {{ num_output: 16 kernel_size: 5 stride: 2
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }}
+layer {{ name: "ip1" type: "InnerProduct" bottom: "conv1" top: "ip1"
+  inner_product_param {{ num_output: 64
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "relu2" type: "ReLU" bottom: "ip1" top: "ip1" }}
+layer {{ name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
+  bottom: "label" top: "loss" }}
+"""
+
+SOLVER_TMPL = """
+net: "{net}"
+base_lr: 0.01
+lr_policy: "fixed"
+max_iter: 10
+random_seed: 7
+"""
+
+
+def build_model(td: str):
+    """Write prototxts + a filler-initialized caffemodel (throughput
+    does not care about trained weights)."""
+    from caffeonspark_tpu import checkpoint
+    from caffeonspark_tpu.proto import NetParameter, SolverParameter
+    from caffeonspark_tpu.solver import Solver
+    net_path = os.path.join(td, "net.prototxt")
+    with open(net_path, "w") as f:
+        f.write(NET_TMPL.format(root=td))
+    solver_path = os.path.join(td, "solver.prototxt")
+    with open(solver_path, "w") as f:
+        f.write(SOLVER_TMPL.format(net=net_path))
+    s = Solver(SolverParameter.from_text(SOLVER_TMPL.format(net=net_path)),
+               NetParameter.from_text(NET_TMPL.format(root=td)))
+    params, _ = s.init()
+    model = os.path.join(td, "serve.caffemodel")
+    checkpoint.save_caffemodel(model, s.train_net, params)
+    return solver_path, model
+
+
+def run_cell(solver_path: str, model: str, max_batch: int,
+             clients: int, duration_s: float, max_wait_ms: float
+             ) -> dict:
+    """One (bucket config, offered load) measurement: `clients`
+    closed-loop threads submit-and-wait for `duration_s`."""
+    from caffeonspark_tpu.config import Config
+    from caffeonspark_tpu.serving import InferenceService
+    conf = Config(["-conf", solver_path, "-model", model])
+    svc = InferenceService(conf, blob_names=("ip2",),
+                           max_batch=max_batch,
+                           max_wait_ms=max_wait_ms,
+                           queue_depth=max(64, 4 * max_batch))
+    svc.start(warmup=True)
+    rec = ("r", 0.0, 3, 24, 24, False,
+           (np.random.RandomState(0).rand(3, 24, 24)
+            .astype(np.float32) * 255.0))
+    stop = threading.Event()
+    counts = [0] * clients
+    rejects = [0] * clients
+
+    def client(i):
+        while not stop.is_set():
+            try:
+                svc.submit(rec).wait(60.0)
+                counts[i] += 1
+            except Exception:      # noqa: BLE001 — queue-full backoff
+                rejects[i] += 1
+                time.sleep(0.001)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=90)
+    elapsed = time.monotonic() - t0
+    svc.stop(drain=True)
+    m = svc.metrics_summary()
+    lat = m["stages"].get("latency", {})
+    served = sum(counts)
+    return {
+        "max_batch": max_batch, "clients": clients,
+        "duration_s": round(elapsed, 3),
+        "rows_per_sec": round(served / elapsed, 2),
+        "served": served, "rejected": sum(rejects),
+        "p50_ms": lat.get("p50_ms"), "p95_ms": lat.get("p95_ms"),
+        "p99_ms": lat.get("p99_ms"),
+        "flushes": m["counters"].get("flushes", 0),
+        "mean_batch_fill": m["queue_depths"]
+        .get("batch_fill", {}).get("mean"),
+        "buckets": m["buckets"],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small configs + short windows (CI smoke)")
+    ap.add_argument("--out", default="bench_evidence/bench_serving.json")
+    ap.add_argument("--trials", type=int, default=0,
+                    help="best-of-N per cell (default 2, quick 1)")
+    args = ap.parse_args()
+
+    import tempfile
+    import jax
+    td = tempfile.mkdtemp(prefix="cos_serve_bench_")
+    solver_path, model = build_model(td)
+
+    # saturation needs offered load >= the largest bucket (a closed
+    # loop with N clients can never fill a bucket past N)
+    duration = 1.2 if args.quick else 3.0
+    trials = args.trials or (1 if args.quick else 2)
+    configs = [1, 8, 32] if args.quick else [1, 8, 64]
+    loads = [1, 32] if args.quick else [1, 16, 64]
+
+    cells = []
+    for mb in configs:
+        # max_wait short enough that batch=1-equivalent idle latency
+        # stays bounded, long enough that a saturated window coalesces
+        wait_ms = 0.0 if mb == 1 else 2.0
+        for nc in loads:
+            best = None
+            for _ in range(trials):
+                cell = run_cell(solver_path, model, mb, nc, duration,
+                                wait_ms)
+                if best is None or cell["rows_per_sec"] > \
+                        best["rows_per_sec"]:
+                    best = cell
+            print(json.dumps(best), flush=True)
+            cells.append(best)
+
+    def peak(mb):
+        return max(c["rows_per_sec"] for c in cells
+                   if c["max_batch"] == mb)
+
+    batched_peak = max(peak(mb) for mb in configs if mb > 1)
+    headline = {
+        "metric": "serving_rows_per_sec",
+        "batch1_rows_per_sec_at_saturation": peak(1),
+        "batched_rows_per_sec_at_saturation": batched_peak,
+        "speedup_at_saturation": round(batched_peak / peak(1), 2),
+        "quick": args.quick,
+    }
+    out = {
+        "bench": "serving",
+        "headline": headline,
+        "cells": cells,
+        "recipe": {
+            "trials_per_cell_best_of": trials,
+            "duration_s_per_cell": duration,
+            "closed_loop_clients": loads,
+            "xla_flags": os.environ.get("XLA_FLAGS", ""),
+            "notes": "single intra-op XLA thread; best-of-N damps "
+                     "neighbor-tenant CPU swings (box-cpu-contention "
+                     "recipe); CPU backend — the fixed per-dispatch "
+                     "cost being amortized is host-side "
+                     "pack+dispatch+fetch, the same overhead class "
+                     "the TPU tunnel pays per call",
+        },
+        "env": {
+            "platform": platform.platform(),
+            "python": sys.version.split()[0],
+            "jax": jax.__version__,
+            "backend": jax.devices()[0].platform,
+            "cpu_count": os.cpu_count(),
+        },
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"headline": headline}), flush=True)
+    if headline["speedup_at_saturation"] < 3.0 and not args.quick:
+        print("WARNING: speedup below the 3x acceptance gate",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

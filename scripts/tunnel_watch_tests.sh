@@ -63,7 +63,16 @@ print('TPU alive:', ds)
           # that is strictly less informative than the committed
           # artifact (a REAL pre-fix suite execution); restore it so a
           # blind end-of-round commit cannot replace evidence with a
-          # wedge stub.  The attempt details live in this log.
+          # wedge stub.  But the FAILING run is evidence too (which
+          # test wedged, how far the suite got) — preserve it under
+          # artifacts/ before restoring; repeated red runs keep the
+          # latest failure (timestamped copies would grow unbounded
+          # while camping).
+          if [ -f TPU_TESTS_r05.json ]; then
+            mkdir -p artifacts
+            cp -f TPU_TESTS_r05.json artifacts/TPU_TESTS_r05.failed.json
+            echo "failing artifact preserved: artifacts/TPU_TESTS_r05.failed.json"
+          fi
           git checkout -- TPU_TESTS_r05.json 2>/dev/null
           echo "non-green artifact restored to committed version"
         fi

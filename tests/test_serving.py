@@ -1,0 +1,507 @@
+"""Online serving subsystem: bucket selection/padding, deadlines,
+backpressure, hot-swap atomicity, drain, the HTTP front end, and the
+headline parity gate — serving output must be byte-equal to
+`extract_features` for the same records at the same batch shape."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from caffeonspark_tpu import checkpoint
+from caffeonspark_tpu.config import Config
+from caffeonspark_tpu.proto import NetParameter, SolverParameter
+from caffeonspark_tpu.serving import (Client, DeadlineExceeded,
+                                      InferenceService, MicroBatcher,
+                                      QueueFullError, ServingHTTPServer,
+                                      ServingStopped, bucket_for,
+                                      make_buckets, serve_max_batch,
+                                      serve_max_wait_ms,
+                                      serve_queue_depth)
+from caffeonspark_tpu.solver import Solver
+
+NET_TMPL = """
+name: "tiny"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param {{ source: "{root}/unused_lmdb" batch_size: 8
+    channels: 1 height: 12 width: 12 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param {{ num_output: 4 kernel_size: 3
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "relu" type: "ReLU" bottom: "conv1" top: "conv1" }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "conv1" top: "ip"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}
+"""
+
+SOLVER_TMPL = """
+net: "{net}"
+base_lr: 0.01
+momentum: 0.9
+lr_policy: "fixed"
+max_iter: 20
+random_seed: 5
+"""
+
+
+def _records(n, seed=0, h=12, w=12):
+    return [(f"{i:08d}", float(i % 3), 1, h, w, False,
+             np.random.RandomState(seed + i)
+             .rand(1, h, w).astype(np.float32) * 255.0)
+            for i in range(n)]
+
+
+@pytest.fixture()
+def tiny_model(tmp_path):
+    """Written prototxts + a briefly-trained caffemodel."""
+    net_path = tmp_path / "net.prototxt"
+    net_path.write_text(NET_TMPL.format(root=tmp_path))
+    solver_path = tmp_path / "solver.prototxt"
+    solver_path.write_text(SOLVER_TMPL.format(net=net_path))
+    s = Solver(SolverParameter.from_text(
+        SOLVER_TMPL.format(net=net_path)),
+        NetParameter.from_text(NET_TMPL.format(root=tmp_path)))
+    params, st = s.init()
+    import jax.numpy as jnp
+    step = s.jit_train_step()
+    rng = np.random.RandomState(7)
+    for i in range(3):
+        batch = {"data": jnp.asarray(
+            rng.rand(8, 1, 12, 12).astype(np.float32) * 255),
+            "label": jnp.asarray(
+                rng.randint(0, 10, 8).astype(np.float32))}
+        params, st, _ = step(params, st, batch, s.step_rng(i))
+    model = str(tmp_path / "m.caffemodel")
+    checkpoint.save_caffemodel(model, s.train_net, params)
+    return str(solver_path), model
+
+
+def _service(tiny_model, **kw):
+    solver_path, model = tiny_model
+    conf = Config(["-conf", solver_path, "-model", model])
+    kw.setdefault("blob_names", ("ip",))
+    return InferenceService(conf, **kw)
+
+
+# ---------------------------------------------------------------- units
+
+def test_make_buckets_and_bucket_for():
+    assert make_buckets(64) == (1, 2, 4, 8, 16, 32, 64)
+    assert make_buckets(1) == (1,)
+    assert make_buckets(6) == (1, 2, 4, 6)   # non-pow2 cap included
+    b = make_buckets(8)
+    assert bucket_for(1, b) == 1
+    assert bucket_for(3, b) == 4
+    assert bucket_for(8, b) == 8
+    with pytest.raises(ValueError):
+        bucket_for(9, b)
+
+
+def test_serve_knobs(monkeypatch):
+    for k in ("COS_SERVE_MAX_BATCH", "COS_SERVE_MAX_WAIT_MS",
+              "COS_SERVE_QUEUE_DEPTH"):
+        monkeypatch.delenv(k, raising=False)
+    assert serve_max_batch() == 64
+    assert serve_max_wait_ms() == 5.0
+    assert serve_queue_depth() == 4 * 64
+    monkeypatch.setenv("COS_SERVE_MAX_BATCH", "16")
+    monkeypatch.setenv("COS_SERVE_MAX_WAIT_MS", "2.5")
+    monkeypatch.setenv("COS_SERVE_QUEUE_DEPTH", "99")
+    assert serve_max_batch() == 16
+    assert serve_max_wait_ms() == 2.5
+    assert serve_queue_depth() == 99
+    monkeypatch.setenv("COS_SERVE_MAX_BATCH", "junk")
+    assert serve_max_batch() == 64           # parse fallback
+
+
+# ------------------------------------------------- batcher (stub model)
+
+def _stub_runner(log=None, delay=0.0):
+    def run(records, bucket):
+        if delay:
+            time.sleep(delay)
+        if log is not None:
+            log.append((len(records), bucket))
+        return [{"v": [float(r)]} for r in records], 1
+    return run
+
+
+def test_queue_full_fast_reject():
+    """Bounded queue + no dispatcher: submits beyond depth raise
+    immediately instead of blocking."""
+    b = MicroBatcher(_stub_runner(), max_batch=4, queue_depth=2,
+                     max_wait_ms=10)
+    b.submit(1)
+    b.submit(2)
+    t0 = time.monotonic()
+    with pytest.raises(QueueFullError):
+        b.submit(3)
+    assert time.monotonic() - t0 < 0.5       # fast, not a blocking put
+    assert b.metrics.summary()["counters"]["rejected_queue_full"] == 1
+    b.stop(drain=False)
+
+
+def test_deadline_salvage_partial_batch():
+    """An expired request is answered with DeadlineExceeded while the
+    REST of its flush still executes (partial-batch salvage)."""
+    log = []
+    b = MicroBatcher(_stub_runner(log), max_batch=4, queue_depth=8,
+                     max_wait_ms=5000)
+    dead = b.submit("x", timeout_ms=1)
+    live = [b.submit(i) for i in range(3)]
+    time.sleep(0.02)                         # let the deadline lapse
+    b.start()
+    rows = [p.wait(10.0) for p in live]
+    assert [r["v"] for r in rows] == [[0.0], [1.0], [2.0]]
+    with pytest.raises(DeadlineExceeded):
+        dead.wait(10.0)
+    # the salvaged flush ran 3 live records at bucket 4
+    assert log == [(3, 4)]
+    assert b.metrics.summary()["counters"]["expired_deadline"] == 1
+    b.stop()
+
+
+def test_deadline_expiry_is_an_error_not_a_hang():
+    """A lone request with a short timeout errors out promptly even
+    though max_wait is much longer — the assembly loop caps its wait
+    at the nearest deadline."""
+    b = MicroBatcher(_stub_runner(delay=0.0), max_batch=8,
+                     queue_depth=8, max_wait_ms=10_000).start()
+    t0 = time.monotonic()
+    p = b.submit("x", timeout_ms=30)
+    with pytest.raises(DeadlineExceeded):
+        p.wait(10.0)
+    assert time.monotonic() - t0 < 5.0
+    b.stop()
+
+
+def test_drain_on_shutdown():
+    """stop(drain=True) flushes everything already accepted."""
+    b = MicroBatcher(_stub_runner(), max_batch=4, queue_depth=32,
+                     max_wait_ms=50).start()
+    pending = [b.submit(i) for i in range(10)]
+    b.stop(drain=True)
+    rows = [p.wait(10.0) for p in pending]
+    assert [r["v"] for r in rows] == [[float(i)] for i in range(10)]
+    with pytest.raises(ServingStopped):
+        b.submit(99)
+
+
+def test_stop_without_drain_rejects_pending():
+    b = MicroBatcher(_stub_runner(delay=0.05), max_batch=1,
+                     queue_depth=32, max_wait_ms=0).start()
+    pending = [b.submit(i) for i in range(6)]
+    b.stop(drain=False)
+    outcomes = []
+    for p in pending:
+        try:
+            p.wait(10.0)
+            outcomes.append("ok")
+        except ServingStopped:
+            outcomes.append("stopped")
+    assert "stopped" in outcomes             # tail was rejected, not hung
+
+
+def test_stop_without_drain_mid_assemble_window():
+    """The no-drain stop must also reject when the dispatcher consumes
+    the sentinel INSIDE an open assemble window (max_wait large), and
+    must return promptly instead of flushing the backlog."""
+    b = MicroBatcher(_stub_runner(delay=0.2), max_batch=4,
+                     queue_depth=32, max_wait_ms=10_000).start()
+    pending = [b.submit(i) for i in range(6)]
+    time.sleep(0.05)           # first flush of 4 in progress; 2 queued
+    t0 = time.monotonic()
+    b.stop(drain=False)
+    assert time.monotonic() - t0 < 5.0
+    outcomes = []
+    for p in pending:
+        try:
+            p.wait(10.0)
+            outcomes.append("ok")
+        except ServingStopped:
+            outcomes.append("stopped")
+    assert "stopped" in outcomes
+
+
+def test_submit_many_all_or_nothing():
+    """A list that does not fit is rejected whole — nothing is left
+    enqueued to execute behind the caller's 429."""
+    b = MicroBatcher(_stub_runner(), max_batch=4, queue_depth=4,
+                     max_wait_ms=10)
+    with pytest.raises(QueueFullError):
+        b.submit_many(list(range(5)))
+    assert len(b) == 0
+    pending = b.submit_many(list(range(4)))
+    assert len(b) == 4
+    b.start()
+    assert [p.wait(10.0)["v"] for p in pending] == \
+        [[0.0], [1.0], [2.0], [3.0]]
+    b.stop()
+
+
+def test_flush_failure_fails_requests_not_dispatcher():
+    calls = []
+
+    def run(records, bucket):
+        calls.append(len(records))
+        if len(calls) == 1:
+            raise RuntimeError("boom")
+        return [{"v": [0.0]} for _ in records], 1
+
+    b = MicroBatcher(run, max_batch=2, queue_depth=8,
+                     max_wait_ms=1).start()
+    p1 = b.submit(1)
+    with pytest.raises(RuntimeError, match="boom"):
+        p1.wait(10.0)
+    p2 = b.submit(2)                         # dispatcher survived
+    assert p2.wait(10.0) == {"v": [0.0]}
+    b.stop()
+
+
+# ------------------------------------------------- service (real model)
+
+def test_parity_with_extract_features(tiny_model):
+    """Headline gate: serving rows for a full bucket are byte-equal to
+    the batch extract path for the same records — same pack, same
+    jitted program shape, same row extraction."""
+    solver_path, model = tiny_model
+    recs = _records(8)
+
+    fconf = Config(["-conf", solver_path, "-model", model,
+                    "-features", "ip"])
+    fconf.snapshotModelFile = model
+    from caffeonspark_tpu.processor import CaffeProcessor
+    proc = CaffeProcessor.instance(fconf)
+    try:
+        ref_rows = proc.extract_rows(list(recs), ["ip"])
+    finally:
+        CaffeProcessor._instance = None
+    assert len(ref_rows) == 8
+
+    svc = _service(tiny_model, max_batch=8, max_wait_ms=2000)
+    svc.start()
+    try:
+        rows = Client(svc).predict(recs)
+    finally:
+        svc.stop()
+    assert rows == ref_rows                  # byte-equal floats
+
+
+def test_padded_rows_do_not_leak(tiny_model):
+    """A partial flush pads to its bucket; only the real rows come
+    back, attributed to the right SampleIDs."""
+    recs = _records(8, seed=50)
+    svc = _service(tiny_model, max_batch=8, max_wait_ms=300)
+    svc.start()
+    try:
+        cl = Client(svc)
+        full = cl.predict(recs)              # bucket 8 reference
+        part = cl.predict(recs[:3])          # bucket 4, 1 padded row
+    finally:
+        svc.stop()
+    assert len(part) == 3
+    assert [r["SampleID"] for r in part] == \
+        [r["SampleID"] for r in full[:3]]
+    for a, b in zip(part, full[:3]):
+        np.testing.assert_allclose(a["ip"], b["ip"], rtol=1e-5)
+    # the partial flush really did run a smaller bucket
+    fills = svc.metrics.summary()["queue_depths"]["batch_fill"]
+    assert fills["samples"] >= 2
+
+
+def test_hot_swap_old_or_new_never_mixed(tiny_model):
+    """Stream single-record requests while swapping the model: every
+    answer must exactly match one version's reference output, and the
+    reported version must agree with the payload."""
+    solver_path, model = tiny_model
+    conf = Config(["-conf", solver_path, "-model", model])
+    svc = InferenceService(conf, blob_names=("ip",), max_batch=2,
+                           max_wait_ms=1, queue_depth=64)
+    net = svc.registry.net
+
+    def constant_params(bias):
+        import jax
+        p = net.init(jax.random.key(0))
+        out = {ln: {bn: np.zeros_like(np.asarray(a))
+                    for bn, a in bl.items()} for ln, bl in p.items()}
+        out["ip"]["bias"] = np.full_like(np.asarray(p["ip"]["bias"]),
+                                         bias)
+        import jax.numpy as jnp
+        return {ln: {bn: jnp.asarray(a) for bn, a in bl.items()}
+                for ln, bl in out.items()}
+
+    # zero conv + zero ip weight → output == ip bias, exactly
+    v_a = svc.registry.publish(constant_params(0.0), "A").version
+    svc.start(warmup=False)
+    try:
+        results = []
+        rec = _records(1)[0]
+        for i in range(40):
+            if i == 20:
+                v_b = svc.registry.publish(constant_params(1.0),
+                                           "B").version
+            p = svc.submit(rec)
+            results.append((p.wait(30.0), p.model_version))
+    finally:
+        svc.stop()
+    expect = {v_a: [0.0] * 10, v_b: [1.0] * 10}
+    assert {v for _, v in results} == {v_a, v_b}
+    for row, version in results:
+        assert row["ip"] == expect[version], (row, version)
+
+
+def test_malformed_record_rejected_at_submit(tiny_model):
+    """Coercion runs per-request at submit (→ the submitter's 400),
+    never inside the flush where it would poison co-batched
+    requests."""
+    svc = _service(tiny_model, max_batch=4, max_wait_ms=50)
+    svc.start(warmup=False)
+    try:
+        with pytest.raises(ValueError):
+            svc.submit({"id": "bad", "data": [1.0, 2.0]})  # wrong size
+        row = Client(svc).predict_one(_records(1)[0])      # unharmed
+        assert len(row["ip"]) == 10
+    finally:
+        svc.stop()
+
+
+def test_warmup_precompiles_every_bucket(tiny_model):
+    svc = _service(tiny_model, max_batch=8, max_wait_ms=1)
+    svc.start(warmup=True)
+    try:
+        s = svc.metrics.summary()["stages"]
+        assert s["warmup_compile"]["count"] == len(svc.batcher.buckets)
+        # post-warmup single request flushes without a bucket compile
+        row = Client(svc).predict_one(_records(1)[0])
+        assert len(row["ip"]) == 10
+    finally:
+        svc.stop()
+
+
+def test_service_metrics_summary_shape(tiny_model):
+    svc = _service(tiny_model, max_batch=4, max_wait_ms=1)
+    svc.start(warmup=False)
+    try:
+        Client(svc).predict(_records(5))
+    finally:
+        svc.stop()
+    out = svc.metrics_summary()
+    assert out["model_version"] == 1
+    assert out["buckets"] == [1, 2, 4]
+    lat = out["stages"]["latency"]
+    assert lat["count"] == 5
+    for k in ("p50_ms", "p95_ms", "p99_ms"):
+        assert k in lat
+    assert out["counters"]["served_rows"] == 5
+    assert out["stages"]["time_to_first_flush"]["count"] == 1
+
+
+def test_load_serving_params_from_solverstate(tiny_model, tmp_path):
+    """Registry accepts a .solverstate by resolving learned_net."""
+    solver_path, model = tiny_model
+    net_path = solver_path.replace("solver.prototxt", "net.prototxt")
+    s = Solver(SolverParameter.from_text(open(solver_path).read()),
+               NetParameter.from_text(open(net_path).read()))
+    params, st = s.init()
+    model_path, state_path = checkpoint.snapshot(
+        s.train_net, params, st, str(tmp_path / "snap"))
+    conf = Config(["-conf", solver_path, "-model", state_path])
+    svc = InferenceService(conf, blob_names=("ip",), max_batch=2,
+                           max_wait_ms=1)
+    svc.start(warmup=False)
+    try:
+        row = Client(svc).predict_one(_records(1)[0])
+        assert len(row["ip"]) == 10
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------- http
+
+def test_http_front_end(tiny_model):
+    svc = _service(tiny_model, max_batch=4, max_wait_ms=5)
+    svc.start(warmup=False)
+    httpd = ServingHTTPServer(svc, host="127.0.0.1", port=0)
+    httpd.start_background()
+    base = f"http://127.0.0.1:{httpd.port}"
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["ok"] and health["model_version"] == 1
+
+        rec = {"id": "r0", "label": 0.0,
+               "data": (np.arange(144, dtype=np.float32)
+                        .reshape(1, 12, 12) % 251).tolist()}
+        req = urllib.request.Request(
+            base + "/v1/predict",
+            data=json.dumps({"records": [rec]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+        assert out["model_version"] == 1
+        assert len(out["rows"]) == 1
+        assert out["rows"][0]["SampleID"] == "r0"
+        assert len(out["rows"][0]["ip"]) == 10
+
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            m = json.loads(r.read())
+        assert m["counters"]["served_rows"] >= 1
+
+        for payload in (b"{}", b"[1, 2]", b'{"records": "nope"}'):
+            bad = urllib.request.Request(base + "/v1/predict",
+                                         data=payload, method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad, timeout=30)
+            assert ei.value.code == 400, payload
+    finally:
+        httpd.stop()
+        svc.stop()
+
+
+@pytest.mark.slow
+def test_concurrent_http_requests_coalesce(tiny_model):
+    """Concurrent HTTP clients land in shared flushes (batch-fill > 1
+    on average is not guaranteed by timing, but every request must be
+    answered correctly under concurrency)."""
+    svc = _service(tiny_model, max_batch=8, max_wait_ms=20,
+                   queue_depth=64)
+    svc.start(warmup=True)
+    httpd = ServingHTTPServer(svc, host="127.0.0.1", port=0)
+    httpd.start_background()
+    base = f"http://127.0.0.1:{httpd.port}"
+    errors = []
+
+    def hit(i):
+        rec = {"id": f"c{i}",
+               "data": np.full((1, 12, 12), float(i),
+                               np.float32).tolist()}
+        req = urllib.request.Request(
+            base + "/v1/predict",
+            data=json.dumps({"records": [rec]}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                out = json.loads(r.read())
+            assert out["rows"][0]["SampleID"] == f"c{i}"
+        except Exception as e:        # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=hit, args=(i,))
+               for i in range(24)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    try:
+        assert not errors
+        assert svc.metrics.summary()["counters"]["served_rows"] == 24
+    finally:
+        httpd.stop()
+        svc.stop()
