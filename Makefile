@@ -8,7 +8,7 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 
 .PHONY: build native install test test-slow spark-test bench smoke \
   tpu-tests bench-evidence bench-ingest bench-steploop bench-serving \
-  onchip-artifacts docs clean
+  bench-gradsync onchip-artifacts docs clean
 
 build: native install
 
@@ -51,6 +51,14 @@ bench-steploop:
 	mkdir -p bench_evidence
 	$(CPU_ENV) $(PY) scripts/bench_steploop.py \
 	  --out bench_evidence/bench_steploop.json
+
+# gradient exchange: COS_GRAD_SYNC default vs bucket/quant/hier under
+# the injected per-byte cross-host comm floor (best-of-N, pinned
+# single-thread); JSON artifact embeds the comm plan + floor=0 control
+bench-gradsync:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_gradsync.py \
+	  --out bench_evidence/bench_gradsync.json
 
 # online serving: dynamic micro-batching vs batch=1 dispatch across
 # offered loads; JSON artifact with p50/p99 latency + rows/s per cell

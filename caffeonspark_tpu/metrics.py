@@ -21,6 +21,14 @@ Stage names used by the training runtime:
               finishes; per-step throughput comes from mark_step());
               for a fused chunk this is the recovered chunk_time/K
   scan_step   one fused K-step dispatch (whole-chunk wall time)
+  comm        injected gradient-exchange floor sleeps (bench drills:
+              COS_FAULT_COMM_NS_PER_BYTE models the exposed wire time
+              of the COS_GRAD_SYNC plan)
+
+Static run facts ride in the same JSON via `set_info`: the trainer
+publishes the gradient-exchange plan as `info.comm` (per-step wire
+bytes, bucket count and sizes, wire dtype, mode) so every pipeline-
+metrics artifact states what the exchange cost.
 
 Stages are NOT disjoint when staging (and, on the inline path, packing)
 runs synchronously inside next(gen): there queue_wait SUBSUMES the pack
@@ -120,6 +128,7 @@ class PipelineMetrics:
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, _Gauge] = {}
         self._steps: List[float] = []
+        self._info: Dict[str, object] = {}
         self._cap = capacity
         self._step_i = 0
         self._created = time.monotonic()
@@ -142,6 +151,12 @@ class PipelineMetrics:
             if g is None:
                 g = self._gauges[name] = _Gauge()
             g.observe(value)
+
+    def set_info(self, name: str, value) -> None:
+        """Attach a static (JSON-serializable) fact to the summary —
+        e.g. the gradient-exchange plan under "comm"."""
+        with self._lock:
+            self._info[name] = value
 
     def mark_step(self, n: int = 1):
         """Timestamp `n` completed solver steps (throughput series).
@@ -172,7 +187,8 @@ class PipelineMetrics:
     # -- reading --------------------------------------------------------
     def has_samples(self) -> bool:
         with self._lock:
-            return bool(self._series or self._counters or self._steps)
+            return bool(self._series or self._counters or self._steps
+                        or self._info)
 
     def steady_steps_per_sec(self, skip: int = 5) -> Optional[float]:
         """Throughput over the step timestamps with the first `skip`
@@ -200,6 +216,7 @@ class PipelineMetrics:
             counters = dict(self._counters)
             gauges = {k: v.summary() for k, v in self._gauges.items()}
             nsteps = len(self._steps)
+            info = dict(self._info)
         out = {
             "stages": stages,
             "counters": counters,
@@ -207,6 +224,8 @@ class PipelineMetrics:
             "steps": nsteps,
             "uptime_s": round(time.monotonic() - self._created, 3),
         }
+        if info:
+            out["info"] = info
         sps = self.steady_steps_per_sec()
         if sps is not None:
             out["steady_steps_per_sec"] = round(sps, 3)

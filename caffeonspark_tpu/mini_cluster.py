@@ -362,6 +362,33 @@ class MiniCluster:
         # suppresses the fault after a supervisor relaunch)
         fault_delay = float(
             os.environ.get("COS_FAULT_STEP_DELAY_MS", "0") or 0) / 1e3
+        # gradient-exchange accounting + injected comm floor
+        # (scripts/bench_gradsync.py): publish the COS_GRAD_SYNC plan
+        # into the step-timeline JSON, and — when
+        # COS_FAULT_COMM_NS_PER_BYTE is set — sleep the modeled
+        # EXPOSED wire time per solver step (per-byte floor on the
+        # plan's non-hidden bytes + per-message latency,
+        # COS_FAULT_COMM_LAT_US; COS_FAULT_COMM_LOCAL is the modeled
+        # intra-host group size the hier mode divides the slow hop by).
+        # Same technique as the 45 ms dispatch floor in bench_steploop:
+        # on a CPU-only box the floor IS the controlled variable.
+        gs = getattr(solver, "grad_sync", None)
+        comm_sleep = 0.0
+        if gs is not None:
+            pmetrics.set_info("comm", gs.plan.comm_info())
+            comm_ns = float(
+                os.environ.get("COS_FAULT_COMM_NS_PER_BYTE", "0") or 0)
+            if comm_ns > 0:
+                lat_us = float(
+                    os.environ.get("COS_FAULT_COMM_LAT_US", "0") or 0)
+                local = int(
+                    os.environ.get("COS_FAULT_COMM_LOCAL", "1") or 1)
+                hide = os.environ.get("COS_FAULT_COMM_HIDE_BYTES", "")
+                exposed = gs.plan.exposed_wire_bytes(
+                    local_size=local,
+                    hide_bytes=int(float(hide)) if hide else None)
+                comm_sleep = (exposed * comm_ns
+                              + gs.plan.n_messages * lat_us * 1e3) / 1e9
         die_once = os.environ.get("COS_FAULT_DIE_ONCE", "")
         die_rank = die_iter = -1
         die_marker = ""
@@ -401,6 +428,13 @@ class MiniCluster:
                         it += n
                         pmetrics.add_chunk(
                             n, time.perf_counter() - t_step)
+                    if comm_sleep:
+                        # one exchange per solver step, fused or not;
+                        # n per-step samples so the series stays
+                        # per-step comparable across K settings
+                        time.sleep(comm_sleep * n)
+                        for _ in range(n):
+                            pmetrics.add("comm", comm_sleep)
                     timer.tick(n)
                     if display and it % display == 0:
                         # fused chunks stack outputs (K, …); the chunk

@@ -4,10 +4,14 @@ Parity surface (SURVEY §2.7): data parallelism — intra-node P2PSync +
 inter-node sharded socket/RDMA exchange in the reference — becomes GSPMD
 over a named mesh (`dp.ParallelSolver`).  Extensions beyond the
 reference: tensor parallelism (`dp.tp_param_specs`), sequence/context
-parallelism via ring attention (`sp.ring_attention`).
+parallelism via ring attention (`sp.ring_attention`), and the explicit
+communication-efficient gradient exchange (`gradsync.GradSync`:
+bucketed backward-overlap, quantized wire, hierarchical reduction —
+COS_GRAD_SYNC).
 """
 
 from .dp import ParallelSolver, tp_param_specs
+from .gradsync import GradSync, GradSyncPlan, build_plan, make_gradsync
 from .mesh import (build_mesh, data_sharding, distributed_init,
                    dp_data_rank, lockstep_steps, replicated)
 from .pp import PipelineSolver, partition_layers

@@ -84,8 +84,11 @@ def zero_state_specs(param_specs: Dict[str, Dict[str, P]],
                      dp: int, *, min_numel: int = ZERO_MIN_NUMEL
                      ) -> Dict[str, Dict[str, P]]:
     """ZeRO-1-style optimizer-STATE specs: for each blob big enough to
-    matter, add 'dp' on the first unsharded dim divisible by the dp
-    size (params stay replicated — only the momentum / second-moment
+    matter, add 'dp' on the LARGEST unsharded dim divisible by the dp
+    size — largest so the shards balance (fc-style (4096, 25088) blobs
+    shard the 25088 axis; picking the first divisible dim would cut
+    the small axis and leave 6x more elements per shard boundary) —
+    while params stay replicated (only the momentum / second-moment
     history shards).  Under GSPMD the elementwise update then runs
     per-shard and XLA all-gathers the updated params, i.e. the ZeRO-1
     partition-update-allgather pattern falls out of the sharding
@@ -104,11 +107,14 @@ def zero_state_specs(param_specs: Dict[str, Dict[str, P]],
                 used = set(spec)
                 if "dp" not in used:
                     axes = list(spec) + [None] * (len(shape) - len(spec))
+                    best = None
                     for i, (ax, dim) in enumerate(zip(axes, shape)):
-                        if ax is None and dim % dp == 0:
-                            axes[i] = "dp"
-                            new = P(*axes)
-                            break
+                        if ax is None and dim % dp == 0 and (
+                                best is None or dim > shape[best]):
+                            best = i
+                    if best is not None:
+                        axes[best] = "dp"
+                        new = P(*axes)
             out[ln][bn] = new
     return out
 
@@ -163,6 +169,18 @@ class ParallelSolver:
             self.state_specs = self.param_specs
             self.state_sharding = self.param_sharding
         self.repl = replicated(mesh)
+        # explicit gradient exchange (gradsync.py): the mesh resolves
+        # COS_GRAD_SYNC=auto and arms the collective constraints; blobs
+        # sharded over tp/ep keep GSPMD's handling (their grads are not
+        # replicated — bucketing them would force an all-gather).  Must
+        # happen before any step is traced (steps build lazily below).
+        gs = getattr(solver, "grad_sync", None)
+        if gs is not None:
+            sharded = frozenset(
+                (ln, bn) for ln, blobs in self.param_specs.items()
+                for bn, spec in blobs.items()
+                if any(ax is not None for ax in spec))
+            gs.bind_mesh(mesh, skip_blobs=sharded)
         self._step = None
         self._step_many: Dict[int, object] = {}
         self._eval = None

@@ -1,0 +1,472 @@
+"""Communication-efficient gradient exchange (COS_GRAD_SYNC).
+
+The reference's entire reason to exist was its gradient-exchange design
+— `P2PSync` tree reduce inside a node, `SocketSync`/`RDMASync` sharded
+all-to-all across nodes — while our `parallel/dp.py` hands the exchange
+to GSPMD's default placement: one implicit f32 all-reduce per param,
+scheduled wherever the partitioner likes (in practice serialized after
+the whole backward).  FireCaffe's scaling analysis (PAPERS.md) says the
+cluster wins come from amortizing and *shrinking* that exchange; this
+module makes the exchange an explicit, tunable layer:
+
+  COS_GRAD_SYNC=default   byte-identical to the implicit exchange (the
+                          module is completely inert — no extra ops are
+                          traced, so the HLO is the pre-existing HLO)
+  COS_GRAD_SYNC=bucket    bucketed backward-overlap: param blobs group
+                          into ~COS_GRAD_BUCKET_MB flat buckets in
+                          reverse-backward (grad-completion) order; a
+                          `jax.custom_vjp` hook per bucket re-emits the
+                          bucket's cotangents through one flat buffer
+                          and pins a replication sharding-constraint on
+                          it RIGHT THERE, mid-backward — so the GSPMD
+                          all-reduce for bucket k is issued while bucket
+                          k+1's grads are still computing (XLA's async
+                          collectives overlap it with the remaining
+                          backward on real ICI/DCN)
+  COS_GRAD_SYNC=quant     bucket + low-precision wire: the flat bucket
+                          is cast to COS_GRAD_WIRE_DTYPE (bfloat16
+                          default; int8 adds a per-bucket max-abs scale
+                          and stochastic rounding) before the
+                          replication constraint and cast back to the
+                          grad dtype after — f32 master accumulation in
+                          the optimizer is untouched, only the wire
+                          payload shrinks (sp.py precision-floor rule:
+                          anything CONSUMING the reduced value stays
+                          full precision)
+  COS_GRAD_SYNC=hier      bucket + hierarchical two-phase exchange: the
+                          flat bucket is constrained to a dp-sharded
+                          layout first (reduce-scatter placement) and
+                          replicated second (all-gather) — the standard
+                          reduce-scatter + all-gather decomposition,
+                          which XLA maps intra-ring first on multihost
+                          meshes so the slow cross-host hop carries
+                          1/local of the traffic
+  COS_GRAD_SYNC=auto      numerics-safe pick for the topology: hier on
+                          multi-process dp meshes, bucket on
+                          single-process dp>1 meshes, default otherwise
+
+Mechanism notes (honest about what GSPMD lets us control):
+
+  * Grads arriving out of `jax.value_and_grad` are LOGICALLY already
+    the global gradient — the partitioner decides where the physical
+    all-reduce happens.  A `with_sharding_constraint` on the bucket's
+    flat buffer forces the value to be replicated AT THAT POINT of the
+    dataflow graph and in THAT dtype, which is exactly the two levers
+    the exchange needs (placement for overlap, dtype for wire size).
+  * The custom_vjp hook wraps each bucket's param blobs with an
+    identity whose bwd rule fires at the point in the backward where
+    the LAST cotangent of the bucket is available — "emit the
+    collective as soon as the bucket's grads are final".  Hooks are
+    used when iter_size == 1 and the transform is deterministic
+    (COS_GRAD_OVERLAP=0 opts out); iter_size > 1 accumulation and the
+    rng-consuming int8 path apply the identical transform to the
+    finished grad pytree instead (`exchange`), preserving Caffe's
+    exchange-once-per-step semantics.
+  * int8 quantizes the already-reduced value, i.e. it models an
+    exchange whose intra-reduction runs at accumulator precision and
+    whose wire payload is int8 + one f32 scale per bucket; convergence
+    is gated by tests/test_gradsync.py, not assumed.
+  * tp/ep-sharded param blobs (their grads are NOT replicated) and
+    BatchNorm stat blobs (never optimized; overwritten by the forward)
+    are excluded from buckets and keep today's GSPMD handling.
+
+Every mode composes with TP, ZeRO-1 and the fused K-step loop: the
+transform lives inside `Solver.train_step_fn`, which is the scan body
+of `build_train_step_many` and the function `ParallelSolver` wraps for
+the mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, FrozenSet, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+MODES = ("auto", "default", "bucket", "quant", "hier")
+WIRE_DTYPES = ("bfloat16", "int8")
+
+_DEFAULT_BUCKET_MB = 25.0     # DDP-style default; COS_GRAD_BUCKET_MB
+_INT8_SCALE_BYTES = 4         # one f32 max-abs scale rides per bucket
+
+
+def env_mode() -> str:
+    m = os.environ.get("COS_GRAD_SYNC", "default").strip().lower()
+    if m not in MODES:
+        raise ValueError(
+            f"COS_GRAD_SYNC={m!r}: expected one of {'|'.join(MODES)}")
+    return m
+
+
+def env_bucket_mb() -> float:
+    v = os.environ.get("COS_GRAD_BUCKET_MB", "")
+    return float(v) if v else _DEFAULT_BUCKET_MB
+
+
+def env_wire_dtype() -> Optional[str]:
+    v = os.environ.get("COS_GRAD_WIRE_DTYPE", "").strip().lower()
+    if v and v not in WIRE_DTYPES:
+        raise ValueError(
+            f"COS_GRAD_WIRE_DTYPE={v!r}: expected one of "
+            f"{'|'.join(WIRE_DTYPES)}")
+    return v or None
+
+
+class Bucket(NamedTuple):
+    """One exchange unit: blobs whose grads finalize together."""
+    index: int
+    entries: Tuple[Tuple[str, str], ...]    # (layer, blob) in fire order
+    shapes: Tuple[Tuple[int, ...], ...]
+    numel: int
+    bytes_grad: int                          # at the grad dtype
+    bytes_wire: int                          # at the wire dtype
+
+
+class GradSyncPlan(NamedTuple):
+    """Static exchange metadata: what goes on the wire, in what order,
+    in what dtype — consumed by the transform, the metrics `comm`
+    block, scripts/roofline.py and the bench floor model."""
+    mode: str                                # resolved, never "auto"
+    wire_dtype: Optional[str]                # None = grad dtype
+    bucket_mb: float
+    buckets: Tuple[Bucket, ...]
+    total_numel: int
+    total_bytes_grad: int
+    total_bytes_wire: int
+    skipped: Tuple[Tuple[str, str], ...]     # blobs left to GSPMD
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+    def comm_info(self) -> dict:
+        """The `comm` block of the PipelineMetrics JSON: per-step
+        exchange traffic at a glance."""
+        return {
+            "mode": self.mode,
+            "wire_dtype": self.wire_dtype or "grad",
+            "bucket_mb": self.bucket_mb,
+            "buckets": self.n_buckets,
+            "bucket_bytes_wire": [b.bytes_wire for b in self.buckets],
+            "exchanged_params": self.total_numel,
+            "bytes_per_step_wire": self.total_bytes_wire,
+            "bytes_per_step_dense_f32": self.total_numel * 4,
+            "skipped_blobs": len(self.skipped),
+        }
+
+    def exposed_wire_bytes(self, local_size: int = 1,
+                           hide_bytes: Optional[int] = None) -> int:
+        """Modeled NON-HIDDEN wire bytes per step, for the injected
+        comm floor (scripts/bench_gradsync.py).  `default` serializes
+        the whole dense exchange after backward.  Overlap modes hide
+        buckets under the remaining backward compute — fully when
+        `hide_bytes` is None, else up to that capacity (the wire can
+        only carry so much while the backward runs) — except the
+        LAST-fired bucket (the first-layer one: nothing is left to
+        hide under), the standard DDP overlap model.  `hier` divides
+        every wire quantity by the modeled intra-host group size
+        first: the slow cross-host hop carries 1/local of the bytes
+        after the intra-host reduce-scatter.  The floor=0 control run
+        in the bench artifact is the reality check on this model."""
+        div = max(1, int(local_size)) if self.mode == "hier" else 1
+        total = -(-self.total_bytes_wire // div)
+        if self.mode == "default":
+            return total
+        last = (-(-self.buckets[-1].bytes_wire // div)
+                if self.buckets else 0)
+        if hide_bytes is None:
+            return last
+        return max(last, total - int(hide_bytes))
+
+    @property
+    def n_messages(self) -> int:
+        """Wire messages per step (per-message latency floor term)."""
+        return 1 if self.mode == "default" else self.n_buckets
+
+
+def _wire_for(mode: str, wire_env: Optional[str]) -> Optional[str]:
+    """quant defaults to bf16 wire; hier honors an explicit wire dtype
+    but stays at grad dtype otherwise; bucket/default never recast."""
+    if mode == "quant":
+        return wire_env or "bfloat16"
+    if mode == "hier":
+        return wire_env
+    return None
+
+
+def build_plan(net, mode: str, *, bucket_mb: Optional[float] = None,
+               wire_dtype: Optional[str] = None,
+               skip_blobs: FrozenSet[Tuple[str, str]] = frozenset()
+               ) -> GradSyncPlan:
+    """Bucket the net's param blobs in reverse-backward order (the
+    order their grads finalize: last compute layer first)."""
+    bucket_mb = env_bucket_mb() if bucket_mb is None else bucket_mb
+    wire = _wire_for(mode, wire_dtype)
+    grad_itemsize = jnp.dtype(net.dtype).itemsize
+    wire_itemsize = (1 if wire == "int8" else
+                     2 if wire == "bfloat16" else grad_itemsize)
+    stat = set(net.stat_param_layers())
+    skipped: List[Tuple[str, str]] = []
+    order: List[Tuple[str, str, Tuple[int, ...]]] = []
+    for lp in reversed(net.compute_layers):
+        specs = net.param_layout.get(lp.name)
+        if not specs:
+            continue
+        for bname, shape, _ in reversed(specs):
+            if lp.name in stat or (lp.name, bname) in skip_blobs:
+                skipped.append((lp.name, bname))
+            else:
+                order.append((lp.name, bname, tuple(shape)))
+
+    cap = max(1, int(bucket_mb * (1 << 20)))
+    buckets: List[Bucket] = []
+    cur: List[Tuple[str, str, Tuple[int, ...]]] = []
+    cur_bytes = 0
+
+    def _flush():
+        nonlocal cur, cur_bytes
+        if not cur:
+            return
+        numel = sum(int(np.prod(s)) if s else 1 for _, _, s in cur)
+        wire_b = numel * wire_itemsize + (
+            _INT8_SCALE_BYTES if wire == "int8" else 0)
+        buckets.append(Bucket(
+            index=len(buckets),
+            entries=tuple((ln, bn) for ln, bn, _ in cur),
+            shapes=tuple(s for _, _, s in cur),
+            numel=numel, bytes_grad=numel * grad_itemsize,
+            bytes_wire=wire_b))
+        cur, cur_bytes = [], 0
+
+    for ln, bn, shape in order:
+        n = int(np.prod(shape)) if shape else 1
+        if cur and cur_bytes + n * grad_itemsize > cap:
+            _flush()
+        cur.append((ln, bn, shape))
+        cur_bytes += n * grad_itemsize
+    _flush()
+
+    total_numel = sum(b.numel for b in buckets)
+    return GradSyncPlan(
+        mode=mode, wire_dtype=wire, bucket_mb=float(bucket_mb),
+        buckets=tuple(buckets), total_numel=total_numel,
+        total_bytes_grad=total_numel * grad_itemsize,
+        total_bytes_wire=sum(b.bytes_wire for b in buckets),
+        skipped=tuple(skipped))
+
+
+# ---------------------------------------------------------------------------
+def quantize_int8(flat: Array, rng: Optional[Array]
+                  ) -> Tuple[Array, Array]:
+    """Per-bucket symmetric int8: max-abs scale + stochastic rounding
+    (unbiased — E[q·scale] = flat; plain round-to-nearest when no rng
+    is supplied).  Returns (q_int8, f32_scale)."""
+    f = flat.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(f)), 1e-30) / 127.0
+    x = f / scale
+    if rng is not None:
+        x = jnp.floor(x + jax.random.uniform(rng, x.shape, x.dtype))
+    else:
+        x = jnp.round(x)
+    return jnp.clip(x, -127.0, 127.0).astype(jnp.int8), scale
+
+
+def dequantize_int8(q: Array, scale: Array, dtype) -> Array:
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+class GradSync:
+    """The exchange itself: bucketing + wire transform + collective
+    placement, applied either via backward hooks (`attach`, fires
+    per-bucket mid-backward) or on the finished grad pytree
+    (`exchange`).  Both paths run the identical per-bucket transform.
+
+    Inert (`enabled` False) in `default` mode: neither path adds a
+    single op, so the traced program is byte-identical to the
+    pre-gradsync step."""
+
+    def __init__(self, net, *, mode: Optional[str] = None,
+                 bucket_mb: Optional[float] = None,
+                 wire_dtype: Optional[str] = None,
+                 overlap: Optional[bool] = None):
+        self.net = net
+        self.requested = env_mode() if mode is None else mode
+        if self.requested not in MODES:
+            raise ValueError(f"grad-sync mode {self.requested!r}: "
+                             f"expected one of {'|'.join(MODES)}")
+        self._bucket_mb = bucket_mb
+        self._wire_env = (env_wire_dtype() if wire_dtype is None
+                          else wire_dtype)
+        if overlap is None:
+            overlap = os.environ.get("COS_GRAD_OVERLAP", "1") != "0"
+        self.overlap = bool(overlap)
+        self.mesh = None
+        self._skip: FrozenSet[Tuple[str, str]] = frozenset()
+        self._plan: Optional[GradSyncPlan] = None
+        self._hooks: Dict[int, object] = {}
+
+    # -- topology ------------------------------------------------------
+    def bind_mesh(self, mesh,
+                  skip_blobs: FrozenSet[Tuple[str, str]] = frozenset()
+                  ) -> "GradSync":
+        """Called by ParallelSolver before any step is traced: the mesh
+        resolves `auto`, enables the sharding constraints, and excludes
+        tp/ep-sharded blobs (their grads are sharded, not replicated —
+        bucketing them would force a pessimizing all-gather)."""
+        self.mesh = mesh
+        self._skip = frozenset(skip_blobs)
+        self._plan = None
+        self._hooks.clear()
+        return self
+
+    @property
+    def mode(self) -> str:
+        if self.requested != "auto":
+            return self.requested
+        dp = self.mesh.shape.get("dp", 1) if self.mesh is not None else 1
+        if dp <= 1:
+            return "default"
+        return "hier" if jax.process_count() > 1 else "bucket"
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "default"
+
+    @property
+    def plan(self) -> GradSyncPlan:
+        if self._plan is None or self._plan.mode != self.mode:
+            self._plan = build_plan(self.net, self.mode,
+                                    bucket_mb=self._bucket_mb,
+                                    wire_dtype=self._wire_env,
+                                    skip_blobs=self._skip)
+        return self._plan
+
+    @property
+    def needs_rng(self) -> bool:
+        return self.enabled and self.plan.wire_dtype == "int8"
+
+    def use_hooks(self, iter_size: int) -> bool:
+        """Backward hooks need a deterministic bwd rule (no rng) and
+        one exchange per optimizer step (iter_size == 1)."""
+        return (self.enabled and self.overlap and iter_size <= 1
+                and not self.needs_rng)
+
+    # -- the per-bucket wire transform ---------------------------------
+    def _dp_on(self) -> bool:
+        return (self.mesh is not None
+                and self.mesh.shape.get("dp", 1) > 1)
+
+    def _replicate(self, x: Array) -> Array:
+        """Pin the exchange point: the value must be replicated (i.e.
+        all-reduced) HERE, at x's current dtype."""
+        if not self._dp_on():
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P()))
+
+    def _two_phase(self, x: Array) -> Array:
+        """hier: dp-sharded first (reduce-scatter placement), then
+        replicated (all-gather) — the two-phase decomposition XLA maps
+        intra-ring first on multihost meshes."""
+        if not self._dp_on():
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        dp = self.mesh.shape["dp"]
+        n = x.shape[0]
+        pad = (-n) % dp
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P("dp")))
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P()))
+        return x[:n] if pad else x
+
+    def _transform_flat(self, flat: Array,
+                        rng: Optional[Array]) -> Array:
+        mode, wire = self.mode, self.plan.wire_dtype
+        orig = flat.dtype
+        if wire == "int8":
+            q, scale = quantize_int8(flat, rng)
+            q = (self._two_phase(q) if mode == "hier"
+                 else self._replicate(q))
+            return dequantize_int8(q, scale, orig)
+        if wire == "bfloat16" and orig != jnp.bfloat16:
+            flat = flat.astype(jnp.bfloat16)
+        flat = (self._two_phase(flat) if mode == "hier"
+                else self._replicate(flat))
+        return flat.astype(orig) if flat.dtype != orig else flat
+
+    def _transform_bucket(self, bucket: Bucket, leaves: List[Array],
+                          rng: Optional[Array]) -> List[Array]:
+        flats = [g.reshape(-1) for g in leaves]
+        flat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        flat = self._transform_flat(flat, rng)
+        out, off = [], 0
+        for g, shape in zip(leaves, bucket.shapes):
+            n = int(np.prod(shape)) if shape else 1
+            out.append(flat[off:off + n].reshape(shape))
+            off += n
+        return out
+
+    # -- path 1: backward hooks (overlap) ------------------------------
+    def _hook(self, bucket: Bucket):
+        """custom_vjp identity over the bucket's blobs: fwd passes the
+        params through untouched; bwd fires where the bucket's LAST
+        cotangent is available and re-emits all of them through the
+        flat wire buffer + collective constraint."""
+        h = self._hooks.get(bucket.index)
+        if h is not None:
+            return h
+
+        @jax.custom_vjp
+        def hook(*blobs):
+            return blobs
+
+        def fwd(*blobs):
+            return blobs, None
+
+        def bwd(_, cts):
+            return tuple(self._transform_bucket(bucket, list(cts),
+                                                None))
+
+        hook.defvjp(fwd, bwd)
+        self._hooks[bucket.index] = hook
+        return hook
+
+    def attach(self, params: Dict) -> Dict:
+        """Wrap params with the per-bucket backward hooks (call inside
+        the loss function, on the value being differentiated)."""
+        out = {ln: dict(bl) for ln, bl in params.items()}
+        for bucket in self.plan.buckets:
+            vals = tuple(out[ln][bn] for ln, bn in bucket.entries)
+            new = self._hook(bucket)(*vals)
+            for (ln, bn), v in zip(bucket.entries, new):
+                out[ln][bn] = v
+        return out
+
+    # -- path 2: finished-grad transform -------------------------------
+    def exchange(self, grads: Dict,
+                 rng: Optional[Array] = None) -> Dict:
+        """Apply the identical per-bucket transform to a finished grad
+        pytree (iter_size accumulation / int8 stochastic rounding)."""
+        if not self.enabled:
+            return grads
+        out = {ln: dict(bl) for ln, bl in grads.items()}
+        for bucket in self.plan.buckets:
+            sub = (jax.random.fold_in(rng, bucket.index)
+                   if rng is not None and self.needs_rng else None)
+            leaves = [out[ln][bn] for ln, bn in bucket.entries]
+            new = self._transform_bucket(bucket, leaves, sub)
+            for (ln, bn), v in zip(bucket.entries, new):
+                out[ln][bn] = v
+        return out
+
+
+def make_gradsync(net, **kw) -> GradSync:
+    return GradSync(net, **kw)

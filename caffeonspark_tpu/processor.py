@@ -323,6 +323,12 @@ class CaffeProcessor:
         try:
             import jax
             solver, ps = self.solver, self.psolver
+            # gradient-exchange plan (COS_GRAD_SYNC) into the
+            # step-timeline artifact: every pipeline-metrics JSON
+            # states the per-step wire bytes / buckets / wire dtype
+            gs = getattr(solver, "grad_sync", None)
+            if gs is not None:
+                self.metrics.set_info("comm", gs.plan.comm_info())
             step = ps.train_step()
             eval_step = (ps.eval_step()
                          if solver.test_net is not None else None)

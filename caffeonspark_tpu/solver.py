@@ -87,7 +87,7 @@ class Solver:
     def __init__(self, solver_param: SolverParameter,
                  net_param: Optional[NetParameter] = None, *,
                  rank: int = 0, dtype=jnp.float32, compute_dtype=None,
-                 state_dtype=None):
+                 state_dtype=None, grad_sync=None):
         self.param = solver_param
         self.rank = rank
         # optimizer-history dtype (default: match each param blob).
@@ -155,6 +155,13 @@ class Solver:
         self.solver_type = (solver_param.type or "SGD").upper()
 
         self._lr_mults, self._decay_mults = self._collect_mults()
+        # explicit gradient-exchange layer (COS_GRAD_SYNC): inert in
+        # `default` mode; ParallelSolver binds the mesh before any step
+        # is traced.  Runtime import — parallel.dp imports this module.
+        if grad_sync is None:
+            from .parallel.gradsync import make_gradsync
+            grad_sync = make_gradsync(self.train_net)
+        self.grad_sync = grad_sync
         self._jit_train_step = None
         self._jit_train_step_many: Dict[int, object] = {}
         self._jit_eval_step = None
@@ -302,9 +309,19 @@ class Solver:
         tmajor = {n for n, _, kind in net.input_specs
                   if kind.endswith(":T")}
         stat_layers = net.stat_param_layers()
+        # explicit gradient exchange (parallel/gradsync.py): backward
+        # hooks emit each bucket's collective mid-backward when
+        # eligible; otherwise the finished grad pytree is transformed
+        # below.  Both trace-time booleans — `default` mode adds no ops
+        # and the step stays byte-identical to the implicit exchange.
+        gs = self.grad_sync
+        hooks_on = gs is not None and gs.use_hooks(iter_size)
+        exchange_on = (gs is not None and gs.enabled and not hooks_on)
 
         def loss_and_grads(params, inputs, rng):
             def loss_fn(p):
+                if hooks_on:
+                    p = gs.attach(p)
                 total, (blobs, fwd_state) = net.loss(p, inputs,
                                                      train=True, rng=rng)
                 return total, (blobs, fwd_state)
@@ -334,6 +351,8 @@ class Solver:
             if iter_size == 1:
                 (loss, (blobs, fwd_state)), grads = loss_and_grads(
                     params, inputs, rng)
+                if exchange_on:
+                    grads = gs.exchange(grads, rng)
                 outputs = {name: blobs[name]
                            for name in net.output_blobs}
             else:
@@ -369,6 +388,11 @@ class Solver:
                     body, (stats0, zero_g, zero_o), (subs, rngs))
                 grads = jax.tree_util.tree_map(
                     lambda g: g / iter_size, gsum)
+                if exchange_on:
+                    # ONE exchange per optimizer step, after the
+                    # iter_size accumulation (Caffe's Normalize-then-
+                    # exchange order)
+                    grads = gs.exchange(grads, rng)
                 outputs = {name: v / iter_size
                            for name, v in osum.items()}
                 fwd_state = {ln: [stats[ln][bn] for bn, _, _ in

@@ -14,12 +14,19 @@ Model (estimate-grade, stated so the numbers are auditable):
     (16 bytes/param) regardless of compute dtype;
   * --fused drops elementwise layers' activation traffic (XLA fuses
     ReLU/Dropout/eltwise into the producing matmul/conv) — the fused
-    and unfused totals bracket reality.
+    and unfused totals bracket reality;
+  * gradient exchange (--dp > 1): per-layer ring all-reduce wire
+    traffic 2·params·wire_bytes·(dp-1)/dp against --interconnect-gbs,
+    wire dtype from --grad-sync (default/bucket f32, quant bf16 — or
+    --wire-dtype), hier dividing the slow hop by --local.  The report
+    shows the comm-vs-compute crossover: whether the exchange hides
+    under the step (overlap modes) or serializes after it (default).
 
 Usage:
   python scripts/roofline.py [--net PATH] [--batch N]
       [--dtype mixed|float32] [--peak-tflops 197] [--hbm-gbs 819]
-      [--fused] [--json]
+      [--fused] [--json] [--dp N] [--grad-sync MODE]
+      [--interconnect-gbs 50] [--local N]
 
 Defaults model TPU v5e (197 bf16 TFLOP/s, 819 GB/s HBM) and the
 bench.py default config (bvlc_reference_net @ batch 256, mixed).
@@ -82,6 +89,21 @@ def main():
     ap.add_argument("--hbm-gbs", type=float, default=819.0)
     ap.add_argument("--fused", action="store_true")
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--dp", type=int, default=1,
+                    help="data-parallel ranks for the gradient-"
+                    "exchange accounting (1 = no exchange)")
+    ap.add_argument("--grad-sync", default="default",
+                    choices=["default", "bucket", "quant", "hier"],
+                    help="COS_GRAD_SYNC mode the exchange models")
+    ap.add_argument("--wire-dtype", default=None,
+                    choices=["bfloat16", "int8"],
+                    help="override the exchange wire dtype")
+    ap.add_argument("--interconnect-gbs", type=float, default=50.0,
+                    help="all-reduce wire bandwidth per device (GB/s; "
+                    "ICI ~50-100, cross-host DCN ~3-25)")
+    ap.add_argument("--local", type=int, default=1,
+                    help="modeled intra-host group size hier divides "
+                    "the slow hop by")
     args = ap.parse_args()
 
     from caffeonspark_tpu.net import Net
@@ -132,11 +154,53 @@ def main():
     ceil_ips = args.batch / t_roof * 1e6
     ceil_mfu = total_flops / (t_roof * 1e-6) / peak
 
+    # gradient-exchange wire traffic per layer (ring all-reduce model:
+    # each device moves 2·P·(dp-1)/dp bytes per blob at the wire dtype)
+    dp = max(1, args.dp)
+    wire = args.wire_dtype or ("bfloat16" if args.grad_sync == "quant"
+                               else None)
+    wire_b = {None: 4, "bfloat16": 2, "int8": 1}[wire]
+    icbw = args.interconnect_gbs * 1e9
+    hier_div = max(1, args.local) if args.grad_sync == "hier" else 1
+    t_comm = 0.0
+    comm_bytes_total = 0
+    for r in rows:
+        cb = (2.0 * r["params"] * wire_b * (dp - 1) / dp / hier_div
+              if dp > 1 else 0.0)
+        r["comm_bytes"] = int(cb)
+        r["t_comm_us"] = cb / icbw * 1e6
+        t_comm += r["t_comm_us"]
+        comm_bytes_total += int(cb)
+    overlap = args.grad_sync in ("bucket", "quant", "hier")
+    # overlap modes hide comm under the backward; default serializes it
+    t_step_eff = (max(t_roof, t_comm) if overlap else t_roof + t_comm)
+    comm_bound = t_comm > t_roof
+    # crossover: smallest dp where the exchange dominates the step
+    # (t_comm scales with (dp-1)/dp toward its asymptote)
+    total_params = sum(r["params"] for r in rows)
+    asym_us = 2.0 * total_params * wire_b / hier_div / icbw * 1e6
+    ratio = t_roof / asym_us if asym_us > 0 else float("inf")
+    crossover_dp = (None if ratio >= 1.0
+                    else max(2, int(1.0 / (1.0 - ratio)) + 1))
+    comm = {
+        "dp": dp, "grad_sync": args.grad_sync,
+        "wire_dtype": wire or "float32",
+        "interconnect_gbs": args.interconnect_gbs,
+        "hier_local": args.local,
+        "comm_bytes_per_step": comm_bytes_total,
+        "t_comm_us": round(t_comm, 1),
+        "overlapped": overlap,
+        "effective_step_us": round(t_step_eff, 1),
+        "comm_bound": comm_bound,
+        "crossover_dp": crossover_dp,
+    }
+
     if args.json:
         print(json.dumps({"rows": rows, "total_flops": total_flops,
                           "roofline_step_us": round(t_roof, 1),
                           "ceiling_images_per_sec": round(ceil_ips, 0),
                           "ceiling_mfu": round(ceil_mfu, 4),
+                          "comm": comm,
                           "config": vars(args)}))
         return
 
@@ -145,17 +209,35 @@ def main():
     print(f"# peak {args.peak_tflops} TFLOP/s, HBM {args.hbm_gbs} GB/s")
     hdr = (f"{'layer':<12}{'type':<16}{'GFLOPs':>9}{'MB':>9}"
            f"{'t_flop':>9}{'t_mem':>9}{'bound':>6}")
+    if dp > 1:
+        hdr += f"{'commMB':>9}{'t_comm':>9}"
     print(hdr)
     for r in rows:
         if r["t_us"] < 1.0:
             continue
-        print(f"{r['layer']:<12}{r['type']:<16}"
-              f"{r['flops'] / 1e9:>9.1f}{r['bytes'] / 1e6:>9.1f}"
-              f"{r['t_flop_us']:>8.0f}u{r['t_mem_us']:>8.0f}u"
-              f"{r['bound']:>6}")
+        line = (f"{r['layer']:<12}{r['type']:<16}"
+                f"{r['flops'] / 1e9:>9.1f}{r['bytes'] / 1e6:>9.1f}"
+                f"{r['t_flop_us']:>8.0f}u{r['t_mem_us']:>8.0f}u"
+                f"{r['bound']:>6}")
+        if dp > 1:
+            line += (f"{r['comm_bytes'] / 1e6:>9.2f}"
+                     f"{r['t_comm_us']:>8.0f}u")
+        print(line)
     print(f"\nroofline step time : {t_roof:>8.0f} us")
     print(f"ceiling throughput : {ceil_ips:>8.0f} images/sec")
     print(f"ceiling MFU        : {ceil_mfu * 100:>7.1f} %")
+    if dp > 1:
+        verb = "overlaps backward" if overlap else "serializes"
+        print(f"\ngrad exchange      : {comm_bytes_total / 1e6:>8.1f}"
+              f" MB/step on the wire ({comm['wire_dtype']}, dp={dp}, "
+              f"{args.grad_sync})")
+        print(f"exchange time      : {t_comm:>8.0f} us "
+              f"@ {args.interconnect_gbs:.0f} GB/s ({verb}; "
+              f"{'COMM' if comm_bound else 'compute'}-bound)")
+        print(f"effective step     : {t_step_eff:>8.0f} us")
+        if crossover_dp is not None and not comm_bound:
+            print(f"comm/compute crossover at dp≈{crossover_dp} "
+                  f"(exchange asymptote {asym_us:.0f} us)")
 
 
 if __name__ == "__main__":

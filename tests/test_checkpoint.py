@@ -278,8 +278,7 @@ def test_sharded_snapshot_elastic_reshard_resume(tmp_path):
                                  weights_path=m)
     p4 = ps4.shard_params(p4)
     st4 = ps4.shard_opt_state(st4)
-    assert tuple(st4.history["fc_big"]["weight"].sharding.spec)[0] \
-        == "dp"
+    assert "dp" in tuple(st4.history["fc_big"]["weight"].sharding.spec)
     _, _, out4 = ps4.train_step()(p4, st4, ps4.shard_batch(nxt),
                                   s4.step_rng(3))
     assert float(out8["loss"]) == pytest.approx(float(out4["loss"]),
@@ -359,8 +358,7 @@ def test_zero1_composes_with_iter_size():
                               sz.step_rng(i))
         assert float(out1["loss"]) == pytest.approx(
             float(outz["loss"]), rel=2e-4), i
-    assert tuple(stz.history["fc_big"]["weight"].sharding.spec)[0] \
-        == "dp"
+    assert "dp" in tuple(stz.history["fc_big"]["weight"].sharding.spec)
 
 
 def test_sharded_state_write_main_false_writes_only_sidecar(tmp_path):
