@@ -6,9 +6,9 @@ PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: build native install test test-slow spark-test bench smoke \
-  tpu-tests bench-evidence bench-ingest bench-steploop bench-serving \
-  bench-gradsync onchip-artifacts docs clean
+.PHONY: build native install lint test test-slow spark-test bench \
+  smoke tpu-tests bench-evidence bench-ingest bench-steploop \
+  bench-serving bench-gradsync onchip-artifacts docs clean
 
 build: native install
 
@@ -17,6 +17,22 @@ native:
 
 install:
 	$(PY) -m pip install -e . --no-deps --no-build-isolation
+
+# coslint (JAX/concurrency rules COS001..COS005, see
+# docs/architecture.md "Correctness tooling") against the checked-in
+# zero-findings baseline, then ruff (pyflakes + import hygiene,
+# [tool.ruff] in pyproject.toml) when the container has it — the
+# minimal test image does not, and the tier-1 gate must not depend on
+# an installer
+lint:
+	$(PY) -m caffeonspark_tpu.analysis \
+	  --baseline artifacts/coslint_baseline.json
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check caffeonspark_tpu tests scripts; \
+	else \
+	  echo "lint: ruff not installed — coslint only (ruff config" \
+	       "lives in pyproject.toml [tool.ruff])"; \
+	fi
 
 # tier-1 shape: slow/e2e tests (subprocess fleets, offline-hanging
 # gcsfs, minute-long zoo compiles) run via `make test-slow`, not here

@@ -1,0 +1,39 @@
+"""coslint: static analysis + runtime trace-safety guards.
+
+Every hard bug this repo has shipped a fix for belongs to a
+mechanically detectable class — CPU `device_put` host-buffer aliasing
+(the PR 3 ingest hazard), silent MXU precision loss on f32-consuming
+einsums (the sp.py ring-backward fix), trace-time host reads baked
+into jitted programs, use-after-donation, and locks held across
+blocking calls in the threaded runtime.  This package is the
+correctness-tooling layer that keeps those classes out:
+
+  * `coslint` / `rules` — an AST linter with rules COS001..COS005,
+    run as `python -m caffeonspark_tpu.analysis` (or `make lint`) and
+    enforced by the tier-1 suite (tests/test_coslint.py) against the
+    checked-in baseline `artifacts/coslint_baseline.json`;
+  * `runtime` — `RecompileGuard` (fails when steady state recompiles,
+    `COS_RECOMPILE_GUARD=1`), a debug-mode donation poisoner
+    (`COS_DONATION_POISON=1`), and `LockWitness`, the runtime
+    lock-order/race witness behind COS005's stress tests.
+
+Suppression syntax (see coslint.py): `# coslint: disable=COS001` on
+the flagged line (or the enclosing `def` line), and
+`# coslint: disable-file=COS003` for a whole module — always with a
+trailing reason.
+"""
+
+from .coslint import (Finding, LintResult, baseline_keys, load_baseline,
+                      run_lint, write_baseline)
+from .rules import ALL_RULES, Rule
+from .runtime import (LockOrderError, LockWitness, RecompileError,
+                      RecompileGuard, maybe_poison_donation,
+                      maybe_recompile_guard, poison_donation)
+
+__all__ = [
+    "Finding", "LintResult", "run_lint", "load_baseline",
+    "write_baseline", "baseline_keys", "ALL_RULES", "Rule",
+    "RecompileGuard", "RecompileError", "maybe_recompile_guard",
+    "poison_donation", "maybe_poison_donation",
+    "LockWitness", "LockOrderError",
+]

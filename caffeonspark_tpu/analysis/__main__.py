@@ -1,0 +1,89 @@
+"""CLI: `python -m caffeonspark_tpu.analysis [paths...]`.
+
+Exit codes: 0 = clean (or everything baselined), 1 = non-baselined
+findings, 2 = bad usage.  `make lint` runs this against the package
+with the checked-in baseline; tests/test_coslint.py runs the same
+check inside the tier-1 suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .coslint import (baseline_keys, load_baseline, run_lint,
+                      write_baseline)
+from .rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m caffeonspark_tpu.analysis",
+        description="coslint: JAX/concurrency static analysis "
+                    "(rules COS001..COS005)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the "
+                         "caffeonspark_tpu package)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON; findings listed there do not "
+                         "fail the run (artifacts/coslint_baseline.json)")
+    ap.add_argument("--write-baseline", default=None, metavar="PATH",
+                    help="write current findings as the new baseline "
+                         "and exit 0")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.id}  {r.title}")
+            doc = (r.__doc__ or "").strip()
+            for line in doc.splitlines():
+                print(f"    {line.strip()}")
+            print()
+        return 0
+
+    result = run_lint(args.paths or None)
+
+    if args.write_baseline:
+        os.makedirs(os.path.dirname(args.write_baseline) or ".",
+                    exist_ok=True)
+        write_baseline(args.write_baseline, result)
+        print(f"coslint: baseline with {len(result.findings)} "
+              f"finding(s) -> {args.write_baseline}")
+        return 0
+
+    baselined = set()
+    if args.baseline and os.path.exists(args.baseline):
+        baselined = load_baseline(args.baseline)
+    fresh = [f for f in result.findings if f.key not in baselined]
+    stale = baselined - baseline_keys(result.findings)
+
+    if args.json:
+        print(json.dumps({
+            "files": result.files,
+            "suppressed": result.suppressed,
+            "findings": [{"rule": f.rule, "path": f.path,
+                          "line": f.line, "col": f.col,
+                          "message": f.message} for f in fresh],
+            "baselined": len(result.findings) - len(fresh),
+        }, indent=2))
+    else:
+        for f in fresh:
+            print(f.render())
+        print(f"coslint: {result.files} file(s), "
+              f"{len(fresh)} finding(s)"
+              f" ({len(result.findings) - len(fresh)} baselined, "
+              f"{result.suppressed} suppressed in source)")
+        if stale:
+            print(f"coslint: note — {len(stale)} baseline entr"
+                  f"{'y is' if len(stale) == 1 else 'ies are'} no "
+                  "longer produced (baseline can be re-written)")
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,293 @@
+"""coslint core: module loading, suppressions, baseline, reporting.
+
+The linter is deliberately dependency-free (stdlib `ast` only) so it
+runs in the same minimal container as the tests.  A rule receives a
+parsed `ModuleCtx` and yields `Finding`s; this module owns everything
+around the rules — which files to walk, how `# coslint: disable=`
+comments scope, and how findings compare against the checked-in
+baseline (`artifacts/coslint_baseline.json`).
+
+Suppression scopes:
+
+  * line  — `# coslint: disable=COS001 -- reason` on the flagged line
+    suppresses the named rule(s) for that line only;
+  * block — the same comment on a `def` / `class` / `with` header line
+    suppresses the rule(s) for the whole statement's body (the header
+    is where reviewers look for the reason);
+  * file  — `# coslint: disable-file=COS003 -- reason` anywhere in the
+    module suppresses the rule(s) module-wide.
+
+`disable=all` is accepted but discouraged — the baseline exists so
+every live suppression names the rule it silences and why.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_DISABLE_RE = re.compile(
+    r"#\s*coslint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_, ]+?|all)\s*(?:--|$)")
+
+# directories never linted even when inside a target path
+_SKIP_DIRS = {"__pycache__", ".git", "build", "_html"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation.  Baseline identity is (rule, path, message)
+    — line/col are for humans and drift with edits, so they stay out
+    of the key."""
+    rule: str
+    path: str              # repo-relative (or as-given) posix path
+    line: int
+    col: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.message}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} {self.message}")
+
+
+class ModuleCtx:
+    """Parsed module handed to rules: source, AST, parent links, and
+    the suppression table."""
+
+    def __init__(self, path: str, source: str, rel: Optional[str] = None):
+        self.path = path
+        self.rel = rel or path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self._line_disable: Dict[int, Set[str]] = {}
+        self._file_disable: Set[str] = set()
+        self._parse_suppressions()
+        # block scopes: a disable on a def/class/with header covers the
+        # statement's whole [lineno, end_lineno] range
+        self._block_disable: List[Tuple[int, int, Set[str]]] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.With)):
+                rules = self._line_disable.get(node.lineno)
+                if rules:
+                    self._block_disable.append(
+                        (node.lineno, node.end_lineno or node.lineno,
+                         rules))
+
+    def _parse_suppressions(self):
+        # real COMMENT tokens only — the syntax quoted inside a string
+        # or docstring (e.g. this very module's) must not register
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError):
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DISABLE_RE.search(tok.string)
+            if not m:
+                continue
+            kind, names = m.group(1), m.group(2)
+            rules = {r.strip().upper() for r in names.split(",")
+                     if r.strip()}
+            if kind == "disable-file":
+                self._file_disable |= rules
+            else:
+                self._line_disable.setdefault(
+                    tok.start[0], set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if "ALL" in self._file_disable or rule in self._file_disable:
+            return True
+        at = self._line_disable.get(line, ())
+        if "ALL" in at or rule in at:
+            return True
+        for lo, hi, rules in self._block_disable:
+            if lo <= line <= hi and ("ALL" in rules or rule in rules):
+                return True
+        return False
+
+    # -- shared AST helpers used by several rules ----------------------
+    def enclosing(self, node: ast.AST, kinds) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, kinds):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_class_name(self, node: ast.AST) -> str:
+        cls = self.enclosing(node, ast.ClassDef)
+        return cls.name if cls is not None else ""
+
+
+def dotted(node: ast.AST) -> str:
+    """`jax.device_put` / `self._q.put` → the dotted source string;
+    '' for anything that is not a plain Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function/module body WITHOUT descending into nested
+    function/class definitions — each def is its own rule scope."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def scopes(ctx: ModuleCtx):
+    """Every rule scope in the module: the module body plus each
+    (possibly nested) function def."""
+    yield ctx.tree
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def shares_loop(ctx: ModuleCtx, a: ast.AST, b: ast.AST,
+                scope: ast.AST) -> bool:
+    """True when a and b sit under one loop inside `scope` — textual
+    order then says nothing about execution order (the reused-buffer
+    pattern: mutate on the NEXT iteration)."""
+    def loop_ancestors(n):
+        out = []
+        cur = ctx.parents.get(n)
+        while cur is not None and cur is not scope:
+            if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+                out.append(cur)
+            cur = ctx.parents.get(cur)
+        return out
+
+    la, lb = loop_ancestors(a), loop_ancestors(b)
+    return any(x in lb for x in la)
+
+
+# ---------------------------------------------------------------- run
+
+@dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: int
+    files: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def default_target() -> str:
+    """The package itself — `python -m caffeonspark_tpu.analysis` with
+    no arguments lints the whole ~25-module tree."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_lint(paths: Optional[Sequence[str]] = None, *,
+             rules=None, rel_root: Optional[str] = None) -> LintResult:
+    from .rules import ALL_RULES
+    rules = list(rules) if rules is not None else \
+        [r() for r in ALL_RULES]
+    if not paths:
+        paths = [default_target()]
+        rel_root = rel_root or os.path.dirname(paths[0])
+    findings: List[Finding] = []
+    suppressed = 0
+    files = 0
+    for path in iter_py_files(paths):
+        rel = (os.path.relpath(path, rel_root).replace(os.sep, "/")
+               if rel_root else path.replace(os.sep, "/"))
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            ctx = ModuleCtx(path, source, rel=rel)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                "COS000", rel, getattr(e, "lineno", 1) or 1, 0,
+                f"unparseable module: {e.__class__.__name__}"))
+            continue
+        files += 1
+        seen: Set[Tuple[str, int, int, str]] = set()
+        for rule in rules:
+            for f in rule.check(ctx):
+                ident = (f.rule, f.line, f.col, f.message)
+                if ident in seen:       # e.g. nested attribute nodes
+                    continue
+                seen.add(ident)
+                if ctx.suppressed(f.rule, f.line):
+                    suppressed += 1
+                else:
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintResult(findings=findings, suppressed=suppressed,
+                      files=files)
+
+
+# ---------------------------------------------------------------- baseline
+
+def baseline_keys(findings: Iterable[Finding]) -> Set[str]:
+    return {f.key for f in findings}
+
+
+def load_baseline(path: str) -> Set[str]:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    return {f["rule"] + ":" + f["path"] + ":" + f["message"]
+            for f in doc.get("findings", [])}
+
+
+def write_baseline(path: str, result: LintResult):
+    doc = {
+        "version": 1,
+        "note": ("coslint baseline: findings listed here are known and "
+                 "tolerated; the tier-1 gate fails on anything NOT in "
+                 "this list.  Kept at zero findings — fix or suppress "
+                 "with a reasoned `# coslint: disable=` instead of "
+                 "baselining."),
+        "files_scanned": result.files,
+        "suppressed_in_source": result.suppressed,
+        "findings": [{"rule": f.rule, "path": f.path,
+                      "message": f.message} for f in result.findings],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")

@@ -1,0 +1,355 @@
+"""Runtime trace-safety guards: the dynamic half of coslint.
+
+Static rules catch what the AST shows; these guards catch what only
+shows up while running:
+
+  * `RecompileGuard` — counts XLA compilations of watched jitted
+    callables (via their compiled-program cache size) and FAILS when
+    steady state recompiles.  A recompilation storm is the runtime
+    face of COS003 (trace-time host reads) and of shape drift — the
+    exact failure classes the fused train loop (PR 4) and the serving
+    buckets (PR 5) exist to prevent.  `COS_RECOMPILE_GUARD=1` arms it
+    inside Solver and InferenceService; tests use it directly via the
+    `recompile_guard` pytest fixture (tests/conftest.py).
+
+  * `poison_donation` — the debug-mode donation poisoner behind
+    COS004: after every call of a donating jitted function it
+    `.delete()`s the donated input arrays, so use-after-donation
+    fails loudly on EVERY backend (CPU ignores donation and would
+    otherwise alias silently).  `COS_DONATION_POISON=1`.
+
+  * `LockWitness` — the lock-order/race witness behind COS005's
+    stress tests: wraps locks/conditions on live objects, records the
+    per-thread acquisition graph, and reports order inversions
+    (`a → b` in one thread, `b → a` in another — a latent deadlock
+    even when the schedule never trips it).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+
+def _env_on(name: str) -> bool:
+    return os.environ.get(name, "").lower() not in ("", "0", "false",
+                                                    "no")
+
+
+# ---------------------------------------------------------------- recompile
+
+class RecompileError(RuntimeError):
+    """A watched jitted function compiled in steady state."""
+
+
+class RecompileGuard:
+    """Watch jitted callables; fail when steady state recompiles.
+
+    Two phases per watched function:
+
+      * warm-up — compiles are expected (first call per shape); each
+        function gets `allow` of them (None = unlimited until
+        `mark_steady()`);
+      * steady — entered by `mark_steady()` (all watched functions at
+        once, e.g. after serving warm-up) or automatically once a
+        function exhausts its `allow`; ANY further cache growth raises
+        RecompileError naming the function.
+
+    Counting uses the jitted function's `_cache_size()` (one entry per
+    compiled (shapes, dtypes, shardings) signature), so the guard adds
+    no tracing overhead and never perturbs numerics — parity pins hold
+    with the guard armed.  Enforcement is per-call through the wrapper
+    returned by `watch`, plus pull-style via `check()` for callers
+    that invoke the underlying function directly.
+    """
+
+    def __init__(self, name: str = "recompile-guard"):
+        self.name = name
+        self._lock = threading.Lock()
+        # fn name -> [fn, allowance (None = unlimited), steady bool,
+        #             baseline cache size at steady entry]
+        self._watched: Dict[str, List[Any]] = {}
+
+    @staticmethod
+    def _cache_size(fn) -> Optional[int]:
+        probe = getattr(fn, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            return int(probe())
+        except Exception:       # noqa: BLE001 — jax internals moved
+            return None
+
+    def watch(self, name: str, fn: Callable, *,
+              allow: Optional[int] = None) -> Callable:
+        """Register `fn` and return a wrapper that enforces after
+        every call.  The wrapper is numerically transparent."""
+        with self._lock:
+            self._watched[name] = [fn, allow, False,
+                                   self._cache_size(fn) or 0]
+
+        def guarded(*args, **kwargs):
+            out = fn(*args, **kwargs)
+            self._check_one(name)
+            return out
+
+        guarded.__wrapped__ = fn
+        guarded._recompile_guard = self       # introspection for tests
+        return guarded
+
+    def compiles(self) -> Dict[str, int]:
+        with self._lock:
+            return {name: self._cache_size(entry[0]) or 0
+                    for name, entry in self._watched.items()}
+
+    def mark_steady(self):
+        """Snapshot every watched function's current compile count as
+        its steady-state ceiling."""
+        with self._lock:
+            for entry in self._watched.values():
+                entry[2] = True
+                entry[3] = self._cache_size(entry[0]) or 0
+
+    def _check_one(self, name: str):
+        with self._lock:
+            entry = self._watched.get(name)
+            if entry is None:
+                return
+            fn, allow, steady, baseline = entry
+            size = self._cache_size(fn)
+            if size is None:
+                return
+            if not steady:
+                if allow is not None and size >= allow:
+                    entry[2], entry[3] = True, max(size, allow)
+                    steady, baseline = True, entry[3]
+                else:
+                    entry[3] = size
+                    return
+            if size > baseline:
+                # advance the ceiling BEFORE raising: one violation
+                # fails one call (the serving flush that paid the
+                # compile), not every call after it — cache hits on
+                # the already-compiled programs stay healthy
+                entry[3] = size
+        if size > baseline:
+            raise RecompileError(
+                f"{self.name}: '{name}' recompiled in steady state "
+                f"({size} compiled programs, steady ceiling "
+                f"{baseline}) — shape drift or a trace-time host "
+                "read (COS003); see docs/architecture.md "
+                "'Correctness tooling'")
+
+    def check(self):
+        """Pull-style enforcement over every watched function."""
+        for name in list(self._watched):
+            self._check_one(name)
+
+
+def maybe_recompile_guard(name: str) -> Optional[RecompileGuard]:
+    """A fresh guard when COS_RECOMPILE_GUARD=1, else None — the
+    pattern Solver/InferenceService use so the default path carries
+    zero overhead."""
+    return RecompileGuard(name) if _env_on("COS_RECOMPILE_GUARD") \
+        else None
+
+
+def maybe_guard_jit(guard: Optional[RecompileGuard], name: str,
+                    fn: Callable, *, allow: Optional[int] = 1
+                    ) -> Callable:
+    """Wrap `fn` under `guard` when armed; identity otherwise."""
+    if guard is None:
+        return fn
+    return guard.watch(name, fn, allow=allow)
+
+
+# ---------------------------------------------------------------- donation
+
+def poison_donation(fn: Callable, donate_argnums: Tuple[int, ...]
+                    ) -> Callable:
+    """Debug-mode donation poisoner (COS004's runtime teeth): after
+    each call, delete every device array that was passed in a donated
+    position, so any later use raises jax's deleted-buffer error
+    instead of reading stale or aliased memory.  Backends that honor
+    donation already invalidated them — this makes the backends that
+    DON'T (CPU) behave the same, which is exactly what a debug mode
+    wants: the bug reproduces everywhere."""
+    import jax
+
+    def poisoned(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        for pos in donate_argnums:
+            if pos >= len(args):
+                continue
+            for leaf in jax.tree_util.tree_leaves(args[pos]):
+                if isinstance(leaf, jax.Array):
+                    try:
+                        if not leaf.is_deleted():
+                            leaf.delete()
+                    except Exception:   # noqa: BLE001 — committed donation
+                        pass
+        return out
+
+    poisoned.__wrapped__ = fn
+    return poisoned
+
+
+def maybe_poison_donation(fn: Callable,
+                          donate_argnums: Tuple[int, ...]) -> Callable:
+    return poison_donation(fn, donate_argnums) \
+        if _env_on("COS_DONATION_POISON") else fn
+
+
+# ---------------------------------------------------------------- locks
+
+class LockOrderError(RuntimeError):
+    """LockWitness.assert_quiet() found order inversions."""
+
+
+class LockViolation(NamedTuple):
+    kind: str            # "inversion"
+    thread: str
+    held: str            # lock already held
+    acquiring: str       # lock being acquired under it
+    note: str
+
+
+class LockWitness:
+    """Dynamic lock-order witness (COS005's runtime half).
+
+    Wrap the locks/conditions of live objects with `wrap()` (or
+    `witness_attrs()` for instance attributes); every acquisition
+    records an edge (held → acquiring) in a global order graph, and an
+    edge whose reverse was already seen — from ANY thread — is an
+    inversion: two threads can interleave those two call sites into a
+    deadlock even if this run never did.  Condition.wait releases the
+    held lock, so witnessed conditions drop out of the held set for
+    the duration of the wait (no false edge against locks taken by
+    the woken path)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._edges: Dict[Tuple[str, str], str] = {}
+        self._violations: List[LockViolation] = []
+        self._tls = threading.local()
+
+    # -- held-set bookkeeping ------------------------------------------
+    def _held(self) -> List[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _on_attempt(self, name: str):
+        held = self._held()
+        tname = threading.current_thread().name
+        with self._mu:
+            for h in held:
+                if h == name:
+                    continue
+                self._edges.setdefault((h, name), tname)
+                first = self._edges.get((name, h))
+                if first is not None:
+                    self._violations.append(LockViolation(
+                        "inversion", tname, h, name,
+                        f"'{tname}' acquires {name} under {h}, but "
+                        f"'{first}' acquired {h} under {name}"))
+
+    def _on_acquired(self, name: str):
+        self._held().append(name)
+
+    def _on_release(self, name: str):
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    # -- wrappers ------------------------------------------------------
+    def wrap(self, lock, name: str):
+        """Witness a Lock/RLock/Condition; the wrapper is a drop-in
+        (context manager + acquire/release [+ wait/notify])."""
+        if hasattr(lock, "wait") and hasattr(lock, "notify"):
+            return _WitnessedCondition(self, lock, name)
+        return _WitnessedLock(self, lock, name)
+
+    def witness_attrs(self, obj, *attrs: str, prefix: str = ""):
+        """Replace `obj.<attr>` locks with witnessed wrappers in
+        place; returns obj for chaining."""
+        base = prefix or type(obj).__name__
+        for attr in attrs:
+            inner = getattr(obj, attr)
+            setattr(obj, attr, self.wrap(inner, f"{base}.{attr}"))
+        return obj
+
+    # -- reporting -----------------------------------------------------
+    def violations(self) -> List[LockViolation]:
+        with self._mu:
+            return list(self._violations)
+
+    def edges(self) -> Dict[Tuple[str, str], str]:
+        with self._mu:
+            return dict(self._edges)
+
+    def assert_quiet(self):
+        v = self.violations()
+        if v:
+            lines = "; ".join(x.note for x in v[:5])
+            raise LockOrderError(
+                f"lock-order witness recorded {len(v)} "
+                f"inversion(s): {lines}")
+
+
+class _WitnessedLock:
+    def __init__(self, witness: LockWitness, inner, name: str):
+        self._w = witness
+        self._inner = inner
+        self._name = name
+
+    def acquire(self, *args, **kwargs):
+        self._w._on_attempt(self._name)
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._w._on_acquired(self._name)
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._w._on_release(self._name)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class _WitnessedCondition(_WitnessedLock):
+    """Condition wrapper: wait() releases the underlying lock, so the
+    held-set must drop the name for the wait's duration."""
+
+    def wait(self, timeout: Optional[float] = None):
+        self._w._on_release(self._name)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            self._w._on_acquired(self._name)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        self._w._on_release(self._name)
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            self._w._on_acquired(self._name)
+
+    def notify(self, n: int = 1):
+        self._inner.notify(n)
+
+    def notify_all(self):
+        self._inner.notify_all()

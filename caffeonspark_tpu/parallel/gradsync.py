@@ -203,8 +203,12 @@ def build_plan(net, mode: str, *, bucket_mb: Optional[float] = None,
                skip_blobs: FrozenSet[Tuple[str, str]] = frozenset()
                ) -> GradSyncPlan:
     """Bucket the net's param blobs in reverse-backward order (the
-    order their grads finalize: last compute layer first)."""
-    bucket_mb = env_bucket_mb() if bucket_mb is None else bucket_mb
+    order their grads finalize: last compute layer first).
+
+    No env reads here: `plan` is built lazily, possibly from inside a
+    traced `attach`/`exchange` (coslint COS003) — the COS_GRAD_BUCKET_MB
+    knob is resolved once at GradSync construction and passed in."""
+    bucket_mb = _DEFAULT_BUCKET_MB if bucket_mb is None else bucket_mb
     wire = _wire_for(mode, wire_dtype)
     grad_itemsize = jnp.dtype(net.dtype).itemsize
     wire_itemsize = (1 if wire == "int8" else
@@ -298,7 +302,11 @@ class GradSync:
         if self.requested not in MODES:
             raise ValueError(f"grad-sync mode {self.requested!r}: "
                              f"expected one of {'|'.join(MODES)}")
-        self._bucket_mb = bucket_mb
+        # resolved HERE, not in build_plan: the plan may be built
+        # lazily at trace time, where an env read would be baked into
+        # the compiled program (coslint COS003)
+        self._bucket_mb = (env_bucket_mb() if bucket_mb is None
+                           else float(bucket_mb))
         self._wire_env = (env_wire_dtype() if wire_dtype is None
                           else wire_dtype)
         if overlap is None:

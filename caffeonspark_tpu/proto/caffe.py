@@ -15,8 +15,7 @@ matter for config compatibility.
 from __future__ import annotations
 
 from .descriptor import (BOOL, BYTES, DOUBLE, ENUM, FLOAT, INT32, INT64,
-                         MESSAGE, STRING, UINT32, UINT64, Enum, Field,
-                         Message)
+                         MESSAGE, STRING, UINT32, Enum, Field, Message)
 
 # ---------------------------------------------------------------------------
 # enums

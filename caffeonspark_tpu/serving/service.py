@@ -96,6 +96,12 @@ class InferenceService:
             metrics=self.metrics)
         self._started = False
         self._dims = None        # lazy (C,H,W) for dict-record coercion
+        # COS_RECOMPILE_GUARD=1: after warmup pre-compiles every bucket
+        # program, a steady-state recompile means a request slipped
+        # past the buckets (shape drift) — fail the flush loudly
+        # instead of paying whole-program compilation in its latency
+        from ..analysis.runtime import maybe_recompile_guard
+        self._recompile_guard = maybe_recompile_guard("serving")
 
     @staticmethod
     def _build_source(conf) -> DataSource:
@@ -116,20 +122,30 @@ class InferenceService:
         pays whole-program compilation in its latency), then start the
         dispatcher."""
         assert not self._started, "service already started"
-        if warmup:
-            self.warmup()
+        warmed = self.warmup() if warmup else False
+        if self._recompile_guard is not None:
+            self._recompile_guard.watch(
+                "serving.forward",
+                self.registry.forward(self.blob_names))
+            # steady only when every bucket actually pre-compiled: a
+            # skipped warmup (geometry-less source, warmup=False)
+            # leaves the guard unarmed rather than counting the lazy
+            # first compile per bucket as a violation
+            if warmed:
+                self._recompile_guard.mark_steady()
         self.batcher.start()
         self._started = True
         return self
 
-    def warmup(self):
+    def warmup(self) -> bool:
+        """Pre-compile every bucket program; True iff all compiled."""
         model = self.registry.current()
         try:
             c, h, w = self.source.image_dims()
         except Exception as e:       # noqa: BLE001 — geometry-less
             _LOG.warning("serving warmup skipped (no static record "
                          "geometry): %s", e)
-            return
+            return False
         dummy: ImageRecord = ("_warmup", 0.0, c, h, w, False,
                               np.zeros((c, h, w), np.float32))
         fwd = self.registry.forward(self.blob_names)
@@ -143,6 +159,7 @@ class InferenceService:
             self.metrics.add("warmup_compile", time.monotonic() - t0)
         _LOG.info("serving warmup: %d bucket programs compiled %s",
                   len(self.batcher.buckets), list(self.batcher.buckets))
+        return True
 
     def stop(self, drain: bool = True):
         if self._started:
@@ -174,6 +191,8 @@ class InferenceService:
         rows = fetch_rows(out, self.blob_names, ids, real=real,
                           bs=bucket)
         m.add("fwd", time.monotonic() - t0)
+        if self._recompile_guard is not None:
+            self._recompile_guard.check()
         return rows, model.version
 
     # -- request API --------------------------------------------------

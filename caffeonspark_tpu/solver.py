@@ -162,6 +162,11 @@ class Solver:
             from .parallel.gradsync import make_gradsync
             grad_sync = make_gradsync(self.train_net)
         self.grad_sync = grad_sync
+        # COS_RECOMPILE_GUARD=1: every jitted step is watched and a
+        # steady-state recompile (shape drift, trace-time host read)
+        # raises instead of silently storming XLA (analysis/runtime.py)
+        from .analysis.runtime import maybe_recompile_guard
+        self._recompile_guard = maybe_recompile_guard("solver")
         self._jit_train_step = None
         self._jit_train_step_many: Dict[int, object] = {}
         self._jit_eval_step = None
@@ -409,8 +414,12 @@ class Solver:
 
     def jit_train_step(self):
         if self._jit_train_step is None:
-            self._jit_train_step = jax.jit(self.train_step_fn(),
-                                           donate_argnums=(0, 1))
+            from .analysis.runtime import (maybe_guard_jit,
+                                           maybe_poison_donation)
+            fn = jax.jit(self.train_step_fn(), donate_argnums=(0, 1))
+            fn = maybe_guard_jit(self._recompile_guard,
+                                 "solver.train_step", fn, allow=1)
+            self._jit_train_step = maybe_poison_donation(fn, (0, 1))
         return self._jit_train_step
 
     # ------------------------------------------------------------------
@@ -457,8 +466,15 @@ class Solver:
         ever compiles the configured K; boundary remainders reuse the
         single-step program instead of compiling odd sizes)."""
         if k not in self._jit_train_step_many:
-            self._jit_train_step_many[k] = jax.jit(
-                self.build_train_step_many(k), donate_argnums=(0, 1))
+            from .analysis.runtime import (maybe_guard_jit,
+                                           maybe_poison_donation)
+            fn = jax.jit(self.build_train_step_many(k),
+                         donate_argnums=(0, 1))
+            fn = maybe_guard_jit(self._recompile_guard,
+                                 f"solver.train_step_many[k={k}]",
+                                 fn, allow=1)
+            self._jit_train_step_many[k] = maybe_poison_donation(
+                fn, (0, 1))
         return self._jit_train_step_many[k]
 
     # ------------------------------------------------------------------
@@ -474,7 +490,10 @@ class Solver:
 
     def jit_eval_step(self):
         if self._jit_eval_step is None:
-            self._jit_eval_step = jax.jit(self.eval_step_fn())
+            from .analysis.runtime import maybe_guard_jit
+            self._jit_eval_step = maybe_guard_jit(
+                self._recompile_guard, "solver.eval_step",
+                jax.jit(self.eval_step_fn()), allow=1)
         return self._jit_eval_step
 
     # ------------------------------------------------------------------

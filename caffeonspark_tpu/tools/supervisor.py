@@ -43,7 +43,6 @@ Exit code 0 iff a run completes (every local rank exits 0).
 from __future__ import annotations
 
 import argparse
-import os
 import re
 import signal
 import subprocess

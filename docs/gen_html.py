@@ -2,7 +2,6 @@
 """Build the HTML docs (reference `python_doc; make html` analog,
 Makefile:46) from the repo's markdown into docs/_html/."""
 
-import glob
 import html
 import os
 

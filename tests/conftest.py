@@ -25,3 +25,18 @@ if not _TPU_RUN:
     import jax  # noqa: E402
 
     jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def recompile_guard():
+    """A fresh RecompileGuard (analysis/runtime.py): watch jitted
+    callables, mark_steady() once warm, and any further XLA compile
+    raises RecompileError.  Teardown runs a final pull-style check so
+    a recompile on the last call of a test still fails it."""
+    from caffeonspark_tpu.analysis.runtime import RecompileGuard
+
+    guard = RecompileGuard("pytest")
+    yield guard
+    guard.check()

@@ -1,0 +1,29 @@
+"""Must-catch fixture: the PR 3 ingest aliasing bug, reconstructed.
+
+The original device_prefetch staged pooled pack buffers with
+`jax.device_put` and refilled them for the next batch.  On the CPU
+backend device_put ALIASES aligned host numpy buffers (zero-copy), so
+the refill corrupted the batch already sitting in the ring — training
+consumed whichever records the pack loop had reached by dispatch time.
+Fixed in data/queue_runner.py by `_resolve_host_copy` (COS_STAGE_COPY,
+copy-on-CPU default).  coslint COS001 must flag both shapes below.
+"""
+
+import jax
+import numpy as np
+
+
+def stage_ring(records, ring):
+    # one pooled pack buffer, reused across iterations
+    buf = np.empty((8, 3, 32, 32), np.float32)
+    for rec in records:
+        np.copyto(buf, rec)             # refill mutates the buffer...
+        staged = jax.device_put(buf)    # ...the ring entry still aliases
+        ring.append(staged)
+    return ring
+
+
+def stage_then_pack_next(batch, next_batch):
+    dev = jax.device_put(batch)
+    batch[...] = next_batch             # mutates what `dev` aliases
+    return dev

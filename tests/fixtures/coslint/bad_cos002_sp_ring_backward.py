@@ -1,0 +1,23 @@
+"""Must-catch fixture: the PR 5 sp.py precision bug, reconstructed.
+
+The cross-extent ring backward upcast operands to float32 and then ran
+default-precision einsums: on TPU a DEFAULT-precision f32 einsum is a
+single bf16 MXU pass, so the upcast was silently thrown away (measured
+1.2e-2 score error at the test shape, >1e-2 dq violation on sharp
+causal rows).  Fixed in parallel/sp.py by forcing
+`precision=jax.lax.Precision.HIGHEST` on the f32-consuming einsums.
+coslint COS002 must flag both contraction shapes below.
+"""
+
+import jax.numpy as jnp
+
+
+def ring_backward_pair(vq, kf, do, vlse, scale):
+    # inline upcast consumed with no precision= — the score einsum
+    s = jnp.einsum("bhqd,bhkd->bhqk",
+                   vq.astype(jnp.float32), kf) * scale
+    p = jnp.exp(s - vlse[..., None])
+    # upcast via a local: do32 is declared f32, the dv einsum drops it
+    do32 = do.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+    return p, dv

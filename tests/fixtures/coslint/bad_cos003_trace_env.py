@@ -1,0 +1,34 @@
+"""Known-bad fixture for COS003: host nondeterminism inside traced
+code.  Every marked line executes ONCE, at trace time — the env value,
+the timestamp, and the host RNG draw are frozen into the compiled
+program; `.item()`/`float()` on tracers force a sync or crash."""
+
+import os
+import random
+import time
+
+import jax
+import numpy as np
+
+
+def train_step(params, batch):
+    lr = float(os.environ["COS_LR"])          # baked at trace time
+    jitter = random.random()                  # draws once, ever
+    noise = np.random.rand()                  # same, numpy flavor
+    t0 = time.time()                          # frozen timestamp
+    loss = (params * batch).sum()
+    probe = loss.item()                       # host sync on a tracer
+    return loss * lr + jitter + noise + probe, t0
+
+
+step = jax.jit(train_step)
+
+
+def make_body():
+    def body(carry, x):
+        scale = os.getenv("COS_SCALE", "1")   # reachable via the factory
+        return carry + x * float(scale), x
+    return body
+
+
+fused = jax.lax.scan(make_body(), 0.0, None, length=4)

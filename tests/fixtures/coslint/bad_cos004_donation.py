@@ -1,0 +1,23 @@
+"""Known-bad fixture for COS004: use after donation.  A donated
+argument's buffer belongs to XLA after the call — deleted on TPU,
+silently aliased on backends that ignore donation (CPU).  Both shapes
+below lose the params buffer and keep using the name."""
+
+import jax
+
+
+def train_forgot_rebind(params, batches):
+    step = jax.jit(lambda p, b: (p * 0.9, b.sum()),
+                   donate_argnums=(0,))
+    total = 0.0
+    for b in batches:
+        out, loss = step(params, b)   # donates params every iteration,
+        total += loss                 # never rebinds it in the loop
+    return params, total
+
+
+def read_after_donate(params, batch):
+    step = jax.jit(lambda p, b: p * 0.5, donate_argnums=(0,))
+    new_params = step(params, batch)
+    checksum = params.sum()           # params' buffer is gone
+    return new_params, checksum

@@ -1,0 +1,39 @@
+"""Known-bad fixture for COS005: a lock held across a blocking call,
+and a cross-function lock-order inversion.  The dispatcher shape is
+the one the threaded runtime forbids: the moment the unblocker (a
+producer, a worker, stop()) needs the same lock, backpressure becomes
+deadlock."""
+
+import queue
+import threading
+import time
+
+
+class Dispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q: queue.Queue = queue.Queue(maxsize=8)
+        self._done = threading.Event()
+
+    def flush(self):
+        with self._lock:
+            item = self._q.get(timeout=0.5)   # blocks under the lock
+            self._done.wait(0.5)              # so does this
+            time.sleep(0.01)                  # and this
+        return item
+
+
+class TwoLocks:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def forward(self):
+        with self._alock:
+            with self._block:
+                return 1
+
+    def backward(self):
+        with self._block:
+            with self._alock:     # reverse order: latent deadlock
+                return 2

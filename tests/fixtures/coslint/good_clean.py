@@ -1,0 +1,111 @@
+"""Known-good fixture: every bad-fixture shape, done the way the
+codebase does it after the fixes — coslint must report ZERO findings
+here.  Each block mirrors one rule's bad fixture."""
+
+import os
+import queue
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# COS003 done right: env resolved ONCE at import/construction time,
+# outside any traced function
+_SCALE = float(os.environ.get("COS_SCALE", "1.0"))
+
+
+def stage_ring_copy_first(records, ring):
+    """COS001 done right: stage a fresh copy (the COS_STAGE_COPY
+    defense), so the pooled buffer refill cannot reach the ring."""
+    buf = np.empty((8, 3, 32, 32), np.float32)
+    for rec in records:
+        np.copyto(buf, rec)
+        staged = jax.device_put(np.array(buf, copy=True))
+        ring.append(staged)
+    return ring
+
+
+def stage_rebind(batch, next_batch):
+    """COS001 not-flagged shape: the name is rebound to a fresh array
+    between the put and the mutation."""
+    dev = jax.device_put(batch)
+    batch = np.array(next_batch)
+    batch[...] = 0.0
+    return dev, batch
+
+
+def ring_backward_pair(vq, kf, do, vlse, scale):
+    """COS002 done right: f32-consuming einsums force HIGHEST, exactly
+    like parallel/sp.py's ring backward after the PR 5 fix."""
+    hi = jax.lax.Precision.HIGHEST
+    s = jnp.einsum("bhqd,bhkd->bhqk", vq.astype(jnp.float32), kf,
+                   precision=hi) * scale
+    p = jnp.exp(s - vlse[..., None])
+    do32 = do.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32, precision=hi)
+    return p, dv
+
+
+def default_precision_is_fine(a, b):
+    """COS002 not-flagged shape: no operand declares f32 intent, so
+    default-precision bf16 is a legitimate speed choice."""
+    return jnp.einsum("ij,jk->ik", a, b)
+
+
+def train_step(params, batch):
+    """COS003 done right: the traced body touches only its inputs and
+    module constants resolved before tracing."""
+    loss = (params * batch).sum() * _SCALE
+    return loss
+
+
+step = jax.jit(train_step)
+
+
+def train_rebinds(params, batches):
+    """COS004 done right: the donated name is rebound from the call's
+    result every iteration."""
+    donating = jax.jit(lambda p, b: p * 0.9, donate_argnums=(0,))
+    for b in batches:
+        params = donating(params, b)
+    return params
+
+
+class Dispatcher:
+    """COS005 done right: waits happen OUTSIDE the lock; the lock
+    only guards state transitions."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q: queue.Queue = queue.Queue(maxsize=8)
+        self._cond = threading.Condition()
+
+    def flush(self):
+        item = self._q.get(timeout=0.5)     # wait first, no lock held
+        with self._lock:
+            out = item                      # then the state transition
+        return out
+
+    def wait_on_held_condition(self):
+        with self._cond:
+            self._cond.wait(0.1)            # releases the held cond —
+        return True                         # fine by design
+
+
+class TwoLocksOneOrder:
+    """COS005 not-flagged: both paths agree on the acquisition order."""
+
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def forward(self):
+        with self._alock:
+            with self._block:
+                return 1
+
+    def backward(self):
+        with self._alock:
+            with self._block:
+                return 2

@@ -1,0 +1,33 @@
+"""Suppression-scope fixture: real violations silenced by each of the
+three disable scopes.  coslint must report zero findings here but a
+nonzero suppressed count — and the same code with the comments
+stripped must be flagged (tests/test_coslint.py checks both)."""
+# coslint: disable-file=COS003 -- fixture: file scope silences the env read
+
+import os
+import queue
+import threading
+
+import jax
+import numpy as np
+
+
+def line_scope(batch, next_batch):
+    dev = jax.device_put(batch)  # coslint: disable=COS001 -- fixture: caller guarantees no reuse
+    batch[...] = next_batch
+    return dev
+
+
+def block_scope():  # coslint: disable=COS005 -- fixture: single-threaded test harness
+    lock = threading.Lock()
+    q: queue.Queue = queue.Queue()
+    with lock:
+        return q.get(timeout=0.1)
+
+
+def file_scope(params, batch):
+    lr = float(os.environ["COS_LR"])
+    return (params * batch).sum() * lr
+
+
+step = jax.jit(file_scope)

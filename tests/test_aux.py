@@ -1,7 +1,6 @@
 """Aux subsystem tests: tracing, spark gating, examples, CIFAR-10 quick
 workload (BASELINE.md parity), and the -profile flag."""
 
-import json
 import os
 import subprocess
 import sys

@@ -11,7 +11,6 @@ import pytest
 
 from caffeonspark_tpu import checkpoint
 from caffeonspark_tpu.data.synthetic import batches, make_images
-from caffeonspark_tpu.net import Net
 from caffeonspark_tpu.proto import (NetParameter, SolverParameter)
 from caffeonspark_tpu.proto.caffe import Datum, SnapshotFormat
 from caffeonspark_tpu.solver import Solver

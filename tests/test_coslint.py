@@ -1,0 +1,604 @@
+"""coslint: the tier-1 lint gate (whole package vs the checked-in
+zero-findings baseline), per-rule unit tests on known-good/known-bad
+fixtures — including the historical PR 3 device_put-aliasing and PR 5
+sp.py precision bugs reconstructed as must-catch fixtures — plus the
+runtime half: RecompileGuard regression pins (zero steady-state
+recompiles for the K>1 fused loop and every warmed serving bucket),
+byte-parity with guards armed, the donation poisoner, and the COS005
+LockWitness stress/inversion tests."""
+
+import json
+import queue
+import re
+import threading
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from caffeonspark_tpu import checkpoint
+from caffeonspark_tpu.analysis import (LockOrderError, LockWitness,
+                                       RecompileError, RecompileGuard,
+                                       baseline_keys, load_baseline,
+                                       maybe_poison_donation,
+                                       maybe_recompile_guard,
+                                       poison_donation, run_lint,
+                                       write_baseline)
+from caffeonspark_tpu.analysis.__main__ import main as coslint_main
+from caffeonspark_tpu.analysis.rules import ALL_RULES
+from caffeonspark_tpu.config import Config
+from caffeonspark_tpu.data.queue_runner import (FeedQueue,
+                                                TransformerPool,
+                                                chunk_schedule)
+from caffeonspark_tpu.proto import NetParameter, SolverParameter
+from caffeonspark_tpu.serving import InferenceService, MicroBatcher
+from caffeonspark_tpu.solver import Solver
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "coslint"
+BASELINE = REPO / "artifacts" / "coslint_baseline.json"
+
+
+def _rules_hit(path) -> list:
+    return [f.rule for f in run_lint([str(path)]).findings]
+
+
+# ------------------------------------------------------- tier-1 gate
+
+def test_package_clean_vs_baseline():
+    """THE gate: linting the whole caffeonspark_tpu package must
+    produce no finding that is not in the checked-in baseline (which
+    is kept at zero findings — fix or suppress with a reason, never
+    baseline)."""
+    result = run_lint()
+    baselined = load_baseline(str(BASELINE))
+    fresh = [f for f in result.findings if f.key not in baselined]
+    assert not fresh, "new coslint findings:\n" + "\n".join(
+        f.render() for f in fresh)
+    assert result.files >= 25, "package walk looks truncated"
+
+
+def test_baseline_artifact_is_zero_findings():
+    doc = json.loads(BASELINE.read_text())
+    assert doc["version"] == 1
+    assert doc["findings"] == [], (
+        "the baseline must stay at zero findings — suppress in source "
+        "with a reasoned # coslint: disable= instead")
+
+
+# ------------------------------------------------ per-rule fixtures
+
+def test_cos001_catches_pr3_aliasing_bug():
+    """Must-catch: the PR 3 ingest bug (device_put of a pooled pack
+    buffer refilled in the same loop) reconstructed verbatim."""
+    hits = _rules_hit(FIXTURES / "bad_cos001_ring_feed.py")
+    assert hits.count("COS001") == 2, hits
+
+
+def test_cos002_catches_pr5_sp_precision_bug():
+    """Must-catch: the PR 5 sp.py ring-backward bug — f32 upcasts
+    consumed by default-precision einsums (inline cast AND cast via a
+    local name)."""
+    hits = _rules_hit(FIXTURES / "bad_cos002_sp_ring_backward.py")
+    assert hits.count("COS002") == 2, hits
+
+
+def test_cos003_catches_trace_host_reads():
+    hits = _rules_hit(FIXTURES / "bad_cos003_trace_env.py")
+    assert hits.count("COS003") >= 5, hits   # env/random/np.random/
+    msgs = [f.message for f in                # time/.item() + factory
+            run_lint([str(FIXTURES / "bad_cos003_trace_env.py")]).findings]
+    assert any("os.environ" in m for m in msgs)
+    assert any("os.getenv" in m for m in msgs), \
+        "the factory-returned scan body must be trace-reachable"
+    assert any(".item()" in m for m in msgs)
+
+
+def test_cos004_catches_use_after_donation():
+    hits = _rules_hit(FIXTURES / "bad_cos004_donation.py")
+    assert hits.count("COS004") == 2, hits
+
+
+def test_cos005_catches_blocking_and_inversion():
+    findings = run_lint([str(FIXTURES / "bad_cos005_locks.py")]).findings
+    kinds = [f.message.split(" ")[0] for f in findings]
+    assert kinds.count("blocking") == 3, findings    # get/wait/sleep
+    assert any("inversion" in f.message for f in findings)
+
+
+def test_good_fixture_is_clean():
+    """The same five shapes done right — copy-first staging, HIGHEST
+    precision, hoisted env reads, rebound donations, waits outside
+    locks — must produce zero findings."""
+    result = run_lint([str(FIXTURES / "good_clean.py")])
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+# -------------------------------------------------- suppressions
+
+def test_suppression_scopes_silence_and_count():
+    result = run_lint([str(FIXTURES / "suppressed.py")])
+    assert result.findings == [], [f.render() for f in result.findings]
+    assert result.suppressed == 3    # line + block + file scopes
+
+
+def test_stripped_suppressions_reflag(tmp_path):
+    """The suppressed fixture minus its disable comments must light
+    every rule back up — proves the comments are what silence it."""
+    src = (FIXTURES / "suppressed.py").read_text()
+    stripped = re.sub(r"#\s*coslint:[^\n]*", "", src)
+    p = tmp_path / "stripped.py"
+    p.write_text(stripped)
+    hits = _rules_hit(p)
+    assert "COS001" in hits and "COS005" in hits and "COS003" in hits
+
+
+def test_suppression_text_in_strings_is_inert(tmp_path):
+    """The disable syntax quoted inside a docstring or string literal
+    (e.g. a module documenting it) must NOT register — only real
+    comment tokens suppress."""
+    p = tmp_path / "quoted.py"
+    p.write_text(
+        '"""Docs: use `# coslint: disable-file=COS003 -- reason`."""\n'
+        'import os, time, jax\n'
+        'HELP = "# coslint: disable=COS003 -- also just text"\n'
+        '@jax.jit\n'
+        'def step(x):\n'
+        '    return x * float(os.environ.get("LR", "1"))\n')
+    result = run_lint([str(p)])
+    assert [f.rule for f in result.findings] == ["COS003"]
+    assert result.suppressed == 0
+
+
+def test_cos005_nonblocking_acquire_not_flagged(tmp_path):
+    """`other.acquire(blocking=False)` (and positional False) under a
+    held lock is a try-lock — deadlock-free, must stay clean."""
+    p = tmp_path / "trylock.py"
+    p.write_text(
+        'import threading\n'
+        'class W:\n'
+        '    def __init__(self):\n'
+        '        self._lock = threading.Lock()\n'
+        '        self._aux = threading.Lock()\n'
+        '    def poll(self):\n'
+        '        with self._lock:\n'
+        '            if self._aux.acquire(blocking=False):\n'
+        '                self._aux.release()\n'
+        '    def poll2(self):\n'
+        '        with self._lock:\n'
+        '            if self._aux.acquire(False):\n'
+        '                self._aux.release()\n')
+    assert _rules_hit(p) == []
+
+
+def test_rule_ids_and_docstrings():
+    ids = [r.id for r in ALL_RULES]
+    assert ids == ["COS001", "COS002", "COS003", "COS004", "COS005"]
+    for r in ALL_RULES:
+        assert r.__doc__ and r.id in r.__doc__.split("\n")[0], r
+
+
+# ------------------------------------------------------------- CLI
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = str(FIXTURES / "bad_cos001_ring_feed.py")
+    good = str(FIXTURES / "good_clean.py")
+    assert coslint_main([good]) == 0
+    assert coslint_main([bad]) == 1
+    out = capsys.readouterr().out
+    assert "COS001" in out and "device_put" in out
+    # --write-baseline then --baseline turns the same findings green
+    base = str(tmp_path / "base.json")
+    assert coslint_main([bad, "--write-baseline", base]) == 0
+    assert coslint_main([bad, "--baseline", base]) == 0
+    assert coslint_main(["--list-rules"]) == 0
+    capsys.readouterr()
+    assert coslint_main([bad, "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["findings"] and doc["findings"][0]["rule"] == "COS001"
+
+
+def test_baseline_roundtrip(tmp_path):
+    result = run_lint([str(FIXTURES / "bad_cos004_donation.py")])
+    p = tmp_path / "b.json"
+    write_baseline(str(p), result)
+    assert load_baseline(str(p)) == baseline_keys(result.findings)
+
+
+# ------------------------------------------- RecompileGuard: units
+
+def test_recompile_guard_flags_steady_state_recompile():
+    guard = RecompileGuard("unit")
+    f = guard.watch("double", jax.jit(lambda x: x * 2), allow=1)
+    f(jnp.ones(3))               # first compile — auto-steady at 1
+    f(jnp.ones(3))               # cache hit
+    with pytest.raises(RecompileError, match="double"):
+        f(jnp.ones(4))           # new shape in steady state
+
+
+def test_recompile_guard_violation_not_sticky():
+    """One violation fails ONE call: the ceiling advances past the
+    offending compile, so cache hits afterwards — including on the
+    shape that violated — stay healthy (a serving flush that slips a
+    shape past the buckets must not brick every later flush)."""
+    guard = RecompileGuard("unit")
+    f = guard.watch("double", jax.jit(lambda x: x * 2), allow=1)
+    f(jnp.ones(3))
+    with pytest.raises(RecompileError):
+        f(jnp.ones(4))
+    f(jnp.ones(3))               # cache hit — must not raise
+    f(jnp.ones(4))               # now-cached offender — must not raise
+    with pytest.raises(RecompileError):
+        f(jnp.ones(5))           # a FURTHER recompile still fails
+
+
+def test_recompile_guard_mark_steady_and_fixture(recompile_guard):
+    f = recompile_guard.watch("f", jax.jit(lambda x: x + 1))
+    f(jnp.ones(2))
+    f(jnp.ones(3))               # warm-up: unlimited until steady
+    recompile_guard.mark_steady()
+    f(jnp.ones(2))
+    f(jnp.ones(3))               # both shapes cached
+    assert recompile_guard.compiles() == {"f": 2}
+
+
+def test_recompile_guard_env_gate(monkeypatch):
+    monkeypatch.delenv("COS_RECOMPILE_GUARD", raising=False)
+    assert maybe_recompile_guard("x") is None
+    monkeypatch.setenv("COS_RECOMPILE_GUARD", "1")
+    assert isinstance(maybe_recompile_guard("x"), RecompileGuard)
+
+
+# ------------------------------- RecompileGuard: fused-loop pins
+
+TINY_NET = """
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 8 channels: 1 height: 4 width: 4 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 4
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }
+"""
+SOLVER_TXT = ("base_lr: 0.05 momentum: 0.9 lr_policy: 'fixed' "
+              "max_iter: 100 random_seed: 7")
+
+
+def _batches(n, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"data": rng.rand(batch, 1, 4, 4).astype(np.float32),
+             "label": rng.randint(0, 4, batch).astype(np.float32)}
+            for _ in range(n)]
+
+
+def _tree_bytes(tree):
+    return [(ln, bn,
+             np.asarray(jax.device_get(tree[ln][bn])).tobytes())
+            for ln in sorted(tree) for bn in sorted(tree[ln])]
+
+
+def _run_schedule(solver, k, start, stop, boundaries, seed=0):
+    """Drive the solver exactly like the fused-loop drivers: chunks of
+    the schedule are k (fused program) or 1 (single-step program)."""
+    params, st = solver.init()
+    it = start
+    for n in chunk_schedule(start, stop, k, boundaries):
+        if n > 1:
+            block = {kk: jnp.asarray(np.stack([b[kk] for b in
+                                               _batches(n, seed=it)]))
+                     for kk in ("data", "label")}
+            params, st, _ = solver.jit_train_step_many(n)(
+                params, st, block)
+        else:
+            b = _batches(1, seed=it)[0]
+            params, st, _ = solver.jit_train_step()(
+                params, st, {kk: jnp.asarray(v) for kk, v in b.items()},
+                solver.step_rng(it))
+        it += n
+    return params, st
+
+
+def test_fused_loop_zero_steady_recompiles(monkeypatch):
+    """Satellite pin: with COS_RECOMPILE_GUARD=1, running every chunk
+    shape of a boundary-broken schedule TWICE compiles each program
+    exactly once — zero steady-state recompiles for the K>1 fused
+    loop — and a shape drift afterwards raises RecompileError."""
+    monkeypatch.setenv("COS_RECOMPILE_GUARD", "1")
+    s = Solver(SolverParameter.from_text(SOLVER_TXT),
+               NetParameter.from_text(TINY_NET))
+    assert s._recompile_guard is not None
+    sched = list(chunk_schedule(0, 20, 4, (6,)))
+    assert set(sched) == {1, 4}, sched   # both programs exercised
+    _run_schedule(s, 4, 0, 20, (6,))
+    _run_schedule(s, 4, 0, 20, (6,))     # second pass: all cache hits
+    compiles = s._recompile_guard.compiles()
+    assert compiles == {"solver.train_step_many[k=4]": 1,
+                        "solver.train_step": 1}, compiles
+    # teeth: an off-schedule batch shape must fail loudly
+    params, st = s.init()
+    bad = {"data": jnp.zeros((5, 1, 4, 4), jnp.float32),
+           "label": jnp.zeros((5,), jnp.float32)}
+    with pytest.raises(RecompileError, match="train_step"):
+        s.jit_train_step()(params, st, bad, s.step_rng(0))
+
+
+def test_parity_with_guards_armed(monkeypatch):
+    """Acceptance pin: arming RecompileGuard AND the donation poisoner
+    changes nothing numerically — params and optimizer state stay
+    byte-identical to the unguarded run for both the K=1 and the
+    fused K>1 paths (default gradsync throughout)."""
+    def run(k):
+        s = Solver(SolverParameter.from_text(SOLVER_TXT),
+                   NetParameter.from_text(TINY_NET))
+        return _run_schedule(s, k, 0, 12, ())
+
+    monkeypatch.delenv("COS_RECOMPILE_GUARD", raising=False)
+    monkeypatch.delenv("COS_DONATION_POISON", raising=False)
+    p_off, st_off = run(4)
+    p1_off, _ = run(1)
+    monkeypatch.setenv("COS_RECOMPILE_GUARD", "1")
+    monkeypatch.setenv("COS_DONATION_POISON", "1")
+    p_on, st_on = run(4)
+    p1_on, _ = run(1)
+    assert _tree_bytes(p_off) == _tree_bytes(p_on)
+    assert _tree_bytes(st_off.history) == _tree_bytes(st_on.history)
+    assert _tree_bytes(p1_off) == _tree_bytes(p1_on)
+    assert int(jax.device_get(st_on.iter)) == 12
+
+
+# ------------------------------- RecompileGuard: serving buckets
+
+SERVE_NET = """
+name: "tiny"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param {{ source: "{root}/unused_lmdb" batch_size: 8
+    channels: 1 height: 12 width: 12 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}
+"""
+
+
+def test_serving_buckets_zero_recompiles_100_requests(tmp_path,
+                                                      monkeypatch):
+    """Satellite pin: after warmup pre-compiles every bucket program,
+    100 mixed-size requests run with ZERO steady-state recompiles —
+    the guard (armed via COS_RECOMPILE_GUARD=1) checks after every
+    flush and the compile count stays at the warmup count."""
+    net_path = tmp_path / "net.prototxt"
+    net_path.write_text(SERVE_NET.format(root=tmp_path))
+    solver_path = tmp_path / "solver.prototxt"
+    solver_path.write_text(f'net: "{net_path}"\nbase_lr: 0.01\n'
+                           'lr_policy: "fixed"\nmax_iter: 1\n'
+                           'random_seed: 3\n')
+    s = Solver(SolverParameter.from_text(
+        solver_path.read_text()),
+        NetParameter.from_text(net_path.read_text()))
+    params, _ = s.init()
+    model = str(tmp_path / "m.caffemodel")
+    checkpoint.save_caffemodel(model, s.train_net, params)
+
+    monkeypatch.setenv("COS_RECOMPILE_GUARD", "1")
+    svc = InferenceService(
+        Config(["-conf", str(solver_path), "-model", model]),
+        blob_names=("ip",), max_batch=8, max_wait_ms=1.0)
+    assert svc._recompile_guard is not None
+    svc.start(warmup=True)
+    try:
+        warm = svc._recompile_guard.compiles()["serving.forward"]
+        assert warm == len(svc.batcher.buckets) == 4  # 1,2,4,8
+        rng = np.random.RandomState(11)
+        served = 0
+        while served < 100:
+            n = int(rng.randint(1, 9))        # mixed sizes hit every
+            recs = [(f"r{served + i}", 0.0, 1, 12, 12, False,   # bucket
+                     rng.rand(1, 12, 12).astype(np.float32))
+                    for i in range(n)]
+            rows = [p.wait(30.0) for p in svc.submit_many(recs)]
+            assert len(rows) == n and all("ip" in r for r in rows)
+            served += n
+        assert served >= 100
+        after = svc._recompile_guard.compiles()["serving.forward"]
+        assert after == warm, (
+            f"serving recompiled in steady state: {warm} -> {after}")
+    finally:
+        svc.stop(drain=False)
+
+
+# --------------------------------------------- donation poisoner
+
+def test_donation_poisoner_deletes_inputs():
+    f = jax.jit(lambda p, b: p + b, donate_argnums=(0,))
+    poisoned = poison_donation(f, (0,))
+    x, y = jnp.ones(4), jnp.full(4, 2.0)
+    out = poisoned(x, y)
+    assert np.allclose(np.asarray(out), 3.0)
+    assert x.is_deleted(), \
+        "poisoner must delete donated inputs even on CPU"
+    assert not y.is_deleted()
+    with pytest.raises(RuntimeError):
+        _ = np.asarray(x)        # use-after-donation fails loudly
+
+
+def test_donation_poisoner_env_gate(monkeypatch):
+    f = jax.jit(lambda p: p)
+    monkeypatch.delenv("COS_DONATION_POISON", raising=False)
+    assert maybe_poison_donation(f, (0,)) is f
+    monkeypatch.setenv("COS_DONATION_POISON", "1")
+    assert maybe_poison_donation(f, (0,)) is not f
+
+
+# ------------------------------------------- LockWitness (COS005)
+
+def test_lock_witness_catches_injected_inversion():
+    w = LockWitness()
+    a = w.wrap(threading.Lock(), "A")
+    b = w.wrap(threading.Lock(), "B")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    for fn in (ab, ba):          # sequential: records edges, no deadlock
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    v = w.violations()
+    assert len(v) == 1 and v[0].kind == "inversion"
+    with pytest.raises(LockOrderError, match="inversion"):
+        w.assert_quiet()
+
+
+def test_lock_witness_condition_wait_no_false_edge():
+    """Condition.wait releases the held lock — a lock taken by the
+    waker while the waiter sleeps must NOT register as nested under
+    the witnessed condition."""
+    w = LockWitness()
+    cond = w.wrap(threading.Condition(), "cond")
+    other = w.wrap(threading.Lock(), "other")
+    woken = threading.Event()
+
+    def waiter():
+        with cond:
+            cond.wait(2.0)
+        woken.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with other:
+        with cond:
+            cond.notify_all()
+    t.join()
+    assert woken.is_set()
+    w.assert_quiet()
+    # and the reverse order later would be a REAL inversion
+    with cond:
+        with other:
+            pass
+    assert w.violations(), "other->cond then cond->other must trip"
+
+
+def test_microbatcher_stress_8_threads_witness_quiet():
+    """Satellite stress: hammer submit/submit_many/flush/len/stop from
+    8 threads with the batcher's lock witnessed — the lock-order
+    witness must stay quiet and every accepted request must resolve."""
+    from caffeonspark_tpu.serving.batcher import (QueueFullError,
+                                                  ServingStopped)
+    w = LockWitness()
+    mb = MicroBatcher(lambda recs, bucket: ([{"n": len(recs)}] *
+                                            len(recs), 1),
+                      max_batch=8, max_wait_ms=1.0, queue_depth=256)
+    w.witness_attrs(mb, "_submit_lock")
+    mb.start()
+    errors: list = []
+    resolved = [0] * 8
+
+    def hammer(tid):
+        rng = np.random.RandomState(tid)
+        try:
+            for i in range(40):
+                try:
+                    if rng.rand() < 0.5:
+                        pending = [mb.submit((tid, i))]
+                    else:
+                        pending = mb.submit_many(
+                            [(tid, i, j) for j in
+                             range(int(rng.randint(1, 5)))])
+                except (QueueFullError, ServingStopped):
+                    continue
+                len(mb)
+                for p in pending:
+                    p.wait(10.0)
+                    resolved[tid] += 1
+        except Exception as e:    # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    mb.stop(drain=True)
+    assert not errors, errors
+    assert sum(resolved) > 0
+    w.assert_quiet()
+
+
+def test_transformer_pool_stress_feed_abort_witness_quiet():
+    """Satellite stress: 8 feeder threads + epoch marks + a concurrent
+    consumer against a TransformerPool whose condition is witnessed,
+    then a second pool aborted mid-stream — quiet witness, clean
+    wind-down both times."""
+    w = LockWitness()
+    consumed = []
+    errors: list = []
+
+    def run_pool(abort: bool):
+        feed = FeedQueue(capacity=64)
+        pool = TransformerPool(feed, batch_size=4,
+                               pack=lambda buf, draw: list(buf),
+                               num_threads=4)
+        w.witness_attrs(pool, "_cond",
+                        prefix=f"pool{int(abort)}")
+        pool.start()
+
+        def feeder(tid):
+            try:
+                for i in range(40):
+                    if not feed.offer((tid, i), timeout=5.0):
+                        return
+                    if i % 17 == 16:
+                        feed.mark_epoch_end()
+            except Exception as e:   # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        feeders = [threading.Thread(target=feeder, args=(t,))
+                   for t in range(8)]
+        for t in feeders:
+            t.start()
+
+        def closer():
+            """Terminal sentinel once every feeder is done — the
+            consumer below must be draining MEANWHILE (a pool with no
+            live consumer backpressures to a stop by design)."""
+            for t in feeders:
+                t.join(timeout=30.0)
+            if not abort:
+                feed.offer(None, timeout=30.0)
+
+        c = threading.Thread(target=closer)
+        c.start()
+        if abort:
+            time.sleep(0.02)
+            pool.stop()              # mid-stream abort
+        else:
+            while True:
+                batch = pool.take(timeout=30.0)
+                if batch is None:
+                    break
+                consumed.append(batch)
+        c.join(timeout=60.0)
+        feed.stop()
+        pool.stop(join_timeout=10.0)
+
+    run_pool(abort=False)
+    run_pool(abort=True)
+    assert not errors, errors
+    assert consumed, "clean run must emit packed batches"
+    assert all(len(b) == 4 for b in consumed)
+    w.assert_quiet()

@@ -13,8 +13,6 @@ Parity contract, in order of strictness:
     including the non-divisible-bucket padding path.
 """
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -23,7 +21,7 @@ import pytest
 from caffeonspark_tpu.data.synthetic import batches
 from caffeonspark_tpu.net import Net
 from caffeonspark_tpu.parallel import ParallelSolver, build_mesh
-from caffeonspark_tpu.parallel.gradsync import (GradSync, build_plan,
+from caffeonspark_tpu.parallel.gradsync import (build_plan,
                                                 dequantize_int8,
                                                 quantize_int8)
 from caffeonspark_tpu.proto import (NetParameter, NetState, Phase,

@@ -17,7 +17,6 @@ from caffeonspark_tpu.data.queue_runner import (DROPPED, FeedQueue,
                                                 TransformerPool,
                                                 combine_batches,
                                                 device_prefetch)
-from caffeonspark_tpu.data.source import STOP_MARK
 from caffeonspark_tpu.metrics import PipelineMetrics
 
 

@@ -271,7 +271,6 @@ def test_prev_log_rule_drops_logs_between_prev_and_current(tmp_path):
                 file_number=10)          # between prev(8) and num(12)
     w.write_log([(b"m", b"live")], seq_start=200, file_number=12)
     size9 = os.path.getsize(os.path.join(db, "000009.ldb"))
-    import struct as _s
     from caffeonspark_tpu.data import leveldb_io as L
     edit = bytearray()
     cmp_name = b"leveldb.BytewiseComparator"

@@ -150,7 +150,7 @@ def test_reference_lrcn_config_trains():
     ref = "/root/reference/data/lrcn_cos.prototxt"
     if not os.path.exists(ref):
         pytest.skip("reference configs not mounted")
-    import jax, jax.numpy as jnp
+    import jax.numpy as jnp
     from caffeonspark_tpu.proto import read_net, read_solver
     npm = read_net(ref)
     sp = read_solver("/root/reference/data/lrcn_solver.prototxt")

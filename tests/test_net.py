@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from caffeonspark_tpu.net import Net, layer_included
+from caffeonspark_tpu.net import Net
 from caffeonspark_tpu.proto import NetParameter, NetState, Phase, read_net
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -207,7 +207,7 @@ def test_ring_attention_matches_reference(causal):
 def test_pipeline_parallel_matches_single_device():
     """4-stage GPipe over 4 devices, 2 microbatches == full-batch step."""
     import jax
-    from caffeonspark_tpu.parallel import PipelineSolver, partition_layers
+    from caffeonspark_tpu.parallel import PipelineSolver
     sp = SolverParameter.from_text(SOLVER)
     npm = NetParameter.from_text(NET)
     batch = _global_batch()

@@ -145,7 +145,6 @@ def test_unknown_text_fields_rejected():
 
 def test_binary_unknown_tags_skipped():
     # append an unknown varint field (tag 3000) — cross-fork compat
-    import struct
     blob = NetParameter(name="x").to_binary() + bytes([0xC0, 0xBB, 0x01, 5])
     assert NetParameter.from_binary(blob).name == "x"
 

@@ -13,7 +13,7 @@ from caffeonspark_tpu.data.synthetic import batches, make_images
 from caffeonspark_tpu.net import Net
 from caffeonspark_tpu.proto import (NetParameter, NetState, Phase,
                                     SolverParameter)
-from caffeonspark_tpu.solver import OptState, Solver, learning_rate
+from caffeonspark_tpu.solver import Solver, learning_rate
 
 LENET = open("/root/reference/data/lenet_memory_train_test.prototxt").read() \
     if os.path.exists("/root/reference/data/lenet_memory_train_test.prototxt") \

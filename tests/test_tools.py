@@ -14,7 +14,7 @@ from caffeonspark_tpu.data import (LmdbReader, LmdbWriter,
 from caffeonspark_tpu.data.synthetic import make_images
 from caffeonspark_tpu.proto.caffe import Datum
 from caffeonspark_tpu.tools import (Vocab, binary2dataframe,
-                                    binary2sequence, coco_to_image_caption,
+                                    binary2sequence,
                                     embedding_to_caption,
                                     image_caption_to_embedding,
                                     lmdb2dataframe, lmdb2sequence,

@@ -96,7 +96,6 @@ def test_transformer_lm_trains_and_is_causal():
     """MultiHeadAttention from a prototxt: the tiny causal LM learns a
     deterministic next-token rule, and causality holds (future tokens
     cannot influence earlier predictions)."""
-    import jax
     import jax.numpy as jnp
     import numpy as np
     from caffeonspark_tpu.proto import SolverParameter
