@@ -83,6 +83,16 @@ bench-serving:
 	$(CPU_ENV) $(PY) scripts/bench_serving.py \
 	  --out bench_evidence/bench_serving.json
 
+# fleet serving: N replica subprocesses behind the least-outstanding
+# router — offered-load sweep with per-replica utilization, AOT
+# warm-start timings (cold fill vs cache-hit warmup), and the
+# kill-under-load fault drill (zero failed client requests); ALWAYS
+# exits 0 with one JSON document on stdout (bench.py contract)
+bench-serving-fleet:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_serving.py --fleet 2 \
+	  --out bench_evidence/bench_serving_fleet.json
+
 smoke:
 	BENCH_SMOKE=1 $(PY) bench.py
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import signal
 import sys
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -363,33 +364,114 @@ def _cli_spark_context(conf: Config):
     return SparkContext.getOrCreate()
 
 
+def _serve_sigterm_drains() -> None:
+    """Route SIGTERM onto the SIGINT drain path.  The fleet/supervisor
+    teardown (tools/supervisor.terminate_processes) sends SIGTERM with
+    a grace window precisely so accepted serving work can flush;
+    without a handler Python's default disposition kills the process
+    instantly and the drain never runs."""
+    def handler(signum, frame):
+        raise KeyboardInterrupt
+    try:
+        signal.signal(signal.SIGTERM, handler)
+    except ValueError:
+        pass                  # not the main thread (embedded): skip
+
+
+def _dump_serve_metrics(summary: dict) -> None:
+    """COS_SERVE_METRICS=path: one JSON document at shutdown (same
+    shape for single-process and fleet mode)."""
+    path = os.environ.get("COS_SERVE_METRICS")
+    if path:
+        with open(path, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+
+def serve_fleet_main(conf: Config, replicas: int) -> int:
+    """-serve -serveReplicas N: fleet mode.  N replica processes (each
+    the unchanged single-process stack on an ephemeral loopback port)
+    behind the least-outstanding router; the client-facing port is the
+    ROUTER's.  Replica death is absorbed: the router retries onto
+    healthy peers while the fleet monitor restarts the dead process
+    (warm via COS_AOT_CACHE_DIR when set)."""
+    from .serving.fleet import Fleet
+    from .serving.router import RouterHTTPServer
+    _serve_sigterm_drains()
+    serve_args = ["-conf", conf.protoFile]
+    if conf.modelPath:
+        serve_args += ["-model", conf.modelPath]
+    if conf.snapshotModelFile:
+        serve_args += ["-weights", conf.snapshotModelFile]
+    if conf.snapshotStateFile:
+        serve_args += ["-snapshot", conf.snapshotStateFile]
+    # the served-blob selection must reach the replicas, or they fall
+    # back to the net's output blobs and answer the wrong columns
+    if conf.features:
+        serve_args += ["-features", conf.features]
+    if conf.label:
+        serve_args += ["-label", conf.label]
+    if getattr(conf, "resize", False):
+        serve_args += ["-resize"]
+    fleet = Fleet(serve_args, replicas)
+    fleet.start()
+    try:
+        # inside the guard: a bind failure (port in use) must not
+        # orphan N freshly-warmed replica subprocesses
+        httpd = RouterHTTPServer(fleet.router, host=conf.serveHost,
+                                 port=conf.servePort,
+                                 reload_fn=fleet.rolling_reload)
+    except BaseException:
+        fleet.stop()
+        raise
+    try:
+        # inside the guard: a signal (or BrokenPipeError on a closed
+        # stdout) landing during the boot print must still tear the
+        # warmed replicas down
+        print(json.dumps({"serving": True, "port": httpd.port,
+                          "replicas": replicas,
+                          "replica_urls": {n: r.url for n, r
+                                           in fleet.replicas.items()}}),
+              flush=True)
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.stop()
+        fleet.stop()
+        _dump_serve_metrics(fleet.metrics_summary())
+    return 0
+
+
 def serve_main(conf: Config) -> int:
     """-serve mode: online inference over the serving subsystem.  Runs
     until interrupted; drains in-flight requests on shutdown and dumps
     serving metrics to COS_SERVE_METRICS (same JSON format as the
-    pipeline metrics) when set."""
+    pipeline metrics) when set.  `-serveReplicas N` (or
+    COS_SERVE_REPLICAS) > 1 switches to fleet mode."""
     from .serving import InferenceService, ServingHTTPServer
+    from .serving.fleet import serve_replicas
+    n = conf.serveReplicas if getattr(conf, "serveReplicas", 0) > 0 \
+        else serve_replicas()
+    if n > 1:
+        return serve_fleet_main(conf, n)
+    _serve_sigterm_drains()
     svc = InferenceService(conf)   # loads -weights, else -model
     svc.start()
     httpd = ServingHTTPServer(svc, host=conf.serveHost,
                               port=conf.servePort)
-    print(json.dumps({"serving": True, "port": httpd.port,
-                      "model_version": svc.registry.version,
-                      "buckets": list(svc.batcher.buckets)}),
-          flush=True)
     try:
+        print(json.dumps({"serving": True, "port": httpd.port,
+                          "model_version": svc.registry.version,
+                          "buckets": list(svc.batcher.buckets)}),
+              flush=True)
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
         httpd.server_close()
         svc.stop(drain=True)
-        path = os.environ.get("COS_SERVE_METRICS")
-        if path:
-            with open(path, "w") as f:
-                json.dump(svc.metrics_summary(), f, indent=2,
-                          sort_keys=True)
-                f.write("\n")
+        _dump_serve_metrics(svc.metrics_summary())
     return 0
 
 

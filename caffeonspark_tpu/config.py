@@ -89,6 +89,12 @@ def build_argparser() -> argparse.ArgumentParser:
     a("-serveHost", dest="serveHost", default="127.0.0.1",
       help="serving bind address (loopback by default; the unauth'd "
            "/v1/reload endpoint makes wider binds an explicit opt-in)")
+    a("-serveReplicas", dest="serveReplicas", type=int, default=0,
+      help="fleet mode: N replica serving processes behind a "
+           "least-outstanding router with retry + rolling hot-swap "
+           "(0/unset → COS_SERVE_REPLICAS, default 1 = single "
+           "process; COS_AOT_CACHE_DIR shares compiled programs so "
+           "replicas warm-start)")
     # mesh extensions (not in the reference)
     a("-mesh", dest="mesh", default="",
       help="mesh spec dp[,tp[,sp[,ep]]] per process")

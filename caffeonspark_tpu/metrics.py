@@ -6,9 +6,19 @@ gives the TPU pipeline that visibility cheaply: lock-guarded ring
 buffers per stage, O(1) per sample, summarized on demand.
 
 The serving subsystem records its stages (latency / assemble / pack /
-fwd / time_to_first_flush series, queue_depth / batch_fill gauges,
-served/rejected/expired counters) through the same classes, so
-serving metrics dump in this exact JSON format.
+fwd / exec_wait / time_to_first_flush series, queue_depth /
+batch_fill gauges, served/rejected/expired and per-bucket
+flush_bucket_<n> counters) through the same classes, so serving
+metrics dump in this exact JSON format.  The fleet layer adds its own
+series in the same shape: `route` (router-observed request time,
+retries included), `replica_startup` / `replica_rejoin` (spawn →
+healthy wall time, cold vs restart-on-death), counters `routed` /
+`retries` / `retry_429` / `retry_503` / `retry_conn` /
+`replica_restarts` / `rolling_reloads` (one per fleet-wide swap
+operation; `replica_reloads` counts per-replica swaps), and a
+per-replica
+state/outstanding/requests table under `replicas` in the router
+summary.
 
 Stage names used by the training runtime:
   queue_wait  solver thread blocked in next(gen) waiting for a batch
@@ -185,6 +195,13 @@ class PipelineMetrics:
         self.mark_step(n)
 
     # -- reading --------------------------------------------------------
+    def get_counter(self, name: str) -> int:
+        """One counter's current value (0 if never incremented) — the
+        cheap point read for pollers (fleet bench, tests) that a full
+        summary() would make O(all series)."""
+        with self._lock:
+            return self._counters.get(name, 0)
+
     def has_samples(self) -> bool:
         with self._lock:
             return bool(self._series or self._counters or self._steps

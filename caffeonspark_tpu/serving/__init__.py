@@ -12,6 +12,12 @@ net; this package answers requests as they ARRIVE, amortizing the
 fixed per-dispatch cost over dynamically formed micro-batches
 (FireCaffe's bigger-effective-batch argument applied to serving — see
 docs/architecture.md §serving).
+
+The fleet layer (router.py / fleet.py / aot.py / retry.py) scales the
+single-process stack out: a least-outstanding request router over N
+replica processes with health/draining states, retry with jittered
+backoff, rolling hot-swap, restart-on-death, and AOT warm start via
+the persistent compilation cache (docs/architecture.md §fleet).
 """
 
 from .batcher import (DeadlineExceeded, MicroBatcher, PendingResult,
@@ -20,13 +26,21 @@ from .batcher import (DeadlineExceeded, MicroBatcher, PendingResult,
                       serve_queue_depth)
 from .forward import BlobForward, fetch_rows
 from .registry import ModelRegistry, ModelVersion, build_serving_net
+from .retry import RetryPolicy, retry_call
 from .service import Client, InferenceService
 from .http_server import ServingHTTPServer
+from .router import (NoReplicaAvailable, RouterRequestError,
+                     RouteRetryable, Router, RouterHTTPServer)
+from .fleet import Fleet, ReplicaProcess, serve_replicas
 
 __all__ = [
-    "BlobForward", "Client", "DeadlineExceeded", "InferenceService",
-    "MicroBatcher", "ModelRegistry", "ModelVersion", "PendingResult",
-    "QueueFullError", "ServingHTTPServer", "ServingStopped",
+    "BlobForward", "Client", "DeadlineExceeded", "Fleet",
+    "InferenceService", "MicroBatcher", "ModelRegistry",
+    "ModelVersion", "NoReplicaAvailable", "PendingResult",
+    "QueueFullError", "ReplicaProcess", "RetryPolicy",
+    "RouteRetryable", "Router", "RouterHTTPServer",
+    "RouterRequestError", "ServingHTTPServer", "ServingStopped",
     "bucket_for", "build_serving_net", "fetch_rows", "make_buckets",
-    "serve_max_batch", "serve_max_wait_ms", "serve_queue_depth",
+    "retry_call", "serve_max_batch", "serve_max_wait_ms",
+    "serve_queue_depth", "serve_replicas",
 ]

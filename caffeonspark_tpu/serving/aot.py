@@ -1,0 +1,108 @@
+"""AOT warm start: persistent compilation cache for serving warmup.
+
+Elastic scale-up is only real if a fresh replica is serving in
+seconds, and the dominant cost of a cold replica is XLA compiling the
+bucket programs (`InferenceService.warmup` compiles
+log2(max_batch)+1 of them; a big net on TPU pays tens of seconds
+each).  The fix is the same persistent compilation cache
+`mini_cluster` and `bench.py` already use for training: point
+`jax_compilation_cache_dir` at shared storage BEFORE the first trace,
+and a replica whose (program, compile options) were compiled by ANY
+earlier replica warms up on deserialized executables — cache hits,
+zero fresh compiles (`RecompileGuard`-verifiable).
+
+Cache layout: one subdirectory per serving identity, named by a
+digest of (net topology, bucket set, served blobs) —
+``<COS_AOT_CACHE_DIR>/aot-<digest>``.  JAX's own cache key (HLO +
+compile options + backend) already guarantees correctness; the
+namespace exists so operators can prune per-model and so the tests
+can count one model's entries in isolation.  The digest deliberately
+EXCLUDES the param values and the model version: forward programs are
+params-agnostic (`BlobForward`), so every version of one net shares
+one program set — that sharing is what makes rolling hot-swap free
+and it would be thrown away by a version-keyed cache.
+
+Knob: COS_AOT_CACHE_DIR (unset = no persistent cache; serving then
+compiles per process exactly as before).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+from typing import Optional, Sequence
+
+_LOG = logging.getLogger(__name__)
+
+
+def aot_cache_root() -> str:
+    """COS_AOT_CACHE_DIR: root under which per-model namespaces live
+    ('' = AOT warm start disabled)."""
+    return os.environ.get("COS_AOT_CACHE_DIR", "")
+
+
+def aot_cache_key(net_param, buckets: Sequence[int],
+                  blob_names: Sequence[str]) -> str:
+    """Digest of the serving identity that determines the compiled
+    program set: net topology + bucket shapes + served blobs.  Params
+    and model version are excluded on purpose (see module docstring)."""
+    h = hashlib.sha256()
+    h.update(str(net_param).encode())
+    h.update(repr(tuple(buckets)).encode())
+    h.update(repr(tuple(blob_names)).encode())
+    return h.hexdigest()[:16]
+
+
+def resolve_cache_dir(net_param, buckets: Sequence[int],
+                      blob_names: Sequence[str],
+                      root: Optional[str] = None) -> Optional[str]:
+    root = aot_cache_root() if root is None else root
+    if not root:
+        return None
+    return os.path.join(root,
+                        "aot-" + aot_cache_key(net_param, buckets,
+                                               blob_names))
+
+
+def enable_aot_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at `cache_dir`.  Must
+    run before the first trace of the programs it should capture (the
+    serving path calls it before warmup).  min_compile_time 0 /
+    min_entry_size -1 persist even the fast CPU compiles — the CI box
+    is where the warm-start tests prove the mechanism the TPU path
+    relies on."""
+    import jax
+    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+        # the cache binds its directory lazily at the FIRST compile
+        # and then never re-reads the config — and model/param loading
+        # already compiled small host programs by the time serving
+        # configures the dir, so without a reset the warmup programs
+        # silently skip the cache (observed: zero entries written)
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
+    except Exception as e:      # noqa: BLE001 — jax config moved
+        _LOG.warning("AOT cache unavailable (%s); serving will "
+                     "compile per process", e)
+        return False
+    _LOG.info("AOT compilation cache at %s", cache_dir)
+    return True
+
+
+def cache_entries(cache_dir: str) -> int:
+    """Number of serialized executables in the namespace (the
+    `*-cache` files jax writes; `-atime` sidecars excluded).  A warm
+    replica's warmup adds ZERO entries — every program deserializes —
+    which is the timing-free cache-hit proof the fleet tests use."""
+    try:
+        return sum(1 for n in os.listdir(cache_dir)
+                   if n.endswith("-cache"))
+    except OSError:
+        return 0

@@ -14,6 +14,16 @@ log2(max_batch)+1 programs total instead of one per arrival count; an
 eager warmup pass (InferenceService.start) pre-compiles every bucket
 before traffic lands.
 
+Batching is CONTINUOUS: an assembler thread gathers requests into
+flushes and an executor thread runs them, joined by a depth-1 handoff
+queue.  While flush N executes, newly arriving requests are admitted
+into flush N+1 — under sustained load the device never idles waiting
+for assembly, and assembly never waits for the device (the original
+single-thread dispatcher was flush-and-wait: requests arriving during
+an execution sat unassembled until it returned).  The handoff depth
+is 1 by design: staging more than one flush ahead would let assembled
+batches go stale against their deadlines behind a slow execution.
+
 Robustness layer:
   * queue-full fast-reject — `submit` raises QueueFullError
     immediately instead of blocking the caller behind a backlog it
@@ -25,8 +35,9 @@ Robustness layer:
     everything already accepted before the dispatcher exits.
 
 Metrics ride in the PipelineMetrics JSON format (series: latency /
-assemble / pack / fwd / time_to_first_flush; gauges: queue_depth /
-batch_fill; counters: served_rows / flushes / rejected_queue_full /
+assemble / pack / fwd / exec_wait / time_to_first_flush; gauges:
+queue_depth / batch_fill; counters: served_rows / flushes /
+flush_bucket_<n> / overlapped_flushes / rejected_queue_full /
 expired_deadline).
 """
 
@@ -62,10 +73,22 @@ class ServingStopped(RuntimeError):
 # -- config knobs (env, COS_SERVE_*) ------------------------------------
 
 def _env_int(name: str, default: int) -> int:
+    """Shared across the serving package (retry, fleet import these) —
+    one copy of parse-or-warn-and-default, so the env-knob behavior
+    cannot drift between modules."""
     try:
         return int(os.environ.get(name, default))
     except ValueError:
         _LOG.warning("ignoring non-integer %s=%r", name,
+                     os.environ.get(name))
+        return default
+
+
+def _env_num(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        _LOG.warning("ignoring non-numeric %s=%r", name,
                      os.environ.get(name))
         return default
 
@@ -78,13 +101,7 @@ def serve_max_batch(default: int = 64) -> int:
 def serve_max_wait_ms(default: float = 5.0) -> float:
     """COS_SERVE_MAX_WAIT_MS: max time the first request of a window
     waits for co-batchers before a partial flush."""
-    try:
-        return max(0.0, float(os.environ.get("COS_SERVE_MAX_WAIT_MS",
-                                             default)))
-    except ValueError:
-        _LOG.warning("ignoring non-numeric COS_SERVE_MAX_WAIT_MS=%r",
-                     os.environ.get("COS_SERVE_MAX_WAIT_MS"))
-        return default
+    return max(0.0, _env_num("COS_SERVE_MAX_WAIT_MS", default))
 
 
 def serve_queue_depth(default: int = 0) -> int:
@@ -167,7 +184,9 @@ class PendingResult:
 # -- batcher ------------------------------------------------------------
 
 class MicroBatcher:
-    """Bounded request queue + dispatcher thread.
+    """Bounded request queue + assembler/executor thread pair
+    (continuous batching: the assembler admits arrivals into the next
+    flush while the executor runs the current one).
 
     `run_batch(records, bucket)` is the model hook: it must return
     (rows, version) with one row per record (padding to `bucket` is
@@ -199,7 +218,13 @@ class MicroBatcher:
         self.default_timeout_ms = default_timeout_ms
         self.metrics = metrics or PipelineMetrics()
         self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
+        # assembler → executor handoff; depth 1 so at most one flush is
+        # staged ahead of the one executing (deeper staging would age
+        # batches against their deadlines behind a slow execution)
+        self._exec_q: "queue.Queue[Any]" = queue.Queue(maxsize=1)
         self._thread: Optional[threading.Thread] = None
+        self._exec_thread: Optional[threading.Thread] = None
+        self._executing = False
         self._stopping = False
         self._drain = True
         # orders submit's check-then-put against stop's final sweep: a
@@ -213,6 +238,10 @@ class MicroBatcher:
     def start(self) -> "MicroBatcher":
         assert self._thread is None, "batcher already started"
         self._t_start = time.monotonic()
+        self._exec_thread = threading.Thread(target=self._exec_loop,
+                                             name="cos-serve-exec",
+                                             daemon=True)
+        self._exec_thread.start()
         self._thread = threading.Thread(target=self._loop,
                                         name="cos-serve-batcher",
                                         daemon=True)
@@ -240,6 +269,15 @@ class MicroBatcher:
                 raise RuntimeError("serving dispatcher failed to "
                                    "drain within join timeout")
             self._thread = None
+        if self._exec_thread is not None:
+            # the assembler's last act is the handoff sentinel, so by
+            # here the executor is exiting (or failing staged batches
+            # on the no-drain path)
+            self._exec_thread.join(timeout=join_timeout)
+            if self._exec_thread.is_alive():
+                raise RuntimeError("serving executor failed to drain "
+                                   "within join timeout")
+            self._exec_thread = None
         # no dispatcher ever ran (or it exited on _STOP before our
         # sentinel): fail anything still queued so no caller hangs.
         # Under the submit lock so no put can land after this sweep.
@@ -247,13 +285,19 @@ class MicroBatcher:
             self._reject_queued()
 
     def _reject_queued(self):
-        while True:
-            try:
-                item = self._q.get_nowait()
-            except queue.Empty:
-                return
-            if item is not _STOP:
-                item.fail(ServingStopped("serving stopped"))
+        # _q holds _Request items; _exec_q holds staged
+        # ([_Request, ...], t_staged) flushes
+        for q in (self._q, self._exec_q):
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is _STOP:
+                    continue
+                reqs = item[0] if isinstance(item, tuple) else [item]
+                for r in reqs:
+                    r.fail(ServingStopped("serving stopped"))
 
     # -- submit -------------------------------------------------------
     def submit(self, record, timeout_ms: Optional[float] = None
@@ -309,44 +353,92 @@ class MicroBatcher:
     def __len__(self):
         return self._q.qsize()
 
-    # -- dispatcher ---------------------------------------------------
+    def depth(self) -> int:
+        """Requests waiting: queued arrivals plus any staged flush not
+        yet executing — what /metrics reports as queue depth and the
+        router reads to spot a backed-up replica."""
+        staged = 0
+        try:
+            item = self._exec_q.queue[0]     # peek, no lock needed for
+            if item is not _STOP:            # an advisory metric
+                staged = len(item[0])
+        except IndexError:
+            pass
+        return self._q.qsize() + staged
+
+    # -- assembler ----------------------------------------------------
     def _loop(self):
+        """Assembler: gather arrivals into flushes and hand each to the
+        executor.  The handoff returns as soon as the staged slot is
+        free, so assembly of the NEXT flush runs concurrently with the
+        execution of the current one (continuous batching)."""
         draining = False
-        while True:
-            try:
-                first = self._q.get(timeout=0.1)
-            except queue.Empty:
-                if self._stopping:
+        try:
+            while True:
+                try:
+                    first = self._q.get(timeout=0.1)
+                except queue.Empty:
+                    if self._stopping:
+                        break
+                    continue
+                if first is _STOP:
+                    draining = True
+                    first = None
+                batch: List[_Request] = \
+                    [first] if first is not None else []
+                if not draining:
+                    batch = self._assemble(batch)
+                    draining = any(b is _STOP for b in batch)
+                    batch = [b for b in batch if b is not _STOP]
+                else:
+                    batch.extend(self._drain_ready())
+                if self._stopping and not self._drain:
+                    # no-drain stop (checked AFTER assembly so the
+                    # sentinel path through _assemble takes it too):
+                    # answer accepted work with the stop error instead
+                    # of flushing it
+                    for r in batch:
+                        r.fail(ServingStopped("serving stopped"))
+                    self._reject_queued()
                     break
-                continue
-            if first is _STOP:
-                draining = True
-                first = None
-            batch: List[_Request] = [first] if first is not None else []
-            if not draining:
-                batch = self._assemble(batch)
-                draining = any(b is _STOP for b in batch)
-                batch = [b for b in batch if b is not _STOP]
-            else:
-                batch.extend(self._drain_ready())
+                if batch:
+                    self._submit_exec(batch)
+                if draining:
+                    # hand over whatever else was accepted pre-stop
+                    while True:
+                        rest = self._drain_ready()
+                        if not rest:
+                            break
+                        self._submit_exec(rest)
+                    break
+        finally:
+            # always wake the executor for exit — even on an assembler
+            # crash, staged work is flushed/failed rather than hung
+            self._exec_q.put(_STOP)
+
+    def _submit_exec(self, batch: List[_Request]):
+        if self._executing:
+            self.metrics.incr("overlapped_flushes")
+        batch_t = (batch, time.monotonic())
+        self._exec_q.put(batch_t)
+
+    # -- executor -----------------------------------------------------
+    def _exec_loop(self):
+        while True:
+            item = self._exec_q.get()
+            if item is _STOP:
+                break
+            batch, t_staged = item
+            self.metrics.add("exec_wait", time.monotonic() - t_staged)
             if self._stopping and not self._drain:
-                # no-drain stop (checked AFTER assembly so the sentinel
-                # path through _assemble takes it too): answer accepted
-                # work with the stop error instead of flushing it
                 for r in batch:
                     r.fail(ServingStopped("serving stopped"))
-                self._reject_queued()
-                break
-            if batch:
+                continue
+            self._executing = True
+            try:
                 self._flush(batch)
-            if draining:
-                # flush whatever else was accepted before the stop
-                while True:
-                    rest = self._drain_ready()
-                    if not rest:
-                        break
-                    self._flush(rest)
-                break
+            finally:
+                self._executing = False
 
     def _assemble(self, batch: List[Any]) -> List[Any]:
         """Gather co-batchers until max_batch, the window's max_wait,
@@ -420,6 +512,7 @@ class MicroBatcher:
             if self._t_start is not None:
                 m.add("time_to_first_flush", done - self._t_start)
         m.incr("flushes")
+        m.incr(f"flush_bucket_{bucket}")
         m.incr("served_rows", len(live))
         for r, row in zip(live, rows):
             r.complete(row, version)

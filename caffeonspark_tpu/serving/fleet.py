@@ -1,0 +1,328 @@
+"""Fleet: N serving replica processes behind one router.
+
+The process plumbing is the training supervisor's
+(`tools/supervisor.py`): spawn one process per replica, watch them,
+and when one dies relaunch it — but where the supervisor tears the
+WHOLE cluster down (a dead training rank wedges the survivors inside
+the gradient collective), serving replicas share nothing, so the
+fleet restarts exactly the dead one while the router keeps routing
+around it.  With COS_AOT_CACHE_DIR set, every replica warms from the
+shared persistent compilation cache (serving/aot.py), so a restarted
+or scaled-up replica is serving again in seconds — its warmup is
+cache hits, not fresh XLA compiles.
+
+Each replica is the UNCHANGED single-process stack: one
+`caffe_on_spark.py -serve` process (InferenceService + HTTP) on an
+ephemeral port, discovered from the startup JSON line the serve CLI
+prints.  The fleet layer never reaches into a replica — everything
+goes over the same HTTP surface operators script against.
+
+    fleet = Fleet(["-conf", solver, "-model", m], replicas=4)
+    fleet.start()                       # spawn, wait healthy, route
+    fleet.router.predict({...})
+    fleet.rolling_reload(new_model)     # drain+reload one at a time
+    fleet.stop()
+
+Knob: COS_SERVE_REPLICAS (the `-serveReplicas` CLI default).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..metrics import PipelineMetrics
+from ..tools.supervisor import terminate_processes
+from .batcher import _env_int
+from .retry import RetryPolicy
+from .router import (DOWN, OK, STARTING, TRANSPORT_ERRORS, Router,
+                     http_json)
+
+_LOG = logging.getLogger(__name__)
+
+
+def serve_replicas(default: int = 1) -> int:
+    """COS_SERVE_REPLICAS: fleet size when the CLI flag is absent."""
+    return max(1, _env_int("COS_SERVE_REPLICAS", default))
+
+
+def _args_with_model(args: List[str], model_path: str) -> List[str]:
+    """Respawn args after a rolling reload: the new model supersedes
+    whatever weights source (-model/-weights/-snapshot) the fleet was
+    launched with, so a replica that dies AFTER the swap rejoins on
+    the NEW version instead of silently reintroducing the old one."""
+    out, skip = [], False
+    for a in args:
+        if skip:
+            skip = False
+        elif a in ("-model", "-weights", "-snapshot"):
+            skip = True
+        else:
+            out.append(a)
+    return out + ["-model", model_path]
+
+
+class ReplicaProcess:
+    """One `-serve` subprocess: spawn, discover the ephemeral port
+    from the startup JSON line, wait until /healthz answers."""
+
+    def __init__(self, name: str, serve_args: List[str],
+                 env: Optional[Dict[str, str]] = None,
+                 host: str = "127.0.0.1"):
+        self.name = name
+        self.serve_args = list(serve_args)
+        self.env = dict(env) if env else None
+        self.host = host
+        self.proc: Optional[subprocess.Popen] = None
+        self.port: Optional[int] = None
+        self._port_ready = threading.Event()
+        self.t_spawn: Optional[float] = None
+        self.t_ready: Optional[float] = None
+        self.restart_count = 0      # lifetime restarts of THIS replica
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def spawn(self) -> "ReplicaProcess":
+        cmd = [sys.executable, "-m", "caffeonspark_tpu.caffe_on_spark",
+               "-serve", "-serveHost", self.host, "-servePort", "0",
+               "-serveReplicas", "1"] + self.serve_args
+        env = dict(os.environ)
+        # the child must import THIS checkout whether or not the
+        # package is pip-installed (tests/bench run from the repo)
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_parent + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
+        if self.env:
+            env.update(self.env)
+        # a FRESH event per spawn: the previous process's stdout
+        # reader still holds the old one, so its EOF set() (which can
+        # land after a respawn's clear under contention) cannot spoof
+        # readiness for the new process
+        evt = threading.Event()
+        self._port_ready = evt
+        self.port = None
+        self.t_spawn = time.monotonic()
+        self.t_ready = None
+        self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                     env=env, text=True)
+        threading.Thread(target=self._read_stdout,
+                         args=(self.proc, evt),
+                         name=f"cos-fleet-{self.name}-stdout",
+                         daemon=True).start()
+        return self
+
+    def _read_stdout(self, proc, evt):
+        """First JSON line carries the bound port; keep draining after
+        that so the child never blocks on a full pipe.  `proc`/`evt`
+        are this spawn's own — a stale reader never touches the
+        replica's current port."""
+        try:
+            for line in proc.stdout:
+                if self.port is None and self.proc is proc:
+                    try:
+                        msg = json.loads(line)
+                        if msg.get("serving"):
+                            self.port = int(msg["port"])
+                            evt.set()
+                    except (ValueError, KeyError, TypeError):
+                        pass
+        except (OSError, ValueError):
+            pass
+        finally:
+            evt.set()                   # EOF: unblock waiters (death)
+
+    def wait_ready(self, timeout_s: float = 180.0,
+                   stop_evt: Optional[threading.Event] = None) -> bool:
+        """True once /healthz answers 200 (model loaded, warmup done —
+        the serve CLI prints its startup line only after start()).
+        `stop_evt` aborts the wait early (the fleet monitor passes its
+        stop event so Fleet.stop() is not held behind a warmup)."""
+        deadline = time.monotonic() + timeout_s
+        self._port_ready.wait(timeout_s)
+        if self.port is None:
+            return False
+        while time.monotonic() < deadline:
+            if stop_evt is not None and stop_evt.is_set():
+                return False
+            if self.proc is None or self.proc.poll() is not None:
+                return False
+            try:
+                code, body = http_json(self.url + "/healthz",
+                                       timeout=5.0)
+                if code == 200:
+                    self.t_ready = time.monotonic()
+                    return True
+            except TRANSPORT_ERRORS + (OSError, ValueError):
+                pass
+            time.sleep(0.05)
+        return False
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self) -> None:
+        """Hard-kill (fault injection: the tests' and bench's replica
+        failure is this, not a graceful stop)."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait(timeout=30)
+
+    def terminate(self, grace: float = 10.0) -> None:
+        if self.proc is not None:
+            terminate_processes([self.proc], grace=grace)
+
+
+class Fleet:
+    """Replica processes + router + restart-on-death monitor."""
+
+    def __init__(self, serve_args: List[str], replicas: int = 0, *,
+                 env: Optional[Dict[str, str]] = None,
+                 policy: Optional[RetryPolicy] = None,
+                 startup_timeout_s: float = 180.0,
+                 poll_interval_s: float = 0.25,
+                 max_restarts: int = 10,
+                 metrics: Optional[PipelineMetrics] = None):
+        self.serve_args = list(serve_args)
+        self.n = replicas or serve_replicas()
+        self.env = dict(env) if env else {}
+        self.startup_timeout_s = startup_timeout_s
+        self.poll_interval_s = poll_interval_s
+        self.max_restarts = max_restarts
+        self.metrics = metrics or PipelineMetrics()
+        self.router = Router(policy=policy, metrics=self.metrics)
+        self.replicas: Dict[str, ReplicaProcess] = {}
+        self._monitor: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._restarts = 0
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> "Fleet":
+        """Spawn every replica, wait until each is healthy, then open
+        routing and start the death monitor.  Spawns overlap (the
+        expensive part of a cold start is each process's own warmup
+        compile — with the AOT cache, replica 0 fills it and the rest
+        mostly hit it)."""
+        try:
+            for i in range(self.n):
+                name = f"replica{i}"
+                self.replicas[name] = ReplicaProcess(
+                    name, self.serve_args, env=self.env).spawn()
+                self.router.add_replica(name, "http://unbound",
+                                        state=STARTING)
+            for name, rep in self.replicas.items():
+                if not rep.wait_ready(self.startup_timeout_s):
+                    raise RuntimeError(
+                        f"fleet: {name} failed to become healthy "
+                        f"within {self.startup_timeout_s}s")
+                self.router.update_url(name, rep.url)
+                self.router.set_state(name, OK)
+                if rep.t_ready and rep.t_spawn:
+                    self.metrics.add("replica_startup",
+                                     rep.t_ready - rep.t_spawn)
+        except BaseException:
+            # a failed spawn or warmup must not orphan the replicas
+            # that DID come up (stale -serve processes pin the box)
+            self.stop()
+            raise
+        self.router.start_health()
+        self._stop_evt.clear()
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="cos-fleet-monitor",
+                                         daemon=True)
+        self._monitor.start()
+        return self
+
+    def stop(self, grace: float = 10.0) -> None:
+        self._stop_evt.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=30)
+            self._monitor = None
+        self.router.stop()
+        terminate_processes(
+            [r.proc for r in self.replicas.values()
+             if r.proc is not None], grace=grace)
+
+    # -- restart-on-death ---------------------------------------------
+    def _monitor_loop(self):
+        while not self._stop_evt.wait(self.poll_interval_s):
+            try:
+                self._monitor_once()
+            except Exception as e:   # noqa: BLE001 — keep monitoring
+                # a failed spawn (fork pressure, vanished binary) must
+                # not kill the only restart path for the whole fleet
+                _LOG.warning("fleet monitor pass failed: %s", e)
+
+    def _monitor_once(self):
+        for name, rep in list(self.replicas.items()):
+            if rep.alive() or self._stop_evt.is_set():
+                continue
+            self.router.set_state(name, DOWN)
+            # the budget is PER REPLICA: one crash-looping replica
+            # must not spend the allowance of its healthy peers (nor
+            # may sporadic recoverable deaths across a long-lived
+            # fleet add up to a permanent no-restart state)
+            if rep.restart_count >= self.max_restarts:
+                _LOG.error("fleet: %s died; max_restarts (%d) "
+                           "exhausted — leaving it down", name,
+                           self.max_restarts)
+                continue
+            rep.restart_count += 1
+            self._restarts += 1
+            _LOG.warning("fleet: %s died (rc=%s) — restarting "
+                         "(%d/%d)", name, rep.proc.returncode,
+                         rep.restart_count, self.max_restarts)
+            self.metrics.incr("replica_restarts")
+            self.router.note_restart(name)
+            t0 = time.monotonic()
+            rep.spawn()
+            # restarts serialize deliberately (one warmup at a
+            # time); meanwhile the health poller keeps marking any
+            # OTHER dead replica down, so routing stays correct
+            if rep.wait_ready(self.startup_timeout_s,
+                              stop_evt=self._stop_evt):
+                # new ephemeral port: point the router at it
+                # BEFORE reopening routing
+                self.router.update_url(name, rep.url)
+                self.router.set_state(name, OK)
+                self.metrics.add("replica_rejoin",
+                                 time.monotonic() - t0)
+            else:
+                _LOG.error("fleet: restarted %s failed to become "
+                           "healthy", name)
+
+    # -- operations ---------------------------------------------------
+    def rolling_reload(self, model_path: str) -> Dict[str, int]:
+        # serve_args repoint PER replica as each one's reload lands:
+        # a replica that dies mid-roll after ITS swap must rejoin on
+        # the NEW version (fresh list assignment — the monitor reads
+        # serve_args only at spawn)
+        def repoint(name: str) -> None:
+            rep = self.replicas.get(name)
+            if rep is not None:
+                rep.serve_args = _args_with_model(rep.serve_args,
+                                                  model_path)
+        return self.router.rolling_reload(model_path,
+                                          on_reloaded=repoint)
+
+    def kill_replica(self, name: str) -> None:
+        self.replicas[name].kill()
+
+    def restarts(self) -> int:
+        return self._restarts
+
+    def metrics_summary(self) -> dict:
+        out = self.router.metrics_summary()
+        out["fleet"] = {"replicas": self.n,
+                        "restarts": self._restarts}
+        return out

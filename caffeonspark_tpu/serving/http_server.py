@@ -10,11 +10,18 @@ Routes:
                       ...]} or a single record object; → {"rows": [...],
                       "model_version": N}
   POST /v1/reload    {"model": "<snapshot path>"} → hot-swap
-  GET  /healthz      liveness + current model version
-  GET  /metrics      serving metrics (PipelineMetrics JSON)
+                     (clears draining — rolling-swap rejoin)
+  POST /v1/drain     {"drain": true|false} → reject new predicts while
+                     accepted work still flushes (the fleet router
+                     takes this replica out of rotation first)
+  GET  /healthz      liveness + `status`: "ok" | "draining" (200) or
+                     "down" (503, no model) + batcher queue depth —
+                     the router's routability signal
+  GET  /metrics      serving metrics (PipelineMetrics JSON, plus
+                     queue_depth_now / per-bucket flush counters)
 
 Status mapping: 429 queue-full fast-reject, 504 deadline exceeded,
-400 malformed request, 503 model failure.
+400 malformed request, 503 draining or model failure.
 """
 
 from __future__ import annotations
@@ -31,10 +38,15 @@ from .batcher import DeadlineExceeded, QueueFullError, ServingStopped
 _LOG = logging.getLogger(__name__)
 
 
-class _Handler(BaseHTTPRequestHandler):
-    protocol_version = "HTTP/1.1"
+class JsonHandler(BaseHTTPRequestHandler):
+    """Shared JSON-over-HTTP plumbing (Content-Length framing both
+    ways, logging routed off stderr) for the replica front end here
+    and the fleet router's — one copy, so framing fixes cannot drift
+    between the two."""
 
-    # self.server is the ServingHTTPServer below
+    protocol_version = "HTTP/1.1"
+    log_prefix = "http: "
+
     def _send(self, code: int, payload: dict):
         body = json.dumps(payload).encode()
         self.send_response(code)
@@ -44,23 +56,30 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def log_message(self, fmt, *args):      # route to logging, not stderr
-        _LOG.debug("http: " + fmt, *args)
+        _LOG.debug(self.log_prefix + fmt, *args)
 
     def _read_json(self) -> dict:
         n = int(self.headers.get("Content-Length", 0))
         raw = self.rfile.read(n) if n else b"{}"
         return json.loads(raw.decode())
 
+
+class _Handler(JsonHandler):
+    # self.server is the ServingHTTPServer below
     def do_GET(self):
         svc = self.server.service
         if self.path == "/healthz":
             try:
                 version = svc.registry.current().version
             except RuntimeError:
-                self._send(503, {"ok": False, "error": "no model loaded"})
+                self._send(503, {"ok": False, "status": "down",
+                                 "error": "no model loaded"})
                 return
-            self._send(200, {"ok": True, "model_version": version,
-                             "queue_depth": len(svc.batcher)})
+            draining = getattr(svc, "draining", False)
+            self._send(200, {"ok": not draining,
+                             "status": "draining" if draining else "ok",
+                             "model_version": version,
+                             "queue_depth": svc.batcher.depth()})
         elif self.path == "/metrics":
             self._send(200, svc.metrics_summary())
         else:
@@ -70,6 +89,19 @@ class _Handler(BaseHTTPRequestHandler):
         svc = self.server.service
         if self.path == "/v1/predict":
             self._predict(svc)
+        elif self.path == "/v1/drain":
+            try:
+                req = self._read_json()
+                flag = req.get("drain", True)
+                if not isinstance(flag, bool):
+                    raise ValueError("'drain' must be a boolean")
+                svc.set_draining(flag)
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"error": str(e)})
+            else:
+                self._send(200, {"ok": True,
+                                 "status": "draining" if flag
+                                 else "ok"})
         elif self.path == "/v1/reload":
             try:
                 req = self._read_json()

@@ -1,0 +1,82 @@
+"""Shared retry policy: capped jittered exponential backoff.
+
+One implementation for every layer that retries transient serving
+overload, so the backoff behavior (and its knobs) cannot drift apart:
+
+  * the in-process `Client` retries `QueueFullError` (a 429 in HTTP
+    terms) instead of surfacing saturation to the caller on the first
+    bounce;
+  * the fleet `Router` retries 429s and connection failures against
+    another replica, so a killed or draining replica never surfaces as
+    a client error while healthy peers exist.
+
+Full jitter (delay ~ U[0, min(cap, base * 2^attempt)]): retriers that
+failed together do not retry together — the synchronized-retry herd is
+exactly the overload amplifier the fast-reject exists to shed.
+
+Knobs (env, shared by Client and Router):
+  COS_SERVE_RETRY_MAX      total attempts including the first
+                           (default 4; 1 = no retries)
+  COS_SERVE_RETRY_BASE_MS  first backoff ceiling (default 10)
+  COS_SERVE_RETRY_CAP_MS   per-sleep ceiling (default 500)
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Iterator, Optional, Tuple, Type
+
+from .batcher import _env_num
+
+
+class RetryPolicy:
+    """Attempt count + backoff schedule.  `seed` pins the jitter for
+    deterministic tests; production callers leave it None."""
+
+    def __init__(self, attempts: Optional[int] = None,
+                 base_ms: Optional[float] = None,
+                 cap_ms: Optional[float] = None,
+                 seed: Optional[int] = None):
+        self.attempts = max(1, int(attempts if attempts is not None
+                                   else _env_num("COS_SERVE_RETRY_MAX",
+                                                 4)))
+        self.base_ms = max(0.0, base_ms if base_ms is not None
+                           else _env_num("COS_SERVE_RETRY_BASE_MS", 10))
+        self.cap_ms = max(0.0, cap_ms if cap_ms is not None
+                          else _env_num("COS_SERVE_RETRY_CAP_MS", 500))
+        self._rng = random.Random(seed)
+
+    def delays_s(self) -> Iterator[float]:
+        """Backoff before each RETRY (attempts - 1 of them): full
+        jitter under an exponentially growing, capped ceiling."""
+        for k in range(self.attempts - 1):
+            ceil_ms = min(self.cap_ms, self.base_ms * (2 ** k))
+            yield self._rng.uniform(0.0, ceil_ms) / 1e3
+
+
+def retry_call(fn: Callable, *,
+               retry_on: Tuple[Type[BaseException], ...],
+               policy: Optional[RetryPolicy] = None,
+               on_retry: Optional[Callable[[BaseException, int],
+                                           None]] = None,
+               sleep: Callable[[float], None] = time.sleep):
+    """Call `fn()`; on a retryable exception, back off and try again
+    until the policy's attempts run out, then re-raise the last error.
+    `on_retry(err, attempt)` observes each retry (the router uses it
+    to mark the failed replica and count retries)."""
+    policy = policy or RetryPolicy()
+    last: Optional[BaseException] = None
+    for attempt, delay in enumerate(policy.delays_s()):
+        try:
+            return fn()
+        except retry_on as e:       # noqa: PERF203 — retry loop
+            last = e
+            if on_retry is not None:
+                on_retry(e, attempt)
+            if delay > 0:
+                sleep(delay)
+    try:
+        return fn()
+    except retry_on as e:
+        raise e from last

@@ -1,0 +1,589 @@
+"""Fleet request router: least-outstanding balancing over replicas.
+
+One router fronts N replica processes, each running the existing
+single-process serving stack (`InferenceService` + HTTP).  "RPC
+Considered Harmful" frames the job: for small-payload inference the
+transport/queueing layer dominates, so the router's whole value is in
+WHERE it queues — keep every replica's micro-batcher fed (more
+co-batching, deeper amortization) without letting any one replica
+build a backlog the others could have absorbed.
+
+  * **Balancing** — least-outstanding-requests: route to the healthy
+    replica with the fewest router-side in-flight requests.  Unlike
+    round-robin this is self-correcting under heterogeneous replica
+    speed (a slow replica accumulates outstanding and stops being
+    picked until it drains).
+  * **Health / draining** — per-replica state machine
+    `starting → ok ⇄ draining → down`: a background poller reads each
+    replica's `/healthz` (which reports `ok`/`draining`), and only
+    `ok` replicas are routable.  Draining is how rolling hot-swap
+    takes one replica out of rotation without dropping a request.
+  * **Retry** — 429 (queue full), 503 (draining/stopping) and
+    connection failures are retried against the next pick with capped
+    jittered backoff (`retry.RetryPolicy`, shared with the in-process
+    Client), so a killed replica never surfaces as a client error
+    while a healthy peer exists; connection failures additionally mark
+    the replica down immediately (faster than the next health poll).
+  * **Rolling hot-swap** — `rolling_reload` publishes a new snapshot
+    one replica at a time: drain → wait idle → `/v1/reload` → back in
+    rotation.  Per-replica never-mixed already holds (the registry
+    snapshots `current()` once per flush); the fleet-wide invariant
+    this adds is that only the old and the new version ever coexist,
+    so every response comes from exactly one of them.
+
+Lock discipline (COS005): `Router._lock` guards only the replica
+table and counters — never held across an HTTP call or a sleep.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from ..metrics import PipelineMetrics
+from .retry import RetryPolicy, retry_call
+
+_LOG = logging.getLogger(__name__)
+
+# Transport-level failures while talking to a replica.  HTTPException
+# matters: a replica SIGKILLed after the status line surfaces as
+# http.client.IncompleteRead from r.read() — an HTTPException, NOT an
+# OSError — and must be just as retryable as connection-refused
+# (predict is idempotent inference).
+TRANSPORT_ERRORS = (urllib.error.URLError, ConnectionError,
+                    socket.timeout, TimeoutError,
+                    http.client.HTTPException)
+
+# replica states
+STARTING = "starting"
+OK = "ok"
+DRAINING = "draining"
+DOWN = "down"
+
+
+class NoReplicaAvailable(RuntimeError):
+    """No replica is in the `ok` state (retried under the policy —
+    a restart in progress looks exactly like this for a moment)."""
+
+
+class RouteRetryable(RuntimeError):
+    """A per-attempt failure the router absorbs by re-picking: 429,
+    503 (draining/stopping), connection refused/reset/timeout."""
+
+
+class RouterRequestError(RuntimeError):
+    """A replica answered with a non-retryable error status; carries
+    the status code and body for the front end to pass through."""
+
+    def __init__(self, code: int, body: dict):
+        super().__init__(f"replica answered {code}: "
+                         f"{body.get('error', body)}")
+        self.code = code
+        self.body = body
+
+
+def http_json(url: str, *, data: Optional[bytes] = None,
+               timeout: float = 30.0, method: Optional[str] = None
+               ) -> Tuple[int, dict]:
+    """One HTTP exchange, JSON both ways.  Non-2xx returns (code,
+    parsed body) instead of raising so callers classify by status;
+    transport failures raise OSError/URLError."""
+    req = urllib.request.Request(
+        url, data=data, method=method or ("POST" if data is not None
+                                          else "GET"),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read() or b"{}")
+        except (ValueError, OSError, http.client.HTTPException):
+            body = {"error": str(e)}
+        return e.code, body
+
+
+class _Replica:
+    """Router-side view of one replica endpoint.  Mutable fields are
+    guarded by the ROUTER's lock (one lock for the whole table — the
+    pick must read every replica's outstanding count atomically)."""
+
+    __slots__ = ("name", "url", "state", "outstanding", "requests",
+                 "failures", "restarts", "drain_intent")
+
+    def __init__(self, name: str, url: str, state: str = STARTING):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.state = state
+        self.outstanding = 0
+        self.requests = 0
+        self.failures = 0
+        self.restarts = 0
+        self.drain_intent = False   # True only for ROUTER-issued drains
+
+
+class Router:
+    def __init__(self, endpoints: Optional[Dict[str, str]] = None, *,
+                 policy: Optional[RetryPolicy] = None,
+                 http_timeout_s: float = 120.0,
+                 health_timeout_s: float = 5.0,
+                 metrics: Optional[PipelineMetrics] = None):
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, _Replica] = {}
+        self._rr = 0             # round-robin tie-break cursor
+        self.policy = policy or RetryPolicy()
+        self.http_timeout_s = http_timeout_s
+        self.health_timeout_s = health_timeout_s
+        self.metrics = metrics or PipelineMetrics()
+        self._health_thread: Optional[threading.Thread] = None
+        self._health_stop = threading.Event()
+        for name, url in (endpoints or {}).items():
+            self.add_replica(name, url)
+
+    # -- replica table ------------------------------------------------
+    def add_replica(self, name: str, url: str,
+                    state: str = STARTING) -> None:
+        with self._lock:
+            self._replicas[name] = _Replica(name, url, state)
+
+    def remove_replica(self, name: str) -> None:
+        with self._lock:
+            self._replicas.pop(name, None)
+
+    def update_url(self, name: str, url: str) -> None:
+        """A restarted replica comes back on a fresh ephemeral port;
+        keep its counters (requests/restarts) across the move."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None:
+                rep.url = url.rstrip("/")
+
+    def set_state(self, name: str, state: str) -> None:
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None and rep.state != state:
+                _LOG.info("router: replica %s %s -> %s", name,
+                          rep.state, state)
+                rep.state = state
+
+    def _apply_poll(self, name: str, url: str, prev: str,
+                    status: str) -> None:
+        """Compare-and-set: apply a health-poll outcome only if the
+        replica's state AND url are unchanged since the poll was
+        issued — a concurrent drain (set after the snapshot but before
+        the stale 'ok' response landed) or a restart's update_url
+        supersedes the result; the next poll sees fresh state."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None or rep.url != url or rep.state != prev:
+                return
+            if rep.state != status:
+                _LOG.info("router: replica %s %s -> %s", name,
+                          rep.state, status)
+                rep.state = status
+
+    def replica_url(self, name: str) -> str:
+        with self._lock:
+            return self._replicas[name].url
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            return {n: r.state for n, r in self._replicas.items()}
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._replicas)
+
+    # -- balancing ----------------------------------------------------
+    def _pick(self, avoid: Optional[str] = None) -> _Replica:
+        """Least-outstanding among `ok` replicas; the outstanding
+        increment happens under the same lock as the choice, so two
+        concurrent picks never both see the same idle replica as
+        free.  Ties rotate round-robin (a fixed tie-break would pin
+        idle traffic to one replica), and `avoid` steers a RETRY away
+        from the replica that just bounced it — a 429 means that
+        replica's queue is full NOW; re-picking it inside the backoff
+        window would mostly re-bounce."""
+        with self._lock:
+            ok = [r for r in self._replicas.values() if r.state == OK]
+            if not ok:
+                raise NoReplicaAvailable(
+                    "no replica in state 'ok' (states: "
+                    + str({r.name: r.state
+                           for r in self._replicas.values()}) + ")")
+            pool = [r for r in ok if r.name != avoid] or ok
+            low = min(r.outstanding for r in pool)
+            ties = [r for r in pool if r.outstanding == low]
+            rep = ties[self._rr % len(ties)]
+            self._rr += 1
+            rep.outstanding += 1
+        return rep
+
+    def _done(self, rep: _Replica, failed: bool = False) -> None:
+        """`requests` counts COMPLETED requests, not pick attempts —
+        a bounced 429/conn-refused attempt lands in `failures`, so the
+        bench's per-replica utilization (delta of `requests`) never
+        credits a dead or saturated replica with traffic it shed."""
+        with self._lock:
+            rep.outstanding = max(0, rep.outstanding - 1)
+            if failed:
+                rep.failures += 1
+            else:
+                rep.requests += 1
+
+    def outstanding(self, name: str) -> int:
+        with self._lock:
+            return self._replicas[name].outstanding
+
+    # -- request path -------------------------------------------------
+    def predict(self, payload,
+                timeout_s: Optional[float] = None) -> dict:
+        """Route one /v1/predict body; returns the replica's parsed
+        response.  `payload` is a dict (programmatic callers) or
+        pre-encoded JSON bytes — the HTTP front door passes the raw
+        client body through untouched, since the replica parses and
+        validates it anyway and the router is the fleet's one shared
+        chokepoint.  Retryable failures re-pick (usually a different
+        replica — the failed one is marked down or has higher
+        outstanding); non-retryable replica errors surface as
+        RouterRequestError with the original status."""
+        data = (payload if isinstance(payload, (bytes, bytearray))
+                else json.dumps(payload).encode())
+        timeout = timeout_s or self.http_timeout_s
+        t0 = time.monotonic()
+        last_failed: List[Optional[str]] = [None]
+
+        def attempt() -> dict:
+            rep = self._pick(avoid=last_failed[0])
+            last_failed[0] = rep.name
+            failed = True
+            try:
+                try:
+                    code, body = http_json(rep.url + "/v1/predict",
+                                            data=data, timeout=timeout)
+                except TRANSPORT_ERRORS + (ValueError,) as e:
+                    # ValueError: a 200 whose body does not parse — a
+                    # replica that broken is as routable-around as a
+                    # refused connection
+                    # transport failure: the replica is gone or
+                    # wedged — stop routing to it before the next
+                    # health poll would notice
+                    self.set_state(rep.name, DOWN)
+                    self.metrics.incr("retry_conn")
+                    raise RouteRetryable(
+                        f"{rep.name}: {e}") from e
+                if code == 429:
+                    self.metrics.incr("retry_429")
+                    raise RouteRetryable(f"{rep.name}: 429 queue full")
+                if code == 503:
+                    # draining/stopping (or a model fault — bounded
+                    # retries against a peer are the right call for
+                    # both: the drain case must not surface, and a
+                    # deterministic fault fails on every peer anyway)
+                    self.metrics.incr("retry_503")
+                    raise RouteRetryable(
+                        f"{rep.name}: 503 {body.get('error', '')}")
+                if code >= 400:
+                    raise RouterRequestError(code, body)
+                failed = False
+                return body
+            finally:
+                self._done(rep, failed=failed)
+
+        def on_retry(err, attempt_i):
+            self.metrics.incr("retries")
+
+        out = retry_call(
+            attempt, retry_on=(RouteRetryable, NoReplicaAvailable),
+            policy=self.policy, on_retry=on_retry)
+        self.metrics.add("route", time.monotonic() - t0)
+        self.metrics.incr("routed")
+        return out
+
+    # -- health -------------------------------------------------------
+    def check_health_once(self) -> Dict[str, str]:
+        """Poll every replica's /healthz and update states.  A replica
+        that answers `ok` while the router holds it in `draining` WITH
+        drain intent stays draining (the router is mid-rolling-swap
+        and a stale pre-drain 'ok' must not re-admit it); a DRAINING
+        state the POLLER observed from a replica-side drain carries no
+        intent, so the poller lifts it as soon as the replica reports
+        `ok` again (an operator undraining a replica directly must not
+        strand it out of rotation)."""
+        with self._lock:
+            snapshot = [(r.name, r.url, r.state, r.drain_intent)
+                        for r in self._replicas.values()]
+        states = {}
+        for name, url, prev, intent in snapshot:
+            try:
+                code, body = http_json(url + "/healthz",
+                                        timeout=self.health_timeout_s)
+                status = body.get("status",
+                                  OK if code == 200 else DOWN)
+                if code != 200 and status == OK:
+                    status = DOWN
+            except TRANSPORT_ERRORS + (ValueError,):
+                status = DOWN
+            if prev == DRAINING and status == OK and intent:
+                status = DRAINING
+            states[name] = status
+            if status != prev:
+                self._apply_poll(name, url, prev, status)
+        return states
+
+    def start_health(self, interval_s: float = 0.5) -> "Router":
+        assert self._health_thread is None, "health loop already up"
+        self._health_stop.clear()
+
+        def loop():
+            while not self._health_stop.wait(interval_s):
+                try:
+                    self.check_health_once()
+                except Exception as e:      # noqa: BLE001 — keep polling
+                    _LOG.warning("router health poll failed: %s", e)
+
+        self._health_thread = threading.Thread(
+            target=loop, name="cos-router-health", daemon=True)
+        self._health_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._health_stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=10)
+            self._health_thread = None
+
+    # -- rolling hot-swap ---------------------------------------------
+    def drain_replica(self, name: str, wait_idle_s: float = 60.0,
+                      poll_s: float = 0.05) -> None:
+        """Take one replica out of rotation and wait until it is idle:
+        no router-side in-flight requests AND an empty replica-side
+        batcher queue (its own accepted backlog must flush on the OLD
+        version before a reload)."""
+        url = self.replica_url(name)
+        prev = self.states().get(name, OK)
+        self._set_drain_intent(name, True)
+        self.set_state(name, DRAINING)
+        try:
+            code, body = http_json(url + "/v1/drain",
+                                    data=b'{"drain": true}',
+                                    timeout=self.health_timeout_s)
+        except TRANSPORT_ERRORS:
+            # the drain never reached the replica: do not strand it
+            # router-side DRAINING forever (the health poller
+            # preserves ROUTER-intended drains) — but unreachable
+            # is DOWN, not OK (the poller re-admits on recovery)
+            self._set_drain_intent(name, False)
+            self.set_state(name, DOWN)
+            raise
+        if code != 200:
+            # the replica answered, it is alive: restore what it was
+            self._set_drain_intent(name, False)
+            self.set_state(name, prev)
+            raise RouterRequestError(code, body)
+        deadline = time.monotonic() + wait_idle_s
+        while time.monotonic() < deadline:
+            if self.outstanding(name) == 0:
+                try:
+                    # /healthz carries the batcher queue depth — O(1)
+                    # on the replica, unlike the full /metrics summary.
+                    # URL re-read each poll: a replica that dies
+                    # mid-drain is respawned on a NEW port by the
+                    # fleet monitor, and polling the dead one would
+                    # spin out the whole idle window
+                    _, h = http_json(self.replica_url(name)
+                                     + "/healthz",
+                                      timeout=self.health_timeout_s)
+                    if h.get("queue_depth", 0) == 0:
+                        return
+                except TRANSPORT_ERRORS:
+                    pass        # transient; re-poll until the deadline
+            time.sleep(poll_s)
+        # idle wait timed out: undo the drain so the replica returns
+        # to rotation instead of serving nothing indefinitely
+        try:
+            self.undrain_replica(name)
+        except TRANSPORT_ERRORS + (RouterRequestError,):
+            self.set_state(name, DOWN)   # poller re-admits on recovery
+        raise TimeoutError(f"replica {name} did not go idle within "
+                           f"{wait_idle_s}s of draining")
+
+    def undrain_replica(self, name: str) -> None:
+        url = self.replica_url(name)
+        # intent cleared up front: even if the POST below fails, the
+        # poller may now lift DRAINING once the replica reports ok
+        self._set_drain_intent(name, False)
+        code, body = http_json(url + "/v1/drain",
+                                data=b'{"drain": false}',
+                                timeout=self.health_timeout_s)
+        if code != 200:
+            # do NOT mark OK on a refused undrain: routing to a
+            # still-draining replica just burns retries on 503s
+            raise RouterRequestError(code, body)
+        self.set_state(name, OK)
+
+    def _set_drain_intent(self, name: str, flag: bool) -> None:
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None:
+                rep.drain_intent = flag
+
+    def rolling_reload(self, model_path: str,
+                       wait_idle_s: float = 60.0,
+                       on_reloaded=None) -> Dict[str, int]:
+        """Publish `model_path` fleet-wide, one replica at a time:
+        drain → wait idle → reload → back in rotation.  At every
+        instant each replica serves entirely old or entirely new
+        weights, so fleet-wide the only versions in flight are those
+        two (the old-xor-new invariant the fleet tests pin).
+        `on_reloaded(name)` fires after EACH replica's successful
+        swap — the fleet uses it to repoint that replica's respawn
+        args mid-roll, not only at the end."""
+        versions: Dict[str, int] = {}
+        for name in self.names():
+            self.drain_replica(name, wait_idle_s=wait_idle_s)
+            url = self.replica_url(name)
+            code, body = http_json(
+                url + "/v1/reload",
+                data=json.dumps({"model": model_path}).encode(),
+                timeout=max(self.http_timeout_s, 60.0))
+            if code != 200:
+                # leave the replica draining (it still serves nothing)
+                # rather than re-admitting a version we cannot name
+                raise RouterRequestError(code, body)
+            if on_reloaded is not None:
+                on_reloaded(name)
+            self.undrain_replica(name)
+            versions[name] = body.get("model_version", -1)
+            self.metrics.incr("replica_reloads")
+        self.metrics.incr("rolling_reloads")   # one per OPERATION
+        return versions
+
+    # -- reporting ----------------------------------------------------
+    def metrics_summary(self) -> dict:
+        out = self.metrics.summary()
+        with self._lock:
+            out["replicas"] = {
+                n: {"state": r.state, "url": r.url,
+                    "outstanding": r.outstanding,
+                    "requests": r.requests, "failures": r.failures,
+                    "restarts": r.restarts}
+                for n, r in self._replicas.items()}
+        return out
+
+    def note_restart(self, name: str) -> None:
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None:
+                rep.restarts += 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP front door
+# ---------------------------------------------------------------------------
+
+def _make_handler():
+    from .http_server import JsonHandler
+
+    class Handler(JsonHandler):
+        log_prefix = "router http: "
+
+        def do_GET(self):
+            router: Router = self.server.router
+            if self.path == "/healthz":
+                states = router.states()
+                n_ok = sum(1 for s in states.values() if s == OK)
+                status = (OK if n_ok == len(states) and states
+                          else DOWN if not n_ok else "degraded")
+                self._send(200 if n_ok else 503,
+                           {"ok": bool(n_ok), "status": status,
+                            "replicas": states})
+            elif self.path == "/metrics":
+                self._send(200, router.metrics_summary())
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            router: Router = self.server.router
+            if self.path == "/v1/predict":
+                try:
+                    # raw pass-through: the replica parses/validates
+                    # the body; decoding + re-encoding thousands of
+                    # pixel floats here would double router CPU
+                    n = int(self.headers.get("Content-Length", 0))
+                    out = router.predict(self.rfile.read(n)
+                                         if n else b"{}")
+                except RouterRequestError as e:
+                    self._send(e.code, e.body)
+                except (RouteRetryable, NoReplicaAvailable) as e:
+                    # retries exhausted: the fleet really is saturated
+                    # or down — surface as 503 (try again later)
+                    self._send(503, {"error": str(e)})
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": str(e)})
+                else:
+                    self._send(200, out)
+            elif self.path == "/v1/reload":
+                try:
+                    # the fleet's reload_fn (when fronting a Fleet)
+                    # also repoints restart-on-death at the new model
+                    reload_fn = (getattr(self.server, "reload_fn",
+                                         None)
+                                 or router.rolling_reload)
+                    versions = reload_fn(self._read_json()["model"])
+                except (KeyError, ValueError,
+                        json.JSONDecodeError) as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:    # noqa: BLE001 — swap fault
+                    self._send(503, {"error": str(e)})
+                else:
+                    self._send(200, {"ok": True, "versions": versions})
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+    return Handler
+
+
+class RouterHTTPServer:
+    """The fleet's single client-facing port: proxies /v1/predict
+    through the router (balancing + retries), /v1/reload through the
+    rolling hot-swap, and aggregates /healthz //metrics.  Same
+    loopback-by-default stance as the replica server."""
+
+    def __init__(self, router: Router, host: str = "127.0.0.1",
+                 port: int = 0, reload_fn=None):
+        from http.server import ThreadingHTTPServer
+        self.router = router
+        self._httpd = ThreadingHTTPServer((host, port), _make_handler())
+        self._httpd.daemon_threads = True
+        self._httpd.router = router
+        self._httpd.reload_fn = reload_fn
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start_background(self) -> "RouterHTTPServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="cos-router-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self._httpd.serve_forever()
+
+    def stop(self):
+        self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._httpd.server_close()

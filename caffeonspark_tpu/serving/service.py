@@ -24,7 +24,9 @@ import numpy as np
 
 from ..data.source import DataSource, ImageRecord, get_source
 from ..metrics import PipelineMetrics
-from .batcher import MicroBatcher, PendingResult
+from .batcher import (MicroBatcher, PendingResult, QueueFullError,
+                      ServingStopped)
+from .retry import RetryPolicy, retry_call
 from .forward import fetch_rows
 from .registry import ModelRegistry
 
@@ -95,6 +97,9 @@ class InferenceService:
             default_timeout_ms=default_timeout_ms,
             metrics=self.metrics)
         self._started = False
+        self._draining = False   # rolling-swap state: reject new work
+        self._warmup_wall_s: Optional[float] = None
+        self._aot_cache_dir: Optional[str] = None
         self._dims = None        # lazy (C,H,W) for dict-record coercion
         # COS_RECOMPILE_GUARD=1: after warmup pre-compiles every bucket
         # program, a steady-state recompile means a request slipped
@@ -120,9 +125,20 @@ class InferenceService:
         """Warm every bucket's program BEFORE traffic (eager XLA
         pre-compile: without it the first request of each batch shape
         pays whole-program compilation in its latency), then start the
-        dispatcher."""
+        dispatcher.  With COS_AOT_CACHE_DIR set, warmup runs against
+        the persistent compilation cache — a replica whose programs an
+        earlier replica already compiled warms on cache hits (AOT warm
+        start, serving/aot.py)."""
         assert not self._started, "service already started"
+        from . import aot
+        cache_dir = aot.resolve_cache_dir(self.conf.netParam,
+                                          self.batcher.buckets,
+                                          self.blob_names)
+        if cache_dir and aot.enable_aot_cache(cache_dir):
+            self._aot_cache_dir = cache_dir
+        t0 = time.monotonic()
         warmed = self.warmup() if warmup else False
+        self._warmup_wall_s = time.monotonic() - t0 if warmed else None
         if self._recompile_guard is not None:
             self._recompile_guard.watch(
                 "serving.forward",
@@ -165,6 +181,18 @@ class InferenceService:
         if self._started:
             self.batcher.stop(drain=drain)
             self._started = False
+
+    # -- draining (rolling hot-swap) ----------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def set_draining(self, flag: bool) -> None:
+        """Draining rejects NEW submits (the router routes elsewhere)
+        while everything already accepted still flushes — the replica-
+        side half of the fleet's rolling hot-swap.  Unlike stop(), the
+        dispatcher stays up and undraining is instant."""
+        self._draining = bool(flag)
 
     # -- model hook ---------------------------------------------------
     def _run_batch(self, records: List[Any], bucket: int
@@ -212,6 +240,8 @@ class InferenceService:
         """Coercion/validation happens HERE, per request — a malformed
         record must be the submitter's error (HTTP 400), never a flush
         failure that poisons every co-batched request."""
+        if self._draining:
+            raise ServingStopped("replica is draining")
         if not isinstance(record, tuple):
             record = coerce_record(record, self._record_dims())
         return self.batcher.submit(record, timeout_ms=timeout_ms)
@@ -223,6 +253,8 @@ class InferenceService:
         before anything is enqueued), then enqueue all-or-nothing — a
         partially-admitted list would execute abandoned rows after its
         caller was told to retry."""
+        if self._draining:
+            raise ServingStopped("replica is draining")
         coerced = [r if isinstance(r, tuple)
                    else coerce_record(r, self._record_dims())
                    for r in records]
@@ -230,26 +262,54 @@ class InferenceService:
 
     def reload(self, model_path: str) -> int:
         """Hot-swap to a newer snapshot; in-flight flushes finish on
-        the version they started with."""
-        return self.registry.load(model_path).version
+        the version they started with.  Clears draining: a reload is
+        how a drained replica rejoins the rotation (rolling swap)."""
+        version = self.registry.load(model_path).version
+        self._draining = False
+        return version
 
     def metrics_summary(self) -> dict:
         out = self.metrics.summary()
         out["model_version"] = self.registry.version
         out["buckets"] = list(self.batcher.buckets)
+        # live depth + status: what the fleet router polls to spot a
+        # backed-up replica and to confirm a drain went idle
+        out["queue_depth_now"] = self.batcher.depth()
+        out["status"] = "draining" if self._draining else "ok"
+        if self._warmup_wall_s is not None:
+            out["warmup_s"] = round(self._warmup_wall_s, 4)
+        if self._aot_cache_dir:
+            out["aot_cache_dir"] = self._aot_cache_dir
         return out
 
 
 class Client:
-    """In-process client: submit-and-wait over an InferenceService."""
+    """In-process client: submit-and-wait over an InferenceService.
 
-    def __init__(self, service: InferenceService):
+    Saturation (`QueueFullError`, the in-process 429) is retried with
+    capped jittered backoff — the same `retry.RetryPolicy` the fleet
+    router uses over HTTP — instead of surfacing on the first bounce:
+    a co-located caller that fails fast and retries hot is the herd
+    the fast-reject is shedding.  `retry=False` (or
+    COS_SERVE_RETRY_MAX=1) restores surface-immediately."""
+
+    def __init__(self, service: InferenceService,
+                 policy: Optional[RetryPolicy] = None,
+                 retry: bool = True):
         self.service = service
+        self.policy = policy or RetryPolicy()
+        self.retry = retry
+
+    def _submit(self, record, timeout_ms):
+        if not self.retry:
+            return self.service.submit(record, timeout_ms=timeout_ms)
+        return retry_call(
+            lambda: self.service.submit(record, timeout_ms=timeout_ms),
+            retry_on=(QueueFullError,), policy=self.policy)
 
     def predict_one(self, record, timeout_ms: Optional[float] = None,
                     wait_s: float = 120.0) -> Dict[str, Any]:
-        return self.service.submit(record,
-                                   timeout_ms=timeout_ms).wait(wait_s)
+        return self._submit(record, timeout_ms).wait(wait_s)
 
     def predict(self, records: Sequence[Any],
                 timeout_ms: Optional[float] = None,
@@ -257,6 +317,5 @@ class Client:
         """Submit every record BEFORE waiting, so the batcher can
         coalesce the whole set into as few flushes as the buckets
         allow."""
-        pending = [self.service.submit(r, timeout_ms=timeout_ms)
-                   for r in records]
+        pending = [self._submit(r, timeout_ms) for r in records]
         return [p.wait(wait_s) for p in pending]

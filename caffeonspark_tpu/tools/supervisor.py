@@ -76,6 +76,39 @@ def find_latest_snapshot(outdir: str, prefix: str
     return best
 
 
+def terminate_processes(procs: List[subprocess.Popen],
+                        grace: float = 10.0,
+                        kill_wait: float = 30.0) -> None:
+    """SIGTERM with a drain window first, SIGKILL only stragglers.
+
+    An immediate SIGKILL loses in-flight ASYNC work: write-behind
+    snapshot uploads (training ranks) and accepted serving flushes
+    (fleet replicas) both run behind the main loop, and killing the
+    process mid-drain throws away exactly the work the restart/client
+    was counting on.  A process wedged in a collective never runs its
+    SIGTERM handler, but its background threads still drain during the
+    window — then the SIGKILL sweep reaps it.  Shared by the training
+    supervisor and the serving fleet (serving/fleet.py)."""
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.time() + grace
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                pass
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+    for p in procs:
+        try:
+            p.wait(timeout=kill_wait)
+        except subprocess.TimeoutExpired:
+            pass
+
+
 class Supervisor:
     def __init__(self, args, passthrough: List[str]):
         self.args = args
@@ -100,36 +133,12 @@ class Supervisor:
         return subprocess.Popen(cmd)
 
     def _teardown(self):
-        """SIGTERM with a drain window first, SIGKILL only stragglers.
-
-        An immediate SIGKILL loses in-flight ASYNC snapshot uploads:
-        write-behind checkpointing to a remote FS can run seconds
-        behind the step loop, and killing the rank mid-upload throws
-        away the very snapshot the relaunch needs (the gs:// drill in
+        """Graceful teardown (terminate_processes): the drain window
+        lets write-behind snapshot uploads finish — the gs:// drill in
         tests/test_fsutils_gcs.py restarted from scratch because the
-        iter-8 upload died with rank 0).  A rank wedged in a collective
-        (its peer died) never runs its SIGTERM handler, but its
-        uploader THREAD still drains during the window — then the
-        SIGKILL sweep reaps it."""
-        grace = getattr(self.args, "grace", 10.0)
-        for p in self.procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        deadline = time.time() + grace
-        for p in self.procs:
-            if p.poll() is None:
-                try:
-                    p.wait(timeout=max(0.1, deadline - time.time()))
-                except subprocess.TimeoutExpired:
-                    pass
-        for p in self.procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGKILL)
-        for p in self.procs:
-            try:
-                p.wait(timeout=30)
-            except subprocess.TimeoutExpired:
-                pass
+        iter-8 upload died with rank 0 under an immediate kill."""
+        terminate_processes(self.procs,
+                            grace=getattr(self.args, "grace", 10.0))
         self.procs = []
 
     def _progress_stamp(self, prefix: str) -> Tuple[int, int]:

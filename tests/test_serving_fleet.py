@@ -1,0 +1,895 @@
+"""Fleet serving: continuous batching, retry/backoff, the replica
+router (balancing, health, draining, retry-absorption), AOT warm
+start, and the subprocess fleet e2e drills (kill under load with zero
+client-visible failures; rolling hot-swap that is old-xor-new
+fleet-wide)."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from caffeonspark_tpu import checkpoint
+from caffeonspark_tpu.config import Config
+from caffeonspark_tpu.proto import NetParameter, SolverParameter
+from caffeonspark_tpu.serving import (Client, Fleet, InferenceService,
+                                      MicroBatcher, NoReplicaAvailable,
+                                      QueueFullError, RetryPolicy,
+                                      Router, RouterHTTPServer,
+                                      ServingHTTPServer,
+                                      ServingStopped, retry_call)
+from caffeonspark_tpu.serving import aot
+from caffeonspark_tpu.serving.fleet import serve_replicas
+from caffeonspark_tpu.serving.router import DOWN, DRAINING, OK
+from caffeonspark_tpu.solver import Solver
+
+NET_TMPL = """
+name: "tiny"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param {{ source: "{root}/unused_lmdb" batch_size: 8
+    channels: 1 height: 12 width: 12 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param {{ num_output: 4 kernel_size: 3
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "relu" type: "ReLU" bottom: "conv1" top: "conv1" }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "conv1" top: "ip"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}
+"""
+
+SOLVER_TMPL = """
+net: "{net}"
+base_lr: 0.01
+lr_policy: "fixed"
+max_iter: 20
+random_seed: 5
+"""
+
+
+def _records(n, seed=0):
+    return [(f"{i:08d}", float(i % 3), 1, 12, 12, False,
+             np.random.RandomState(seed + i)
+             .rand(1, 12, 12).astype(np.float32) * 255.0)
+            for i in range(n)]
+
+
+def _dict_record(i=0):
+    return {"id": f"r{i}", "label": 0.0,
+            "data": (np.arange(144, dtype=np.float32)
+                     .reshape(1, 12, 12) % 251).tolist()}
+
+
+@pytest.fixture()
+def tiny_model(tmp_path):
+    net_path = tmp_path / "net.prototxt"
+    net_path.write_text(NET_TMPL.format(root=tmp_path))
+    solver_path = tmp_path / "solver.prototxt"
+    solver_path.write_text(SOLVER_TMPL.format(net=net_path))
+    s = Solver(SolverParameter.from_text(
+        SOLVER_TMPL.format(net=net_path)),
+        NetParameter.from_text(NET_TMPL.format(root=tmp_path)))
+    params, _ = s.init()
+    model = str(tmp_path / "m.caffemodel")
+    checkpoint.save_caffemodel(model, s.train_net, params)
+    return str(solver_path), model
+
+
+def _service(tiny_model, **kw):
+    solver_path, model = tiny_model
+    conf = Config(["-conf", solver_path, "-model", model])
+    kw.setdefault("blob_names", ("ip",))
+    return InferenceService(conf, **kw)
+
+
+# ----------------------------------------------------- retry helper
+
+def test_retry_policy_schedule_and_knobs(monkeypatch):
+    for k in ("COS_SERVE_RETRY_MAX", "COS_SERVE_RETRY_BASE_MS",
+              "COS_SERVE_RETRY_CAP_MS"):
+        monkeypatch.delenv(k, raising=False)
+    p = RetryPolicy(seed=7)
+    assert p.attempts == 4 and p.base_ms == 10 and p.cap_ms == 500
+    delays = list(p.delays_s())
+    assert len(delays) == 3                  # attempts - 1 backoffs
+    for k, d in enumerate(delays):           # full jitter under the
+        assert 0.0 <= d <= min(0.5, 0.01 * (2 ** k))   # capped ceiling
+    monkeypatch.setenv("COS_SERVE_RETRY_MAX", "2")
+    monkeypatch.setenv("COS_SERVE_RETRY_BASE_MS", "1")
+    monkeypatch.setenv("COS_SERVE_RETRY_CAP_MS", "3")
+    p = RetryPolicy(seed=0)
+    assert p.attempts == 2 and p.cap_ms == 3
+    assert len(list(p.delays_s())) == 1
+
+
+def test_retry_call_absorbs_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise QueueFullError("busy")
+        return "ok"
+
+    slept = []
+    out = retry_call(flaky, retry_on=(QueueFullError,),
+                     policy=RetryPolicy(attempts=4, base_ms=5,
+                                        cap_ms=10, seed=1),
+                     sleep=slept.append)
+    assert out == "ok" and len(calls) == 3 and len(slept) == 2
+
+
+def test_retry_call_exhausts_and_reraises():
+    def always():
+        raise QueueFullError("busy")
+
+    with pytest.raises(QueueFullError):
+        retry_call(always, retry_on=(QueueFullError,),
+                   policy=RetryPolicy(attempts=3, base_ms=0.1,
+                                      cap_ms=0.2, seed=1),
+                   sleep=lambda s: None)
+
+
+def test_client_retries_on_queue_full(tiny_model):
+    """The in-process Client absorbs transient saturation with the
+    shared backoff instead of surfacing QueueFullError immediately."""
+
+    class FlakyService:
+        def __init__(self):
+            self.calls = 0
+
+        def submit(self, record, timeout_ms=None):
+            self.calls += 1
+            if self.calls < 3:
+                raise QueueFullError("saturated")
+
+            class Done:
+                def wait(self, _t):
+                    return {"v": [1.0]}
+            return Done()
+
+    svc = FlakyService()
+    cl = Client(svc, policy=RetryPolicy(attempts=4, base_ms=0.1,
+                                        cap_ms=0.2, seed=2))
+    assert cl.predict_one(("r", 0.0)) == {"v": [1.0]}
+    assert svc.calls == 3
+    svc.calls = 0
+    with pytest.raises(QueueFullError):
+        Client(svc, retry=False).predict_one(("r", 0.0))
+    assert svc.calls == 1                    # surfaced on first bounce
+
+
+# ---------------------------------------------- continuous batching
+
+def test_continuous_batching_admits_next_flush_during_execution():
+    """Tentpole behavior: while one flush EXECUTES (slow fake
+    forward), newly arriving requests are assembled into the next
+    flush and staged — the original dispatcher was flush-and-wait."""
+    calls = []
+    started = threading.Event()
+    release = threading.Event()
+
+    def run(records, bucket):
+        calls.append(tuple(records))
+        if len(calls) == 1:
+            started.set()
+            assert release.wait(10.0), "test released flush 1"
+        return [{"v": [float(r)]} for r in records], 1
+
+    b = MicroBatcher(run, max_batch=8, queue_depth=32,
+                     max_wait_ms=150).start()
+    p1 = b.submit(1)
+    assert started.wait(5.0)                 # flush 1 executing
+    ps = b.submit_many([2, 3])
+    deadline = time.monotonic() + 5.0
+    # the overlap counter ticks exactly when a flush is staged WHILE
+    # another executes — flush 1 is still held open by `release`
+    while b.metrics.get_counter("overlapped_flushes") == 0 \
+            and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert b.metrics.get_counter("overlapped_flushes") == 1
+    assert b.depth() == 2      # both new requests sit in the staged
+    assert not p1.done()       # flush; flush 1 still in flight
+    release.set()
+    assert p1.wait(10.0)["v"] == [1.0]
+    assert [p.wait(10.0)["v"] for p in ps] == [[2.0], [3.0]]
+    assert calls == [(1,), (2, 3)]
+    b.stop()
+
+
+def test_per_bucket_flush_counters_and_depth():
+    def run(records, bucket):
+        return [{"v": [float(r)]} for r in records], 1
+
+    b = MicroBatcher(run, max_batch=4, queue_depth=32, max_wait_ms=5)
+    pend = b.submit_many([1, 2, 3, 4])        # full bucket-4 flush
+    b.start()
+    for p in pend:
+        p.wait(10.0)
+    b.submit(5).wait(10.0)                    # lone request: bucket 1
+    c = b.metrics.summary()["counters"]
+    assert c["flush_bucket_4"] == 1
+    assert c["flush_bucket_1"] == 1
+    assert c["flushes"] == 2
+    assert b.depth() == 0
+    b.stop()
+
+
+# ------------------------------------------------------ fake replica
+
+class _FakeReplica:
+    """Stdlib fake of the replica HTTP surface (healthz / metrics /
+    predict / drain / reload) with scriptable behavior."""
+
+    def __init__(self, version=1, mode="ok"):
+        self.version = version
+        self.mode = mode   # ok | busy (429) | fault (503) | truncate
+        self.draining = False
+        self.served = 0
+        self.block = None          # Event: hold predicts in-handler
+        self.reloads = []
+        self.queue_depth = 0       # reported by /metrics
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    st = "draining" if outer.draining else "ok"
+                    self._send(200, {"ok": st == "ok", "status": st,
+                                     "model_version": outer.version,
+                                     "queue_depth": outer.queue_depth})
+                elif self.path == "/metrics":
+                    self._send(200, {"queue_depth_now":
+                                     outer.queue_depth,
+                                     "counters": {
+                                         "served_rows": outer.served}})
+                else:
+                    self._send(404, {"error": "no route"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/v1/predict":
+                    if outer.block is not None:
+                        outer.block.wait(10.0)
+                    if outer.draining:
+                        self._send(503, {"error": "draining"})
+                    elif outer.mode == "busy":
+                        self._send(429, {"error": "queue full"})
+                    elif outer.mode == "fault":
+                        self._send(503, {"error": "model fault"})
+                    elif outer.mode == "truncate":
+                        # SIGKILL-mid-response shape: status line +
+                        # Content-Length sent, body never arrives
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header("Content-Length", "108")
+                        self.close_connection = True
+                        self.end_headers()
+                    else:
+                        outer.served += 1
+                        self._send(200, {
+                            "rows": [{"SampleID": r.get("id", "")}
+                                     for r in req.get("records", [])],
+                            "model_version": outer.version})
+                elif self.path == "/v1/drain":
+                    outer.draining = bool(req.get("drain", True))
+                    self._send(200, {"ok": True})
+                elif self.path == "/v1/reload":
+                    outer.reloads.append(req.get("model"))
+                    outer.version += 1
+                    outer.draining = False
+                    self._send(200, {"ok": True,
+                                     "model_version": outer.version})
+                else:
+                    self._send(404, {"error": "no route"})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self._thread.join(timeout=10)
+        self.httpd.server_close()
+
+
+def _router(fakes, **kw):
+    kw.setdefault("policy", RetryPolicy(attempts=4, base_ms=0.1,
+                                        cap_ms=0.5, seed=3))
+    r = Router({f"r{i}": f.url for i, f in enumerate(fakes)}, **kw)
+    for name in r.names():
+        r.set_state(name, OK)
+    return r
+
+
+@pytest.fixture()
+def two_fakes():
+    fakes = [_FakeReplica(), _FakeReplica()]
+    yield fakes
+    for f in fakes:
+        f.stop()
+
+
+# ----------------------------------------------------------- router
+
+def test_router_least_outstanding(two_fakes):
+    """A replica with an in-flight request stops being picked while
+    an idle peer exists."""
+    a, b = two_fakes
+    a.block = threading.Event()              # holds a's predicts open
+    router = _router(two_fakes)
+    t = threading.Thread(target=router.predict,
+                         args=({"records": [{"id": "x"}]},),
+                         daemon=True)
+    t.start()                                # occupies one replica
+    deadline = time.monotonic() + 5.0
+    while (router.outstanding("r0") + router.outstanding("r1")) == 0 \
+            and time.monotonic() < deadline:
+        time.sleep(0.005)
+    blocked = "r0" if router.outstanding("r0") else "r1"
+    free = "r1" if blocked == "r0" else "r0"
+    for _ in range(5):                       # all go to the idle one
+        router.predict({"records": [{"id": "y"}]})
+    assert router.outstanding(blocked) == 1
+    summary = router.metrics_summary()["replicas"]
+    assert summary[free]["requests"] == 5
+    a.block.set()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def test_router_retry_on_429_absorbs_saturation(two_fakes):
+    """One saturated replica (429) never surfaces to the client while
+    a peer has room: the retry re-picks AWAY from the bouncer."""
+    a, b = two_fakes
+    a.mode = "busy"
+    router = _router(two_fakes)
+    for i in range(6):
+        out = router.predict({"records": [{"id": f"q{i}"}]})
+        assert out["rows"][0]["SampleID"] == f"q{i}"
+    assert b.served == 6
+    m = router.metrics_summary()["counters"]
+    assert m["routed"] == 6
+    assert m.get("retry_429", 0) >= 1        # a first pick hit the
+    assert m["retries"] >= 1                 # saturated one
+
+
+def test_router_conn_refused_marks_down_and_retries(two_fakes):
+    """A killed replica: connection refused → marked down before the
+    next health poll, request retried onto the live peer."""
+    a, b = two_fakes
+    a.stop()                                 # port closed: conn refused
+    router = _router(two_fakes)
+    for i in range(4):
+        out = router.predict({"records": [{"id": f"k{i}"}]})
+        assert out["rows"][0]["SampleID"] == f"k{i}"
+    assert router.states()["r0"] == DOWN
+    assert b.served == 4
+    assert router.metrics_summary()["counters"]["retry_conn"] >= 1
+
+
+def test_router_no_replica_available(two_fakes):
+    router = _router(two_fakes,
+                     policy=RetryPolicy(attempts=2, base_ms=0.1,
+                                        cap_ms=0.2, seed=4))
+    for name in router.names():
+        router.set_state(name, DOWN)
+    with pytest.raises(NoReplicaAvailable):
+        router.predict({"records": [{"id": "x"}]})
+
+
+def test_router_health_poll_transitions(two_fakes):
+    a, b = two_fakes
+    router = _router(two_fakes)
+    assert router.check_health_once() == {"r0": OK, "r1": OK}
+    b.draining = True                        # replica-side drain
+    assert router.check_health_once()["r1"] == DRAINING
+    b.draining = False                       # replica-side undrain:
+    assert router.check_health_once()["r1"] == OK  # poller lifts it
+    router.drain_replica("r0", wait_idle_s=5.0)  # ROUTER-issued drain
+    a.draining = False               # stale 'ok' from the replica...
+    assert router.check_health_once()["r0"] == DRAINING  # intent wins
+    router.undrain_replica("r0")
+    assert router.check_health_once()["r0"] == OK
+    a.stop()
+    assert router.check_health_once()["r0"] == DOWN
+
+
+def test_router_drain_skips_replica_until_undrained(two_fakes):
+    a, b = two_fakes
+    router = _router(two_fakes)
+    router.drain_replica("r0", wait_idle_s=5.0)
+    assert a.draining and router.states()["r0"] == DRAINING
+    for i in range(4):
+        router.predict({"records": [{"id": f"d{i}"}]})
+    assert b.served == 4 and a.served == 0
+    router.undrain_replica("r0")
+    assert not a.draining and router.states()["r0"] == OK
+
+
+def test_router_predict_retries_truncated_response(two_fakes):
+    """A replica that dies after the status line (IncompleteRead — an
+    HTTPException, not an OSError) is retried like conn-refused, not
+    surfaced: predict is idempotent inference."""
+    a, b = two_fakes
+    a.mode = "truncate"
+    router = _router(two_fakes)
+    for i in range(4):
+        out = router.predict({"records": [{"id": f"t{i}"}]})
+        assert out["rows"][0]["SampleID"] == f"t{i}"
+    assert b.served == 4
+    assert router.states()["r0"] == DOWN     # marked on first truncation
+    assert router.metrics_summary()["counters"]["retry_conn"] >= 1
+
+
+def test_router_drain_transport_failure_goes_down_not_stuck(two_fakes):
+    """A drain POST that never reaches the replica must NOT strand it
+    router-side DRAINING (the health poller preserves router intent,
+    so without the rollback it would never recover) — unreachable
+    means DOWN, which the poller lifts on recovery."""
+    a, b = two_fakes
+    router = _router(two_fakes)
+    a.stop()                                 # port closed
+    with pytest.raises((urllib.error.URLError, ConnectionError,
+                        OSError)):
+        router.drain_replica("r0", wait_idle_s=2.0)
+    assert router.states()["r0"] == DOWN     # not stuck DRAINING
+    assert router.check_health_once()["r0"] == DOWN
+
+
+def test_router_drain_idle_timeout_undrains(two_fakes):
+    """If the replica never goes idle within the deadline, the drain
+    is undone — back in rotation beats serving nothing forever."""
+    a, b = two_fakes
+    a.queue_depth = 3                        # never reports idle
+    router = _router(two_fakes)
+    with pytest.raises(TimeoutError):
+        router.drain_replica("r0", wait_idle_s=0.3, poll_s=0.02)
+    assert not a.draining                    # replica-side undone too
+    assert router.states()["r0"] == OK
+
+
+def test_fleet_respawn_args_follow_rolling_reload():
+    """After a rolling reload, restart-on-death must rejoin on the
+    NEW model: _args_with_model strips every launch-time weights
+    source in favor of the reloaded one."""
+    from caffeonspark_tpu.serving.fleet import _args_with_model
+    args = ["-conf", "s.prototxt", "-model", "old.caffemodel",
+            "-features", "ip", "-weights", "w.caffemodel",
+            "-snapshot", "st.solverstate", "-resize"]
+    out = _args_with_model(args, "new.caffemodel")
+    assert out == ["-conf", "s.prototxt", "-features", "ip",
+                   "-resize", "-model", "new.caffemodel"]
+    # idempotent under repeated swaps
+    assert _args_with_model(out, "newer.caffemodel")[-2:] == \
+        ["-model", "newer.caffemodel"]
+
+
+def test_rolling_reload_old_xor_new_under_concurrency(two_fakes):
+    """Rolling hot-swap with concurrent traffic: every response's
+    version is exactly the old or the new one, and the swap ends with
+    every replica on the new version."""
+    router = _router(two_fakes)
+    seen = []
+    errors = []
+    stop_evt = threading.Event()
+
+    def client():
+        while not stop_evt.is_set():
+            try:
+                out = router.predict({"records": [{"id": "c"}]})
+                seen.append(out["model_version"])
+            except Exception as e:    # noqa: BLE001 — fail the test
+                errors.append(e)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)
+    versions = router.rolling_reload("new.caffemodel",
+                                     wait_idle_s=10.0)
+    time.sleep(0.1)
+    stop_evt.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors
+    assert versions == {"r0": 2, "r1": 2}
+    assert set(seen) <= {1, 2} and 2 in set(seen)
+    for f in two_fakes:
+        assert f.reloads == ["new.caffemodel"]
+    # post-swap traffic is new-version only
+    assert router.predict({"records": [{"id": "z"}]}
+                          )["model_version"] == 2
+
+
+def test_router_http_front_end(two_fakes):
+    router = _router(two_fakes)
+    httpd = RouterHTTPServer(router, port=0).start_background()
+    base = f"http://127.0.0.1:{httpd.port}"
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["ok"] and health["status"] == OK
+        assert health["replicas"] == {"r0": OK, "r1": OK}
+        req = urllib.request.Request(
+            base + "/v1/predict",
+            data=json.dumps({"records": [{"id": "h0"}]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert out["rows"][0]["SampleID"] == "h0"
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            m = json.loads(r.read())
+        assert m["counters"]["routed"] == 1
+        assert set(m["replicas"]) == {"r0", "r1"}
+        # all replicas down → aggregate healthz turns 503
+        for name in router.names():
+            router.set_state(name, DOWN)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/healthz", timeout=30)
+        assert ei.value.code == 503
+    finally:
+        httpd.stop()
+
+
+def test_router_lock_witness_stress(two_fakes):
+    """COS005 stress entry: hammer the router's lock/queue
+    interactions (concurrent picks, health transitions, metrics)
+    under the dynamic lock-order witness — any inversion between the
+    replica-table lock and the metrics lock is a latent deadlock."""
+    from caffeonspark_tpu.analysis.runtime import LockWitness
+    router = _router(two_fakes)
+    w = LockWitness()
+    w.witness_attrs(router, "_lock", prefix="Router")
+    w.witness_attrs(router.metrics, "_lock", prefix="PipelineMetrics")
+    router.start_health(interval_s=0.02)
+    errors = []
+
+    def client(i):
+        for j in range(25):
+            try:
+                router.predict({"records": [{"id": f"{i}.{j}"}]})
+            except Exception as e:    # noqa: BLE001 — fail the test
+                errors.append(e)
+
+    def churn():
+        for _ in range(50):
+            router.set_state("r0", DRAINING)
+            router.metrics_summary()
+            router.set_state("r0", OK)
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(8)]
+    threads.append(threading.Thread(target=churn, daemon=True))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    router.stop()
+    assert not errors
+    w.assert_quiet()
+
+
+# ------------------------------------------- replica HTTP satellites
+
+def test_healthz_draining_and_drain_route(tiny_model):
+    """/healthz distinguishes ok/draining (the router's routability
+    signal); /v1/drain toggles it; a draining replica 503s new
+    predicts; /metrics exposes live queue depth + per-bucket flush
+    counts."""
+    svc = _service(tiny_model, max_batch=4, max_wait_ms=5)
+    svc.start(warmup=False)
+    httpd = ServingHTTPServer(svc, port=0).start_background()
+    base = f"http://127.0.0.1:{httpd.port}"
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            h = json.loads(r.read())
+        assert h["ok"] and h["status"] == "ok"
+        assert "queue_depth" in h
+
+        out = post("/v1/predict", {"records": [_dict_record()]})
+        assert len(out["rows"]) == 1
+
+        assert post("/v1/drain", {"drain": True})["status"] == \
+            "draining"
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            h = json.loads(r.read())
+        assert h["status"] == "draining" and not h["ok"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/v1/predict", {"records": [_dict_record()]})
+        assert ei.value.code == 503
+
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            m = json.loads(r.read())
+        assert m["status"] == "draining"
+        assert m["queue_depth_now"] == 0
+        assert m["counters"]["flush_bucket_1"] == 1
+
+        post("/v1/drain", {"drain": False})
+        out = post("/v1/predict", {"records": [_dict_record(1)]})
+        assert out["rows"][0]["SampleID"] == "r1"
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/v1/drain", {"drain": "yes"})
+        assert ei.value.code == 400
+    finally:
+        httpd.stop()
+        svc.stop()
+
+
+def test_service_draining_rejects_submit(tiny_model):
+    svc = _service(tiny_model, max_batch=2, max_wait_ms=1)
+    svc.start(warmup=False)
+    try:
+        svc.set_draining(True)
+        with pytest.raises(ServingStopped):
+            svc.submit(_records(1)[0])
+        with pytest.raises(ServingStopped):
+            svc.submit_many(_records(2))
+        svc.set_draining(False)
+        assert Client(svc).predict_one(_records(1)[0])
+    finally:
+        svc.stop()
+
+
+def test_serve_replicas_knobs(monkeypatch):
+    monkeypatch.delenv("COS_SERVE_REPLICAS", raising=False)
+    assert serve_replicas() == 1
+    monkeypatch.setenv("COS_SERVE_REPLICAS", "3")
+    assert serve_replicas() == 3
+    monkeypatch.setenv("COS_SERVE_REPLICAS", "junk")
+    assert serve_replicas() == 1
+    conf = Config(["-serve", "-serveReplicas", "4"])
+    assert conf.serveReplicas == 4
+
+
+# ----------------------------------------------------- AOT warm start
+
+def test_aot_cache_key_and_resolution(monkeypatch, tmp_path):
+    k1 = aot.aot_cache_key("netA", (1, 2, 4), ("ip",))
+    assert k1 == aot.aot_cache_key("netA", (1, 2, 4), ("ip",))
+    assert k1 != aot.aot_cache_key("netB", (1, 2, 4), ("ip",))
+    assert k1 != aot.aot_cache_key("netA", (1, 2), ("ip",))
+    assert k1 != aot.aot_cache_key("netA", (1, 2, 4), ("loss",))
+    monkeypatch.delenv("COS_AOT_CACHE_DIR", raising=False)
+    assert aot.resolve_cache_dir("netA", (1,), ("ip",)) is None
+    monkeypatch.setenv("COS_AOT_CACHE_DIR", str(tmp_path))
+    d = aot.resolve_cache_dir("netA", (1,), ("ip",))
+    assert d is not None and d.startswith(str(tmp_path))
+    assert aot.cache_entries(str(tmp_path / "missing")) == 0
+
+
+def test_aot_warm_start_second_service_cache_hits(
+        tiny_model, tmp_path, monkeypatch, recompile_guard):
+    """AOT acceptance, in one process: service 1 populates the
+    persistent cache during warmup; a SECOND service over the same
+    net/buckets warms with zero new cache entries (every program
+    deserialized — the timing-free cache-hit proof) and serves with
+    zero steady-state recompiles under the guard."""
+    import jax
+    monkeypatch.setenv("COS_AOT_CACHE_DIR", str(tmp_path / "aot"))
+    old_dir = jax.config.jax_compilation_cache_dir
+    try:
+        svc1 = _service(tiny_model, max_batch=4, max_wait_ms=5)
+        svc1.start(warmup=True)
+        m1 = svc1.metrics_summary()
+        svc1.stop()
+        d = m1["aot_cache_dir"]
+        assert m1["warmup_s"] > 0
+        n_cold = aot.cache_entries(d)
+        assert n_cold >= len(svc1.batcher.buckets)
+
+        svc2 = _service(tiny_model, max_batch=4, max_wait_ms=5)
+        svc2.start(warmup=True)
+        try:
+            assert aot.cache_entries(d) == n_cold   # all cache hits
+            recompile_guard.watch(
+                "serving.forward",
+                svc2.registry.forward(svc2.blob_names))
+            recompile_guard.mark_steady()
+            rows = Client(svc2).predict(_records(6, seed=30))
+            assert len(rows) == 6
+            recompile_guard.check()          # no steady recompiles
+            assert svc2.metrics_summary()["warmup_s"] > 0
+        finally:
+            svc2.stop()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
+
+
+# ------------------------------------------------- fleet e2e (slow)
+
+def _constant_model(tmp_path, solver_path, net_path, bias, name):
+    """Zero weights + constant ip bias → serving 'ip' returns exactly
+    [bias]*10, making versions distinguishable byte-for-byte."""
+    import jax.numpy as jnp
+    s = Solver(SolverParameter.from_text(open(solver_path).read()),
+               NetParameter.from_text(open(net_path).read()))
+    params, _ = s.init()
+    zeroed = {ln: {bn: jnp.zeros_like(a) for bn, a in bl.items()}
+              for ln, bl in params.items()}
+    zeroed["ip"]["bias"] = jnp.full_like(params["ip"]["bias"], bias)
+    path = str(tmp_path / name)
+    checkpoint.save_caffemodel(path, s.train_net, zeroed)
+    return path
+
+
+@pytest.fixture(scope="module")
+def fleet_models(tmp_path_factory):
+    td = tmp_path_factory.mktemp("fleet")
+    net_path = td / "net.prototxt"
+    net_path.write_text(NET_TMPL.format(root=td))
+    solver_path = td / "solver.prototxt"
+    solver_path.write_text(SOLVER_TMPL.format(net=net_path))
+    model_a = _constant_model(td, solver_path, net_path, 0.0,
+                              "a.caffemodel")
+    model_b = _constant_model(td, solver_path, net_path, 1.0,
+                              "b.caffemodel")
+    return str(solver_path), model_a, model_b
+
+
+def _fleet_env(aot_dir):
+    return {"JAX_PLATFORMS": "cpu",
+            "COS_AOT_CACHE_DIR": aot_dir,
+            "COS_SERVE_MAX_BATCH": "4",
+            "COS_SERVE_MAX_WAIT_MS": "2",
+            "COS_RECOMPILE_GUARD": "1"}
+
+
+@pytest.mark.slow
+def test_fleet_kill_under_load_zero_failures_warm_rejoin(
+        fleet_models, tmp_path):
+    """Fault injection: SIGKILL one replica under offered load —
+    router retries absorb it (zero client-visible failures) and the
+    monitor restarts it WARM: its warmup adds zero entries to the
+    shared AOT cache (pure cache hits), with the in-replica recompile
+    guard (COS_RECOMPILE_GUARD=1) armed throughout."""
+    solver_path, model_a, _ = fleet_models
+    aot_dir = str(tmp_path / "aot")
+    fleet = Fleet(["-conf", solver_path, "-model", model_a,
+                   "-features", "ip"],
+                  replicas=2, env=_fleet_env(aot_dir),
+                  poll_interval_s=0.1)
+    fleet.start()
+    try:
+        ns = os.listdir(aot_dir)
+        assert len(ns) == 1                  # one namespace: same net
+        cache = os.path.join(aot_dir, ns[0])
+        n_warm = aot.cache_entries(cache)
+        assert n_warm >= 3                   # buckets 1/2/4 compiled
+
+        errors = []
+        counts = [0] * 4
+        stop_evt = threading.Event()
+        rec = _dict_record()
+
+        def client(i):
+            while not stop_evt.is_set():
+                try:
+                    out = fleet.router.predict({"records": [rec]})
+                    assert out["rows"][0]["ip"] == [0.0] * 10
+                    counts[i] += 1
+                except Exception as e:  # noqa: BLE001 — count them
+                    errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True) for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.8)
+        fleet.kill_replica("replica0")       # fault injection
+        time.sleep(2.0)
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors                    # retries absorbed the kill
+        assert sum(counts) > 20
+
+        deadline = time.monotonic() + 120
+        while fleet.router.states()["replica0"] != OK \
+                and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert fleet.router.states()["replica0"] == OK
+        assert fleet.restarts() == 1
+        # warm rejoin: the restarted replica compiled NOTHING fresh
+        assert aot.cache_entries(cache) == n_warm
+        out = fleet.router.predict({"records": [rec]})
+        assert out["rows"][0]["ip"] == [0.0] * 10
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.slow
+def test_fleet_rolling_hot_swap_old_xor_new_fleet_wide(
+        fleet_models, tmp_path):
+    """Rolling hot-swap under concurrent load: every response across
+    the whole fleet is exactly the old model's output or the new
+    model's — never a third thing, never mixed — and the swap ends
+    with the fleet fully on the new version."""
+    solver_path, model_a, model_b = fleet_models
+    fleet = Fleet(["-conf", solver_path, "-model", model_a,
+                   "-features", "ip"],
+                  replicas=2,
+                  env=_fleet_env(str(tmp_path / "aot")),
+                  poll_interval_s=0.1)
+    fleet.start()
+    try:
+        old, new = tuple([0.0] * 10), tuple([1.0] * 10)
+        seen = []
+        errors = []
+        stop_evt = threading.Event()
+        rec = _dict_record()
+
+        def client():
+            while not stop_evt.is_set():
+                try:
+                    out = fleet.router.predict({"records": [rec]})
+                    seen.append(tuple(out["rows"][0]["ip"]))
+                except Exception as e:  # noqa: BLE001 — count them
+                    errors.append(e)
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        versions = fleet.rolling_reload(model_b)
+        time.sleep(0.5)
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors
+        assert versions == {"replica0": 2, "replica1": 2}
+        assert set(seen) <= {old, new}       # old-xor-new, fleet-wide
+        assert new in set(seen)
+        out = fleet.router.predict({"records": [rec]})
+        assert tuple(out["rows"][0]["ip"]) == new
+        # a post-swap death must rejoin on the NEW model
+        for rep in fleet.replicas.values():
+            i = rep.serve_args.index("-model")
+            assert rep.serve_args[i + 1] == model_b
+    finally:
+        fleet.stop()
