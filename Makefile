@@ -8,7 +8,8 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 
 .PHONY: build native install lint test test-slow spark-test bench \
   smoke tpu-tests bench-evidence bench-ingest bench-steploop \
-  bench-serving bench-gradsync onchip-artifacts docs clean
+  bench-serving bench-serving-sharded bench-gradsync onchip-artifacts \
+  docs clean
 
 build: native install
 
@@ -92,6 +93,16 @@ bench-serving-fleet:
 	mkdir -p bench_evidence
 	$(CPU_ENV) $(PY) scripts/bench_serving.py --fleet 2 \
 	  --out bench_evidence/bench_serving_fleet.json
+
+# sharded serving: hot-swap wall time + peak host RSS under a tp=2
+# mesh — zero-gather shard streaming vs the host-gather baseline
+# (dense-host path poisoned in the streamed worker, so the artifact
+# re-proves no full-size host buffer); ALWAYS exits 0 with one JSON
+# document on stdout (bench.py contract)
+bench-serving-sharded:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_serving.py --tp 2 \
+	  --out bench_evidence/bench_serving_sharded.json
 
 smoke:
 	BENCH_SMOKE=1 $(PY) bench.py

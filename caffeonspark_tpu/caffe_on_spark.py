@@ -413,6 +413,9 @@ def serve_fleet_main(conf: Config, replicas: int) -> int:
         serve_args += ["-label", conf.label]
     if getattr(conf, "resize", False):
         serve_args += ["-resize"]
+    # sharded serving: each replica builds the same mesh layout
+    if getattr(conf, "serveMesh", ""):
+        serve_args += ["-serveMesh", conf.serveMesh]
     fleet = Fleet(serve_args, replicas)
     fleet.start()
     try:

@@ -130,18 +130,90 @@ def save_caffemodel(path: str, net: Net, params: Params) -> None:
     fsutils.write_bytes(path, params_to_net_param(net, params).to_binary())
 
 
-def load_caffemodel_blobs(path: str) -> Dict[str, list]:
-    """caffemodel → {layer_name: [np arrays]} (unmatched layers kept).
-    Reads both the modern `layer` field and the deprecated V1 `layers`
-    field, so published legacy models (original bvlc_reference zoo)
-    import directly."""
+def _is_marker(bp: BlobProto) -> bool:
+    """Shape-only blob (sharded sidecar marker): shape recorded, data
+    absent — the same convention the sharded .solverstate uses."""
+    return bool(bp.shape.dim) and not len(bp.data) \
+        and not len(bp.double_data)
+
+
+class _PrefixSlabs:
+    """One blob's bounds-keyed view over a lazy slab mapping: keys are
+    iterable without I/O, values decompress on access."""
+
+    def __init__(self, slabs, keymap: Dict[str, str]):
+        self._slabs = slabs
+        self._keymap = keymap
+
+    def __iter__(self):
+        return iter(self._keymap)
+
+    def __len__(self):
+        return len(self._keymap)
+
+    def keys(self):
+        return self._keymap.keys()
+
+    def items(self):
+        return ((k, self._slabs[v]) for k, v in self._keymap.items())
+
+    def __getitem__(self, k) -> np.ndarray:
+        return self._slabs[self._keymap[k]]
+
+
+def _param_blob_values(path: str) -> Dict[str, list]:
+    """caffemodel → {layer_name: [np.ndarray | ShardedHostBlob]}.
+
+    Dense blobs come back as arrays; shape-only markers resolve to
+    `ShardedHostBlob`s backed by the `<path>.shard<k>` sidecar slabs
+    (global blob index = file traversal order, matching
+    save_sharded_caffemodel's writer).  This is the parse layer both
+    the dense loader (assembles on host — the gather baseline) and
+    the mesh loader (streams slabs per destination shard — never a
+    full-size host buffer) share."""
     npm = NetParameter.from_binary(fsutils.read_bytes(path))
-    out = {lp.name: [_from_blobproto(bp) for bp in lp.blobs]
-           for lp in npm.layer if lp.blobs}
+    has_markers = any(_is_marker(bp) for lp in npm.layer
+                      for bp in lp.blobs)
+    slabs = _open_sidecar_slabs(path) if has_markers else {}
+    out: Dict[str, list] = {}
+    i = 0
+    for lp in npm.layer:
+        vals = []
+        for bp in lp.blobs:
+            if _is_marker(bp):
+                shape = tuple(int(d) for d in bp.shape.dim)
+                prefix = f"b{i}__"
+                shards = _PrefixSlabs(
+                    slabs, {k[len(prefix):]: k for k in slabs
+                            if k.startswith(prefix)})
+                if not len(shards):
+                    raise ValueError(
+                        f"{path}: sharded-model marker for blob {i} "
+                        f"({lp.name}) has no sidecar slabs")
+                vals.append(ShardedHostBlob(shape, shards))
+            else:
+                vals.append(_from_blobproto(bp))
+            i += 1
+        if vals:
+            out[lp.name] = vals
     for lp in npm.layers:            # V1 legacy
         if lp.blobs and lp.name not in out:
             out[lp.name] = [_from_blobproto(bp) for bp in lp.blobs]
     return out
+
+
+def load_caffemodel_blobs(path: str) -> Dict[str, list]:
+    """caffemodel → {layer_name: [np arrays]} (unmatched layers kept).
+    Reads both the modern `layer` field and the deprecated V1 `layers`
+    field, so published legacy models (original bvlc_reference zoo)
+    import directly.  A sharded model (shape-only markers + sidecars)
+    is assembled DENSE on the host here — this is the gather baseline;
+    the mesh serving path streams instead (load_serving_params with a
+    layout)."""
+    out = _param_blob_values(path)
+    return {ln: [_assemble_host_blob(v) if isinstance(v, ShardedHostBlob)
+                 else v for v in vals]
+            for ln, vals in out.items()}
 
 
 def copy_layers(net: Net, params: Params, weights_path: str, *,
@@ -218,19 +290,210 @@ def _resolve_learned_net(state_path: str) -> str:
 
 
 def load_serving_params(net: Net, model_path: str, *,
-                        strict: bool = False) -> Params:
-    """Snapshot → dense inference params WITHOUT an optimizer or a
-    training run (the serving registry's loader): filler-init the net,
-    then copy_layers from the snapshot (finetune semantics — layers
-    absent from the file keep their init, exactly like -weights).
-    Accepts .caffemodel[.h5] directly; a .solverstate[.h5] resolves
-    its learned_net pointer first."""
+                        strict: bool = False, layout=None) -> Params:
+    """Snapshot → inference params WITHOUT an optimizer or a training
+    run (the serving registry's loader).  Accepts .caffemodel[.h5]
+    directly; a .solverstate[.h5] resolves its learned_net pointer
+    first.
+
+    Dense path (layout=None): filler-init the net, then copy_layers
+    from the snapshot (finetune semantics — layers absent from the
+    file keep their init, exactly like -weights).
+
+    Mesh path (layout = a MeshLayout): ZERO-GATHER STREAMING — each
+    param blob goes from the file straight to its destination devices
+    shard by shard (`jax.make_array_from_callback` over the layout's
+    NamedSharding: dense blobs device_put per-shard VIEWS, sharded
+    sidecar blobs assemble at most one shard-sized buffer at a time).
+    The dense-host export helpers (`gather_params_if_sharded`,
+    `_dense_host_param`) are never touched and no full-size f32 host
+    copy of a sharded blob is materialized, so hot-swap wall time and
+    peak host RSS scale with 1/N instead of with model size
+    (tests/test_serving_sharded.py pins this by making the dense-host
+    path raise)."""
     import jax
     path = model_path
     if ".solverstate" in fsutils.basename(path):
         path = _resolve_learned_net(path)
-    params = net.init(jax.random.key(0))
-    return copy_layers(net, params, path, strict=strict)
+    if layout is None:
+        params = net.init(jax.random.key(0))
+        return copy_layers(net, params, path, strict=strict)
+    if path.endswith(".h5"):
+        # the h5 container has no shard sidecar format: dense load,
+        # then place on the mesh (a gather-free put — the file is
+        # already a dense host representation)
+        params = net.init(jax.random.key(0))
+        params = copy_layers(net, params, path, strict=strict)
+        return layout.place_params(params)
+    return _load_serving_params_streamed(net, path, layout,
+                                         strict=strict)
+
+
+def _parse_bounds(key: str, shape) -> Tuple[slice, ...]:
+    """'start-stop[_start-stop...]' (the sidecar slab key) → slices."""
+    return tuple(slice(int(a), int(b)) for a, b in
+                 (part.split("-") for part in key.split("_")))
+
+
+def _slice_from_slabs(shape, slabs, idx) -> np.ndarray:
+    """Materialize ONE shard slice of a blob from the sidecar slabs —
+    the only host buffer the streamed load path allocates, sized
+    1/N of the blob, never the full shape.  Slab DATA is fetched only
+    for keys whose bounds intersect the requested shard (`slabs` may
+    be a lazy mapping), so non-intersecting slabs cost a key parse,
+    not a read."""
+    idx = tuple(slice(s.start or 0, s.stop if s.stop is not None else d)
+                for s, d in zip(idx, shape))
+    tgt_shape = tuple(s.stop - s.start for s in idx)
+    out = np.zeros(tgt_shape, np.float32)
+    covered = np.zeros(tgt_shape, bool)
+    for key in slabs:
+        bounds = _parse_bounds(key, shape)
+        inter = []
+        for t, b in zip(idx, bounds):
+            lo, hi = max(t.start, b.start), min(t.stop, b.stop)
+            if lo >= hi:
+                inter = None
+                break
+            inter.append((lo, hi))
+        if inter is None:
+            continue
+        dst = tuple(slice(lo - t.start, hi - t.start)
+                    for (lo, hi), t in zip(inter, idx))
+        src = tuple(slice(lo - b.start, hi - b.start)
+                    for (lo, hi), b in zip(inter, bounds))
+        out[dst] = slabs[key][src]
+        covered[dst] = True
+    if not covered.all():
+        raise ValueError(
+            f"sharded blob (shape {shape}): sidecar slabs cover only "
+            f"{covered.mean():.0%} of slice {idx} — a host's shard "
+            "file is missing")
+    return out
+
+
+def _assemble_host_blob(v: "ShardedHostBlob") -> np.ndarray:
+    """Dense host assembly of a sharded model blob (the GATHER
+    baseline the streamed path exists to avoid)."""
+    return _slice_from_slabs(
+        v.shape, v.shards,
+        tuple(slice(0, d) for d in v.shape))
+
+
+def _device_put_streamed(value, sharding) -> jax.Array:
+    """One blob, file representation → mesh placement, shard by shard.
+    `jax.make_array_from_callback` asks for each addressable shard's
+    index and device_puts the returned buffer straight to that device:
+    dense arrays hand back VIEWS (no copy), sharded sidecar blobs
+    assemble one shard-sized buffer per UNIQUE index — the callback is
+    invoked once per device, and replicated copies of the same shard
+    (dp replicas of a tp shard) must not re-read/re-assemble the
+    slabs, so assembled slices are memoized for the duration of this
+    one blob's placement (peak host footprint: the unique shards of
+    ONE blob, still never the whole model)."""
+    if isinstance(value, ShardedHostBlob):
+        shape, slabs = value.shape, value.shards
+        memo: Dict[tuple, np.ndarray] = {}
+
+        def cb(idx):
+            key = tuple(
+                (s.start or 0, s.stop if s.stop is not None else d)
+                for s, d in zip(idx, shape))
+            if key not in memo:
+                memo[key] = _slice_from_slabs(shape, slabs, idx)
+            return memo[key]
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+    arr = np.ascontiguousarray(value, np.float32)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+def _load_serving_params_streamed(net: Net, path: str, layout, *,
+                                  strict: bool = False) -> Params:
+    """The mesh body of load_serving_params: copy_layers semantics
+    (match by layer name + blob position, shape-checked, filler init
+    for absent layers) with per-shard streaming placement."""
+    import jax
+    values = _param_blob_values(path)
+    out: Params = {}
+    init_params = None
+    copied = 0
+    for lname, specs in net.param_layout.items():
+        out[lname] = {}
+        blobs = values.get(lname)
+        for i, (bname, shape, _) in enumerate(specs):
+            sh = layout.param_sharding[lname][bname]
+            v = blobs[i] if blobs is not None and i < len(blobs) \
+                else None
+            if isinstance(v, np.ndarray) \
+                    and tuple(v.shape) != tuple(shape):
+                if v.size == int(np.prod(shape)):
+                    v = v.reshape(shape)     # legacy 4D blobs
+                elif strict:
+                    raise ValueError(
+                        f"{lname}/{bname}: shape {v.shape} != {shape}")
+                else:
+                    v = None
+            elif isinstance(v, ShardedHostBlob) \
+                    and v.shape != tuple(shape):
+                raise ValueError(
+                    f"{lname}/{bname}: sharded blob shape {v.shape} "
+                    f"!= net {shape}")
+            if v is None:
+                if strict and blobs is None:
+                    raise ValueError(
+                        f"layer {lname!r} missing from {path}")
+                if init_params is None:
+                    init_params = net.init(jax.random.key(0))
+                out[lname][bname] = jax.device_put(
+                    init_params[lname][bname], sh)
+                continue
+            out[lname][bname] = _device_put_streamed(v, sh)
+            copied += 1
+    if copied == 0:
+        raise ValueError(f"no blobs matched from {path}")
+    return out
+
+
+def save_sharded_caffemodel(path: str, net: Net, params: Params, *,
+                            force_shards: bool = False,
+                            write_main: bool = True) -> str:
+    """Write a .caffemodel whose partitioned blobs live in per-process
+    `<path>.shard<k>` sidecars (the model-file analog of the sharded
+    .solverstate): the main file carries shape-only markers for them,
+    dense BlobProtos for replicated blobs.  No collective, no host
+    gather — each process writes only its addressable shards, so a
+    multi-host tp/ep run snapshots its model without ever owning the
+    dense parameter set.  `force_shards` routes every blob through the
+    sidecar (single-process tests/bench exercise the exact multi-host
+    format).  The serving mesh loader streams these sidecars shard by
+    shard; `load_caffemodel_blobs` assembles them dense for the
+    classic import path."""
+    out = NetParameter(name=net.name)
+    slabs: Dict[str, np.ndarray] = {}
+    i = 0
+    for lp in net.compute_layers:
+        copy = LayerParameter(name=lp.name, type=lp.type)
+        if lp.name in net.param_layout:
+            blobs = params[lp.name]
+            for bname, _, _ in net.param_layout[lp.name]:
+                h = host_state_blob(blobs[bname],
+                                    force_shards=force_shards)
+                if isinstance(h, ShardedHostBlob):
+                    copy.blobs.append(
+                        BlobProto(shape=BlobShape(dim=list(h.shape))))
+                    for key, arr in h.shards.items():
+                        slabs[f"b{i}__{key}"] = arr
+                else:
+                    copy.blobs.append(_to_blobproto(h))
+                i += 1
+        out.layer.append(copy)
+    if write_main:
+        fsutils.write_bytes(path, out.to_binary())
+    if slabs:
+        _write_slabs(slabs, path)
+    return path
 
 
 # ---------------------------------------------------------------------------
@@ -360,28 +623,64 @@ def _shard_sidecar_path(state_path: str) -> str:
 _SIDECAR_META = "__meta_nprocs__"
 
 
-def _load_state_shards(state_path: str) -> Dict[str, np.ndarray]:
+class _LazySlabs:
+    """Mapping over every sidecar's slabs that decompresses an npz
+    member only when ACCESSED (np.savez writes an uncompressed zip — a
+    member read is one seek + one read).  The streamed model loader
+    iterates KEYS to find the slabs a destination shard intersects and
+    fetches only those, so its peak host footprint is shard-sized, not
+    model-sized."""
+
+    def __init__(self, handles):
+        self._where: Dict[str, object] = {}
+        for z in handles:
+            for k in z.files:
+                if k != _SIDECAR_META:
+                    self._where[k] = z
+
+    def __iter__(self):
+        return iter(self._where)
+
+    def __len__(self):
+        return len(self._where)
+
+    def __contains__(self, k):
+        return k in self._where
+
+    def keys(self):
+        return self._where.keys()
+
+    def __getitem__(self, k) -> np.ndarray:
+        return self._where[k][k]
+
+
+def _open_sidecar_slabs(state_path: str) -> _LazySlabs:
+    """Open every `<path>.shard<k>` sidecar (generation-checked) as a
+    lazy slab mapping.  Local files stay on disk until a slab is
+    read; remote files are buffered (fsspec streams have no cheap
+    member seek)."""
     import io
     import re
     d = fsutils.dirname(state_path)
     base = fsutils.basename(state_path) + ".shard"
     pat = re.compile(re.escape(base) + r"\d+$")   # excludes .tmp.* etc
-    merged: Dict[str, np.ndarray] = {}
     names = [n for n in fsutils.listdir(d) if pat.fullmatch(n)]
     if not names:
         raise FileNotFoundError(
-            f"{state_path}: solverstate has sharded-state markers but "
-            f"no {base}* sidecars exist — snapshot written with a "
+            f"{state_path}: file has sharded-blob markers but no "
+            f"{base}* sidecars exist — snapshot written with a "
             "non-shared output dir, or the sidecar writes were lost")
+    handles = []
     nprocs = set()
     for n in sorted(names):
-        blob = fsutils.read_bytes(fsutils.join(d, n))
-        with np.load(io.BytesIO(blob)) as z:
-            for k in z.files:
-                if k == _SIDECAR_META:
-                    nprocs.add(int(z[k]))
-                else:
-                    merged[k] = z[k]
+        p = fsutils.join(d, n)
+        if fsutils.is_remote(p):
+            z = np.load(io.BytesIO(fsutils.read_bytes(p)))
+        else:
+            z = np.load(fsutils.strip_local(p))
+        if _SIDECAR_META in z.files:
+            nprocs.add(int(z[_SIDECAR_META]))
+        handles.append(z)
     # generation check: stale sidecars from an earlier run with a
     # different process count in the same output dir would otherwise
     # merge SILENTLY into corrupted state (the coverage check cannot
@@ -392,27 +691,30 @@ def _load_state_shards(state_path: str) -> Dict[str, np.ndarray]:
             f"({len(names)} files, declared process counts "
             f"{sorted(nprocs)}) — clean stale .shard* files from the "
             "output dir and re-snapshot")
-    return merged
+    return _LazySlabs(handles)
+
+
+def _load_state_shards(state_path: str) -> Dict[str, np.ndarray]:
+    """Eager merged slab dict — state restore reassembles every blob
+    anyway, so there is nothing to stream."""
+    lazy = _open_sidecar_slabs(state_path)
+    return {k: lazy[k] for k in lazy}
 
 
 def _assemble_blob(idx: int, shape, shards: Dict[str, np.ndarray]
                    ) -> np.ndarray:
-    out = np.zeros(shape, np.float32)
-    covered = np.zeros(shape, bool)
+    """Dense assembly of state blob `idx` from the merged sidecar
+    slabs — one slab-key grammar, one assembler: this is the
+    full-blob special case of the per-shard `_slice_from_slabs` the
+    streamed model loader uses."""
     prefix = f"b{idx}__"
-    for key, arr in shards.items():
-        if not key.startswith(prefix):
-            continue
-        sl = tuple(slice(int(a), int(b)) for a, b in
-                   (part.split("-") for part in
-                    key[len(prefix):].split("_")))
-        out[sl] = arr
-        covered[sl] = True
-    if not covered.all():
-        raise ValueError(
-            f"state blob {idx} (shape {shape}): sidecars cover only "
-            f"{covered.mean():.0%} — a host's shard file is missing")
-    return out
+    view = _PrefixSlabs(shards, {k[len(prefix):]: k for k in shards
+                                 if k.startswith(prefix)})
+    try:
+        return _slice_from_slabs(shape, view,
+                                 tuple(slice(0, d) for d in shape))
+    except ValueError as e:
+        raise ValueError(f"state blob {idx}: {e}") from None
 
 
 def _state_blob_seq(net: Net, opt_state: OptState, solver_type: str):

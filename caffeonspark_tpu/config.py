@@ -89,6 +89,12 @@ def build_argparser() -> argparse.ArgumentParser:
     a("-serveHost", dest="serveHost", default="127.0.0.1",
       help="serving bind address (loopback by default; the unauth'd "
            "/v1/reload endpoint makes wider binds an explicit opt-in)")
+    a("-serveMesh", dest="serveMesh", default="",
+      help="serving mesh spec dp[,tp[,sp[,ep]]] (same grammar as "
+           "-mesh): mesh-parallel forward with params tp/ep-sharded "
+           "and the batch dp-sharded, serving nets bigger than one "
+           "device; env equivalents COS_SERVE_MESH (same spec) and "
+           "COS_SERVE_TP=N (tp-only shorthand)")
     a("-serveReplicas", dest="serveReplicas", type=int, default=0,
       help="fleet mode: N replica serving processes behind a "
            "least-outstanding router with retry + rolling hot-swap "

@@ -12,7 +12,8 @@ COS_GRAD_SYNC).
 
 from .dp import ParallelSolver, tp_param_specs
 from .gradsync import GradSync, GradSyncPlan, build_plan, make_gradsync
-from .mesh import (build_mesh, data_sharding, distributed_init,
-                   dp_data_rank, lockstep_steps, replicated)
+from .mesh import (MeshLayout, build_mesh, data_sharding,
+                   distributed_init, dp_data_rank, lockstep_steps,
+                   parse_mesh_spec, replicated)
 from .pp import PipelineSolver, partition_layers
 from .sp import attention, ring_attention, sp_shard_time

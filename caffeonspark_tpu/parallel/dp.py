@@ -34,46 +34,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..net import Net
 from ..solver import OptState, Solver
-from .mesh import replicated
+from .mesh import (MeshLayout, TP_MIN_FEATURES, replicated,  # noqa: F401
+                   tp_param_specs)
+
+# tp_param_specs/TP_MIN_FEATURES moved to mesh.py (MeshLayout is the
+# one spec-construction path, shared with serving); re-exported here
+# for the historical import site.
 
 Array = jax.Array
-
-TP_MIN_FEATURES = 1024  # shard only matmuls big enough to matter
-
-
-def tp_param_specs(net: Net, *, min_features: int = TP_MIN_FEATURES
-                   ) -> Dict[str, Dict[str, P]]:
-    """PartitionSpec per param blob: column-shard large IP/Embed weights
-    over 'tp', replicate the rest."""
-    specs: Dict[str, Dict[str, P]] = {}
-    by_name = {lp.name: lp for lp in net.compute_layers}
-    for lname, blobs in net.param_layout.items():
-        lp = by_name[lname]
-        specs[lname] = {}
-        for bname, shape, _ in blobs:
-            spec = P()
-            if lp.type == "InnerProduct" and bname == "weight":
-                ipp = lp.inner_product_param
-                n_out = int(ipp.num_output)
-                if n_out >= min_features and not ipp.transpose:
-                    spec = P("tp", None)     # (num_output, K) column split
-                elif n_out >= min_features:
-                    spec = P(None, "tp")
-            elif lp.type == "InnerProduct" and bname == "bias":
-                if int(lp.inner_product_param.num_output) >= min_features:
-                    spec = P("tp")
-            elif lp.type == "Embed" and bname == "weight":
-                if int(lp.embed_param.num_output) >= min_features:
-                    spec = P(None, "tp")     # (vocab, dim) dim split
-            elif lp.type in ("LSTM", "RNN") and bname.startswith("W_x"):
-                rp = lp.recurrent_param
-                if int(rp.num_output) * 4 >= min_features:
-                    spec = P("tp", None)     # (4N, D) gate split
-            elif lp.type == "MixtureOfExperts" and bname in ("W1",
-                                                             "W2"):
-                spec = P("ep", None, None)   # expert-dim split
-            specs[lname][bname] = spec
-    return specs
 
 
 ZERO_MIN_NUMEL = 16384  # shard only state blobs big enough to matter
@@ -128,36 +96,19 @@ class ParallelSolver:
         import os
         self.solver = solver
         self.mesh = mesh
-        self.tp_on = tensor_parallel and (
-            mesh.shape.get("tp", 1) > 1 or mesh.shape.get("ep", 1) > 1)
+        # spec construction is shared with serving (mesh.MeshLayout):
+        # same tp/ep layouts, same divisibility guard — the training
+        # step and the serving forward can never disagree on where a
+        # blob's shards live
+        self.layout = MeshLayout(solver.train_net, mesh,
+                                 tensor_parallel=tensor_parallel)
+        self.tp_on = self.layout.tp_on
         if zero_dp is None:
             zero_dp = os.environ.get("COS_ZERO") == "1"
         self.zero_on = bool(zero_dp) and mesh.shape.get("dp", 1) > 1
-        net = solver.train_net
-        self.param_specs = (tp_param_specs(net) if self.tp_on else
-                            {ln: {bn: P() for bn, _, _ in blobs}
-                             for ln, blobs in net.param_layout.items()})
-        # divisibility guard: every sharded param dim must divide by its
-        # mesh axis (an opaque XLA partition error otherwise)
-        shapes = {ln: {bn: s for bn, s, _ in blobs}
-                  for ln, blobs in net.param_layout.items()}
-        for ln, blobs in self.param_specs.items():
-            for bn, spec in blobs.items():
-                for dim_i, ax in enumerate(spec):
-                    if ax is None:
-                        continue
-                    size = mesh.shape.get(ax, 1)
-                    dim = shapes[ln][bn][dim_i]
-                    if size > 1 and dim % size != 0:
-                        raise ValueError(
-                            f"layer {ln!r} blob {bn!r}: dim {dim_i} "
-                            f"(size {dim}) not divisible by mesh axis "
-                            f"{ax!r} (size {size}) — adjust "
-                            f"num_experts/num_output or the mesh")
-        self.param_sharding = {
-            ln: {bn: NamedSharding(mesh, spec)
-                 for bn, spec in blobs.items()}
-            for ln, blobs in self.param_specs.items()}
+        self.param_specs = self.layout.param_specs
+        shapes = self.layout.shapes
+        self.param_sharding = self.layout.param_sharding
         if self.zero_on:
             self.state_specs = zero_state_specs(
                 self.param_specs, shapes, mesh.shape.get("dp", 1))
@@ -187,9 +138,7 @@ class ParallelSolver:
 
     # ------------------------------------------------------------------
     def shard_params(self, params) -> Dict:
-        return {ln: {bn: jax.device_put(arr, self.param_sharding[ln][bn])
-                     for bn, arr in blobs.items()}
-                for ln, blobs in params.items()}
+        return self.layout.place_params(params)
 
     def shard_opt_state(self, st: OptState) -> OptState:
         hist = {ln: {bn: jax.device_put(arr, self.state_sharding[ln][bn])
@@ -202,24 +151,12 @@ class ParallelSolver:
                         history=hist, history2=hist2)
 
     def _input_specs(self, net: Optional[Net] = None) -> Dict[str, P]:
-        """Per-input PartitionSpec: batch sharded over dp; time-major
-        (T, B, ·) tops shard batch on axis 1 and — when the mesh has an
-        sp axis — their TIME axis over sp (sequence parallelism:
-        attention/scan math under GSPMD partitions along T; see
-        examples/long_context.py)."""
-        net = net or self.solver.train_net
-        has_sp = dict(self.mesh.shape).get("sp", 1) > 1
-        out = {}
-        for name, shape, kind in net.input_specs:
-            if kind.endswith(":T"):
-                out[name] = P("sp", "dp") if has_sp else P(None, "dp")
-            else:
-                out[name] = P("dp")
-        return out
+        """Per-input PartitionSpec — shared construction (MeshLayout):
+        batch over dp, time-major tops additionally over sp."""
+        return self.layout.input_specs(net)
 
     def input_shardings(self, net: Optional[Net] = None) -> Dict[str, NamedSharding]:
-        return {name: NamedSharding(self.mesh, spec)
-                for name, spec in self._input_specs(net).items()}
+        return self.layout.input_shardings(net)
 
     def chunk_input_shardings(self, net: Optional[Net] = None
                               ) -> Dict[str, NamedSharding]:
@@ -281,30 +218,22 @@ class ParallelSolver:
         return self._step_many[k]
 
     def _install_flash_mesh(self, fn):
-        """A bare pallas_call cannot be GSPMD-partitioned, but attention
-        is embarrassingly parallel over batch x heads — so on meshes
-        the dispatch is routed through shard_map (ops.layers.flash_mesh)
-        and each device runs the kernel on its local block; when the
-        mesh also shards TIME (sp), the shard_map body is the
-        differentiable fused ring.  Single-device meshes call the
-        kernel directly; ineligible shapes fall back to the
-        GSPMD-partitionable einsum inside the dispatch."""
-        if self.mesh.devices.size <= 1:
-            return fn
-
-        def wrapped(*args, _f=fn):
-            from ..ops.layers import flash_mesh
-            with flash_mesh(self.mesh):  # active during TRACING
-                return _f(*args)
-        return wrapped
+        """Route pallas attention dispatches through shard_map on
+        meshes (MeshLayout.install_flash — shared with the serving
+        forward); when the mesh also shards TIME (sp), the shard_map
+        body is the differentiable fused ring."""
+        return self.layout.install_flash(fn)
 
     def eval_step(self):
+        """Jitted validation forward — built by the SAME BlobForward
+        the serving and batch-extract paths use (serving/forward.py),
+        against this solver's layout: one forward-construction path."""
         if self._eval is None:
-            base = self._install_flash_mesh(self.solver.eval_step_fn())
-            in_sh = (self.param_sharding,
-                     self.input_shardings(self.solver.test_net))
-            self._eval = jax.jit(base, in_shardings=in_sh,
-                                 out_shardings=None)
+            from ..serving.forward import BlobForward
+            net = self.solver.test_net
+            assert net is not None, "no TEST-phase net in this config"
+            self._eval = BlobForward(net, layout=self.layout)(
+                tuple(net.output_blobs))
         return self._eval
 
     @property

@@ -21,13 +21,26 @@ ride ICI neighbors first.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXES = ("pp", "ep", "sp", "tp", "dp")
+
+
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """'dp[,tp[,sp[,ep]]]' → build_mesh kwargs; rejects extra dims
+    instead of silently dropping them.  Shared by the training CLI
+    (-mesh) and the serving CLI (-serveMesh)."""
+    dims = [int(x) for x in spec.split(",")]
+    names = ["dp", "tp", "sp", "ep"]
+    if len(dims) > len(names):
+        raise ValueError(
+            f"mesh spec {spec!r} has {len(dims)} dims; expected at most "
+            f"{len(names)} ({','.join(names)})")
+    return dict(zip(names, dims))
 
 
 def distributed_init(coordinator: Optional[str] = None,
@@ -103,6 +116,179 @@ def dp_data_rank(mesh: Mesh) -> tuple:
     # non-contiguous local dp rows (exotic device order): feed the
     # whole stream rather than misalign the local shard
     return 0, 1
+
+
+# ---------------------------------------------------------------------------
+# named-axis layouts (param/input spec construction)
+#
+# THE one spec-construction path: ParallelSolver (training) and
+# BlobForward (serving / batch extract / validation) both consume
+# MeshLayout, so a net's tp/ep partitioning can never diverge between
+# the step that trains the weights and the forward that serves them.
+# ---------------------------------------------------------------------------
+
+TP_MIN_FEATURES = 1024  # shard only matmuls big enough to matter
+
+
+def tp_param_specs(net, *, min_features: int = TP_MIN_FEATURES
+                   ) -> Dict[str, Dict[str, P]]:
+    """PartitionSpec per param blob: column-shard large IP/Embed weights
+    over 'tp', replicate the rest (Megatron-style split on num_output)."""
+    specs: Dict[str, Dict[str, P]] = {}
+    by_name = {lp.name: lp for lp in net.compute_layers}
+    for lname, blobs in net.param_layout.items():
+        lp = by_name[lname]
+        specs[lname] = {}
+        for bname, shape, _ in blobs:
+            spec = P()
+            if lp.type == "InnerProduct" and bname == "weight":
+                ipp = lp.inner_product_param
+                n_out = int(ipp.num_output)
+                if n_out >= min_features and not ipp.transpose:
+                    spec = P("tp", None)     # (num_output, K) column split
+                elif n_out >= min_features:
+                    spec = P(None, "tp")
+            elif lp.type == "InnerProduct" and bname == "bias":
+                if int(lp.inner_product_param.num_output) >= min_features:
+                    spec = P("tp")
+            elif lp.type == "Embed" and bname == "weight":
+                if int(lp.embed_param.num_output) >= min_features:
+                    spec = P(None, "tp")     # (vocab, dim) dim split
+            elif lp.type in ("LSTM", "RNN") and bname.startswith("W_x"):
+                rp = lp.recurrent_param
+                if int(rp.num_output) * 4 >= min_features:
+                    spec = P("tp", None)     # (4N, D) gate split
+            elif lp.type == "MixtureOfExperts" and bname in ("W1",
+                                                             "W2"):
+                spec = P("ep", None, None)   # expert-dim split
+            specs[lname][bname] = spec
+    return specs
+
+
+def validate_param_specs(specs: Dict[str, Dict[str, P]],
+                         shapes: Dict[str, Dict[str, tuple]],
+                         mesh: Mesh) -> None:
+    """Divisibility guard: every sharded param dim must divide by its
+    mesh axis (an opaque XLA partition error otherwise)."""
+    for ln, blobs in specs.items():
+        for bn, spec in blobs.items():
+            for dim_i, ax in enumerate(spec):
+                if ax is None:
+                    continue
+                size = mesh.shape.get(ax, 1)
+                dim = shapes[ln][bn][dim_i]
+                if size > 1 and dim % size != 0:
+                    raise ValueError(
+                        f"layer {ln!r} blob {bn!r}: dim {dim_i} "
+                        f"(size {dim}) not divisible by mesh axis "
+                        f"{ax!r} (size {size}) — adjust "
+                        f"num_experts/num_output or the mesh")
+
+
+class MeshLayout:
+    """Named-axis parameter + input layouts for one Net under one Mesh.
+
+    Holds the PartitionSpecs/NamedShardings a forward or train step
+    needs: tp/ep-sharded param layouts (with the divisibility guard),
+    dp(+sp)-sharded input layouts, the replicated sharding, and a
+    stable topology signature (the AOT cache namespace key).  Built
+    once and shared — ParallelSolver derives its training shardings
+    from it, and serving's BlobForward jits against the SAME object,
+    which is what lets a net bigger than one device's HBM serve across
+    the mesh with the exact layout training produced."""
+
+    def __init__(self, net, mesh: Mesh, *, tensor_parallel: bool = True,
+                 min_features: int = TP_MIN_FEATURES):
+        self.net = net
+        self.mesh = mesh
+        self.tp_on = tensor_parallel and (
+            mesh.shape.get("tp", 1) > 1 or mesh.shape.get("ep", 1) > 1)
+        self.param_specs = (
+            tp_param_specs(net, min_features=min_features) if self.tp_on
+            else {ln: {bn: P() for bn, _, _ in blobs}
+                  for ln, blobs in net.param_layout.items()})
+        self.shapes = {ln: {bn: s for bn, s, _ in blobs}
+                       for ln, blobs in net.param_layout.items()}
+        validate_param_specs(self.param_specs, self.shapes, mesh)
+        self.param_sharding = {
+            ln: {bn: NamedSharding(mesh, spec)
+                 for bn, spec in blobs.items()}
+            for ln, blobs in self.param_specs.items()}
+        self.repl = replicated(mesh)
+
+    # -- inputs ---------------------------------------------------------
+    def input_specs(self, net=None) -> Dict[str, P]:
+        """Per-input PartitionSpec: batch sharded over dp; time-major
+        (T, B, ·) tops shard batch on axis 1 and — when the mesh has an
+        sp axis — their TIME axis over sp (sequence parallelism).  The
+        optional `net` override serves forwards whose input geometry
+        differs from the layout net (TEST-phase vs TRAIN-phase)."""
+        net = net or self.net
+        has_sp = dict(self.mesh.shape).get("sp", 1) > 1
+        out = {}
+        for name, shape, kind in net.input_specs:
+            if kind.endswith(":T"):
+                out[name] = P("sp", "dp") if has_sp else P(None, "dp")
+            else:
+                out[name] = P("dp")
+        return out
+
+    def input_shardings(self, net=None) -> Dict[str, NamedSharding]:
+        return {name: NamedSharding(self.mesh, spec)
+                for name, spec in self.input_specs(net).items()}
+
+    # -- placement ------------------------------------------------------
+    def place_params(self, params) -> Dict:
+        """device_put every param blob onto its layout sharding."""
+        return {ln: {bn: jax.device_put(arr, self.param_sharding[ln][bn])
+                     for bn, arr in blobs.items()}
+                for ln, blobs in params.items()}
+
+    def install_flash(self, fn):
+        """A bare pallas_call cannot be GSPMD-partitioned, but attention
+        is embarrassingly parallel over batch x heads — on meshes the
+        dispatch is routed through shard_map (ops.layers.flash_mesh)
+        and each device runs the kernel on its local block.  Single-
+        device meshes call the kernel directly."""
+        if self.mesh.devices.size <= 1:
+            return fn
+
+        def wrapped(*args, _f=fn):
+            from ..ops.layers import flash_mesh
+            with flash_mesh(self.mesh):  # active during TRACING
+                return _f(*args)
+        return wrapped
+
+    # -- identity -------------------------------------------------------
+    @property
+    def dp(self) -> int:
+        return self.mesh.shape.get("dp", 1)
+
+    def describe(self) -> Dict[str, object]:
+        """JSON-serializable layout summary (PipelineMetrics set_info,
+        /healthz) — axes with extent > 1 plus the sharded blobs."""
+        axes = {ax: int(n) for ax, n in self.mesh.shape.items() if n > 1}
+        sharded = sorted(
+            f"{ln}/{bn}:{','.join(str(a) for a in spec)}"
+            for ln, blobs in self.param_specs.items()
+            for bn, spec in blobs.items()
+            if any(ax is not None for ax in spec))
+        return {"axes": axes or {"dp": 1},
+                "devices": int(self.mesh.devices.size),
+                "sharded_params": sharded}
+
+    def signature(self) -> str:
+        """Stable topology+layout signature: distinct meshes (or
+        distinct param layouts under one mesh) must never share a
+        compiled-program cache namespace (serving/aot.py)."""
+        axes = ",".join(f"{ax}{self.mesh.shape.get(ax, 1)}"
+                        for ax in self.mesh.axis_names)
+        specs = ";".join(
+            f"{ln}/{bn}={spec}"
+            for ln in sorted(self.param_specs)
+            for bn, spec in sorted(self.param_specs[ln].items())
+            if any(ax is not None for ax in spec))
+        return f"mesh({axes})|{specs}"
 
 
 def lockstep_steps(total_records: int, batch_per_step: int,

@@ -38,20 +38,12 @@ from .data.queue_runner import (DROP_LIMIT_DEFAULT, DROPPED, FeedQueue,
                                 transform_threads, tune_decode_threads)
 from .data.source import STOP_MARK, DataSource
 from .metrics import PipelineMetrics
-from .parallel import ParallelSolver, build_mesh
+from .parallel import ParallelSolver, build_mesh, parse_mesh_spec
 from .solver import Solver
 
-
-def _parse_mesh_spec(spec: str) -> Dict[str, int]:
-    """'dp[,tp[,sp[,ep]]]' → build_mesh kwargs; rejects extra dims
-    instead of silently dropping them."""
-    dims = [int(x) for x in spec.split(",")]
-    names = ["dp", "tp", "sp", "ep"]
-    if len(dims) > len(names):
-        raise ValueError(
-            f"mesh spec {spec!r} has {len(dims)} dims; expected at most "
-            f"{len(names)} ({','.join(names)})")
-    return dict(zip(names, dims))
+# historical alias: the parser now lives with the mesh machinery
+# (parallel.mesh.parse_mesh_spec — shared with the serving CLI)
+_parse_mesh_spec = parse_mesh_spec
 
 
 class ValidationReport:
@@ -627,12 +619,21 @@ class CaffeProcessor:
         """Jitted predict(blobNames) closure, cached per blob set — the
         daemon's chunked EXTRACT requests must not retrace per chunk.
         The builder lives in serving/forward.py (shared with the online
-        serving subsystem, which needs it without a training run)."""
+        serving subsystem, which needs it without a training run).
+        With an explicit -mesh the extract forward runs under the SAME
+        MeshLayout the training step uses (mesh-parallel forward: tp/ep
+        params stay sharded, batch over dp); the implicit all-dp
+        default keeps the single-program path so extract output stays
+        byte-identical to the pre-mesh behavior."""
         from .serving.forward import BlobForward
         net = self.solver.test_net or self.solver.train_net
+        layout = (self.psolver.layout
+                  if (getattr(self.conf, "mesh", "")
+                      and self.psolver.mesh.devices.size > 1)
+                  else None)
         fwd = getattr(self, "_blob_forward", None)
-        if fwd is None or fwd.net is not net:
-            fwd = self._blob_forward = BlobForward(net)
+        if fwd is None or fwd.net is not net or fwd.layout is not layout:
+            fwd = self._blob_forward = BlobForward(net, layout=layout)
         return fwd(blob_names)
 
     def extract_rows(self, records, blob_names: Sequence[str],

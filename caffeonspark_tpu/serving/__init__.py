@@ -24,7 +24,8 @@ from .batcher import (DeadlineExceeded, MicroBatcher, PendingResult,
                       QueueFullError, ServingStopped, bucket_for,
                       make_buckets, serve_max_batch, serve_max_wait_ms,
                       serve_queue_depth)
-from .forward import BlobForward, fetch_rows
+from .forward import (BlobForward, build_serving_layout, fetch_rows,
+                      make_forward_fn, serve_mesh_spec)
 from .registry import ModelRegistry, ModelVersion, build_serving_net
 from .retry import RetryPolicy, retry_call
 from .service import Client, InferenceService
@@ -40,7 +41,8 @@ __all__ = [
     "QueueFullError", "ReplicaProcess", "RetryPolicy",
     "RouteRetryable", "Router", "RouterHTTPServer",
     "RouterRequestError", "ServingHTTPServer", "ServingStopped",
-    "bucket_for", "build_serving_net", "fetch_rows", "make_buckets",
-    "retry_call", "serve_max_batch", "serve_max_wait_ms",
+    "bucket_for", "build_serving_layout", "build_serving_net",
+    "fetch_rows", "make_buckets", "make_forward_fn", "retry_call",
+    "serve_max_batch", "serve_max_wait_ms", "serve_mesh_spec",
     "serve_queue_depth", "serve_replicas",
 ]

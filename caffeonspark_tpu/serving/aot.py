@@ -43,26 +43,34 @@ def aot_cache_root() -> str:
 
 
 def aot_cache_key(net_param, buckets: Sequence[int],
-                  blob_names: Sequence[str]) -> str:
+                  blob_names: Sequence[str],
+                  mesh_sig: Optional[str] = None) -> str:
     """Digest of the serving identity that determines the compiled
-    program set: net topology + bucket shapes + served blobs.  Params
-    and model version are excluded on purpose (see module docstring)."""
+    program set: net topology + bucket shapes + served blobs + mesh
+    topology/sharding layout (`MeshLayout.signature()`; None =
+    single-device).  A tp=2 program and a single-device program are
+    DIFFERENT executables over the same HLO-adjacent net — without the
+    mesh term they would share a namespace and every topology change
+    would count the other topology's entries as its own.  Params and
+    model version stay excluded on purpose (see module docstring)."""
     h = hashlib.sha256()
     h.update(str(net_param).encode())
     h.update(repr(tuple(buckets)).encode())
     h.update(repr(tuple(blob_names)).encode())
+    h.update(repr(mesh_sig).encode())
     return h.hexdigest()[:16]
 
 
 def resolve_cache_dir(net_param, buckets: Sequence[int],
                       blob_names: Sequence[str],
-                      root: Optional[str] = None) -> Optional[str]:
+                      root: Optional[str] = None,
+                      mesh_sig: Optional[str] = None) -> Optional[str]:
     root = aot_cache_root() if root is None else root
     if not root:
         return None
     return os.path.join(root,
                         "aot-" + aot_cache_key(net_param, buckets,
-                                               blob_names))
+                                               blob_names, mesh_sig))
 
 
 def enable_aot_cache(cache_dir: str) -> bool:

@@ -113,15 +113,21 @@ def serve_queue_depth(default: int = 0) -> int:
 
 # -- buckets ------------------------------------------------------------
 
-def make_buckets(max_batch: int) -> Tuple[int, ...]:
+def make_buckets(max_batch: int, multiple: int = 1) -> Tuple[int, ...]:
     """Powers of two up to max_batch, plus max_batch itself when it is
-    not one — the fixed program set XLA compiles."""
+    not one — the fixed program set XLA compiles.  `multiple` (the
+    serving mesh's dp extent) scales every bucket so each flush shape
+    divides evenly over the dp axis: buckets are multiple x powers of
+    two, capped by max_batch rounded UP to the multiple (a flush can
+    never be smaller than one row per dp rank)."""
+    m = max(1, int(multiple))
+    cap = -(-max_batch // m) * m      # ceil to the dp multiple
     out: List[int] = []
-    b = 1
-    while b < max_batch:
+    b = m
+    while b < cap:
         out.append(b)
         b *= 2
-    out.append(max_batch)
+    out.append(cap)
     return tuple(out)
 
 
@@ -201,20 +207,26 @@ class MicroBatcher:
                  max_wait_ms: Optional[float] = None,
                  queue_depth: Optional[int] = None,
                  default_timeout_ms: Optional[float] = None,
+                 batch_multiple: int = 1,
                  metrics: Optional[PipelineMetrics] = None):
         self.run_batch = run_batch
         self.max_batch = max_batch if max_batch else serve_max_batch()
         self.max_wait_s = (serve_max_wait_ms()
                            if max_wait_ms is None else
                            max(0.0, float(max_wait_ms))) / 1e3
-        # default depth scales with THIS instance's max_batch (the env
-        # knob only supplies an explicit depth), so a wide constructor
-        # max_batch still gets room for ~4 full flushes
+        # mesh-aware buckets: every flush shape divisible by the dp
+        # extent (batch_multiple), so a dp-sharded forward never sees a
+        # batch it cannot split evenly across the mesh
+        self.batch_multiple = max(1, int(batch_multiple))
+        self.buckets = make_buckets(self.max_batch, self.batch_multiple)
+        self.max_batch = self.buckets[-1]   # cap rounded to the multiple
+        # default depth scales with THIS instance's (rounded) max_batch
+        # (the env knob only supplies an explicit depth), so a wide
+        # constructor max_batch still gets room for ~4 full flushes
         depth = queue_depth if queue_depth \
             else _env_int("COS_SERVE_QUEUE_DEPTH", 0)
         if depth <= 0:
             depth = 4 * self.max_batch
-        self.buckets = make_buckets(self.max_batch)
         self.default_timeout_ms = default_timeout_ms
         self.metrics = metrics or PipelineMetrics()
         self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)

@@ -2,43 +2,74 @@
 
 Lifted out of `CaffeProcessor._feature_fwd` so an online service can
 build the jitted forward from a Net + params WITHOUT a training run
-(no Solver thread, no feed queues).  The processor's feature path and
-the serving subsystem share this one implementation, which is what
-makes the serving-vs-extract parity gate (tests/test_serving.py) hold
-by construction: same program, same row extraction.
+(no Solver thread, no feed queues).  The processor's feature path,
+the validation round, and the serving subsystem share this one
+implementation, which is what makes the serving-vs-extract parity
+gate (tests/test_serving.py) hold by construction: same program,
+same row extraction.
+
+Mesh-parallel forward: pass a `parallel.mesh.MeshLayout` and every
+program is jitted under the layout's mesh — params laid out on tp/ep
+exactly as `ParallelSolver` trains them (the SAME MeshLayout object
+builds both), the input batch sharded on dp, outputs replicated so
+row extraction stays a plain device_get.  A net bigger than one
+device's HBM serves across the mesh with no second spec derivation
+anywhere.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Sequence, Tuple
+import logging
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..net import Net
+
+_LOG = logging.getLogger(__name__)
+
+
+def make_forward_fn(net: Net, blob_names: Tuple[str, ...]):
+    """The one un-jitted forward body every consumer traces:
+    predict(blobNames) semantics (CaffeNet.cpp:677-697) — forward,
+    then read ANY named blob, not just net outputs."""
+    def fwd(params, inputs):
+        blobs, _ = net.apply(params, inputs, train=False)
+        return {bn: blobs[bn] for bn in blob_names}
+    return fwd
 
 
 class BlobForward:
     """Jitted predict(blobNames) closures for one Net, cached per blob
     set — chunked EXTRACT requests and per-bucket serving flushes must
     not retrace per call.  Programs are params-agnostic, so a model
-    hot-swap reuses every compiled bucket program."""
+    hot-swap reuses every compiled bucket program.
 
-    def __init__(self, net: Net):
+    `layout` (a MeshLayout) switches every closure to mesh execution:
+    in_shardings pin params to the layout's tp/ep placement and the
+    batch to dp, out_shardings replicate the fetched blobs.  jit does
+    the input device_put itself, so callers keep handing in host
+    arrays."""
+
+    def __init__(self, net: Net, layout=None):
         self.net = net
+        self.layout = layout
         self._cache: Dict[Tuple[str, ...], Any] = {}
 
     def __call__(self, blob_names: Tuple[str, ...]):
         import jax
         if blob_names not in self._cache:
-            net = self.net
-
-            # predict(blobNames) semantics (CaffeNet.cpp:677-697):
-            # forward, then read ANY named blob — not just net outputs
-            @jax.jit
-            def fwd(params, inputs):
-                blobs, _ = net.apply(params, inputs, train=False)
-                return {bn: blobs[bn] for bn in blob_names}
-
+            fwd = make_forward_fn(self.net, tuple(blob_names))
+            if self.layout is None:
+                fwd = jax.jit(fwd)
+            else:
+                lay = self.layout
+                fwd = jax.jit(
+                    lay.install_flash(fwd),
+                    in_shardings=(lay.param_sharding,
+                                  lay.input_shardings(self.net)),
+                    out_shardings={bn: lay.repl for bn in blob_names})
             self._cache[blob_names] = fwd
         return self._cache[blob_names]
 
@@ -65,3 +96,49 @@ def fetch_rows(out: Dict[str, Any], blob_names: Sequence[str],
                 row[bn] = [float(x) for x in per[i]]
         rows.append(row)
     return rows
+
+
+# ---------------------------------------------------------------------------
+# serving mesh resolution (-serveMesh / COS_SERVE_TP)
+# ---------------------------------------------------------------------------
+
+def serve_mesh_spec(conf=None) -> Optional[Dict[str, int]]:
+    """Resolve the serving mesh request: `-serveMesh dp[,tp[,sp[,ep]]]`
+    (same grammar as the training `-mesh` flag), else the COS_SERVE_TP
+    shorthand (tp=N, dp = device remainder).  None = single-device
+    serving, exactly the pre-mesh behavior."""
+    spec = getattr(conf, "serveMesh", "") if conf is not None else ""
+    if not spec:
+        spec = os.environ.get("COS_SERVE_MESH", "")
+    if spec:
+        from ..parallel.mesh import parse_mesh_spec
+        return parse_mesh_spec(spec)
+    try:
+        tp = int(os.environ.get("COS_SERVE_TP", "0"))
+    except ValueError:
+        _LOG.warning("ignoring non-integer COS_SERVE_TP=%r",
+                     os.environ.get("COS_SERVE_TP"))
+        tp = 0
+    if tp > 1:
+        return {"tp": tp}
+    return None
+
+
+def build_serving_layout(net: Net, conf=None, *, devices=None):
+    """MeshLayout for serving, or None when no mesh was requested.
+    Spec construction is `parallel.mesh.MeshLayout` — the identical
+    path ParallelSolver uses for training, so serving params land on
+    the same shards the trainer would put them on.  `-devices N`
+    limits the mesh to this host's first N devices (the trainer's
+    rule), so a replica can own a sub-slice."""
+    kwargs = serve_mesh_spec(conf)
+    if kwargs is None:
+        return None
+    import jax
+    from ..parallel.mesh import MeshLayout, build_mesh
+    if devices is None and getattr(conf, "devices", 0) > 0:
+        devices = jax.local_devices()[:conf.devices]
+    mesh = build_mesh(devices=devices, **kwargs)
+    layout = MeshLayout(net, mesh)
+    _LOG.info("serving mesh: %s", layout.describe())
+    return layout

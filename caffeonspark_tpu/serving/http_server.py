@@ -76,10 +76,16 @@ class _Handler(JsonHandler):
                                  "error": "no model loaded"})
                 return
             draining = getattr(svc, "draining", False)
-            self._send(200, {"ok": not draining,
-                             "status": "draining" if draining else "ok",
-                             "model_version": version,
-                             "queue_depth": svc.batcher.depth()})
+            out = {"ok": not draining,
+                   "status": "draining" if draining else "ok",
+                   "model_version": version,
+                   "queue_depth": svc.batcher.depth()}
+            # replica topology rides along so the router / operators
+            # see sharded replicas without a /metrics round-trip
+            mesh = getattr(svc, "mesh_info", lambda: None)()
+            if mesh is not None:
+                out["mesh"] = mesh
+            self._send(200, out)
         elif self.path == "/metrics":
             self._send(200, svc.metrics_summary())
         else:

@@ -22,7 +22,7 @@ from typing import NamedTuple, Optional
 from .. import checkpoint
 from ..net import Net, Params
 from ..proto import NetParameter, NetState, Phase, SolverParameter
-from .forward import BlobForward
+from .forward import BlobForward, build_serving_layout
 
 _LOG = logging.getLogger(__name__)
 
@@ -60,11 +60,20 @@ class ModelVersion(NamedTuple):
 
 
 class ModelRegistry:
-    """Versioned param store + shared forward-program cache."""
+    """Versioned param store + shared forward-program cache.
 
-    def __init__(self, net: Net):
+    `layout` (a parallel.mesh.MeshLayout) turns the registry
+    mesh-parallel: the shared BlobForward jits under the mesh, `load`
+    streams checkpoint shards straight to their destination devices
+    (zero-gather — checkpoint.load_serving_params' mesh path), and
+    `publish` places in-memory params onto the layout before they
+    become current, so every version a flush can snapshot is already
+    on the mesh."""
+
+    def __init__(self, net: Net, layout=None):
         self.net = net
-        self.forward = BlobForward(net)
+        self.layout = layout
+        self.forward = BlobForward(net, layout=layout)
         self._lock = threading.Lock()
         self._current: Optional[ModelVersion] = None
         self._version = 0
@@ -74,16 +83,19 @@ class ModelRegistry:
         if conf.netParam is None:
             raise ValueError("serving needs -conf (solver prototxt "
                              "resolving a net)")
-        return cls(build_serving_net(conf.netParam,
-                                     conf.solverParameter))
+        net = build_serving_net(conf.netParam, conf.solverParameter)
+        return cls(net, layout=build_serving_layout(net, conf))
 
     # ------------------------------------------------------------------
     def load(self, model_path: str) -> ModelVersion:
         """Load a snapshot (.caffemodel[.h5] or .solverstate[.h5] whose
         learned_net pointer resolves) and publish it as the current
         version.  In-flight flushes keep serving the version they
-        snapshotted; new flushes pick this one up."""
-        params = checkpoint.load_serving_params(self.net, model_path)
+        snapshotted; new flushes pick this one up.  Under a layout the
+        load STREAMS: shard-by-shard device placement, no host-RAM
+        gather of the full parameter set."""
+        params = checkpoint.load_serving_params(self.net, model_path,
+                                                layout=self.layout)
         with self._lock:
             self._version += 1
             mv = ModelVersion(self._version, model_path, params)
@@ -95,7 +107,11 @@ class ModelRegistry:
     def publish(self, params: Params, path: str = "<in-memory>"
                 ) -> ModelVersion:
         """Install already-materialized params (tests, co-located
-        trainers handing over fresh weights without a file round-trip)."""
+        trainers handing over fresh weights without a file round-trip).
+        Under a layout the params are placed onto the mesh first, so
+        hot-swap and load agree on where every shard lives."""
+        if self.layout is not None:
+            params = self.layout.place_params(params)
         with self._lock:
             self._version += 1
             mv = ModelVersion(self._version, path, params)

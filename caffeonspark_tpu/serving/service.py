@@ -91,11 +91,20 @@ class InferenceService:
             blob_names = names
         self.blob_names: Tuple[str, ...] = tuple(blob_names)
         self.metrics = metrics or PipelineMetrics()
+        # mesh-aware micro-batching: bucket shapes stay divisible by
+        # the serving mesh's dp extent so every flush splits evenly
+        layout = self.registry.layout
         self.batcher = MicroBatcher(
             self._run_batch, max_batch=max_batch,
             max_wait_ms=max_wait_ms, queue_depth=queue_depth,
             default_timeout_ms=default_timeout_ms,
+            batch_multiple=layout.dp if layout is not None else 1,
             metrics=self.metrics)
+        if layout is not None:
+            # self-describing replica topology: the router, /metrics
+            # scrapers, and bench artifacts read it from the same
+            # PipelineMetrics info block PR 6 used for the comm plan
+            self.metrics.set_info("serve_mesh", layout.describe())
         self._started = False
         self._draining = False   # rolling-swap state: reject new work
         self._warmup_wall_s: Optional[float] = None
@@ -131,9 +140,10 @@ class InferenceService:
         start, serving/aot.py)."""
         assert not self._started, "service already started"
         from . import aot
-        cache_dir = aot.resolve_cache_dir(self.conf.netParam,
-                                          self.batcher.buckets,
-                                          self.blob_names)
+        layout = self.registry.layout
+        cache_dir = aot.resolve_cache_dir(
+            self.conf.netParam, self.batcher.buckets, self.blob_names,
+            mesh_sig=layout.signature() if layout is not None else None)
         if cache_dir and aot.enable_aot_cache(cache_dir):
             self._aot_cache_dir = cache_dir
         t0 = time.monotonic()
@@ -267,6 +277,13 @@ class InferenceService:
         version = self.registry.load(model_path).version
         self._draining = False
         return version
+
+    def mesh_info(self) -> Optional[dict]:
+        """Serving mesh/sharding layout (None when single-device) —
+        what /healthz reports so the fleet router and operators can see
+        each replica's topology without parsing /metrics."""
+        layout = self.registry.layout
+        return layout.describe() if layout is not None else None
 
     def metrics_summary(self) -> dict:
         out = self.metrics.summary()

@@ -479,14 +479,13 @@ class Solver:
 
     # ------------------------------------------------------------------
     def eval_step_fn(self):
+        """Validation forward — constructed by the shared blob-forward
+        builder (serving/forward.py), so serving, batch extract, and
+        validation trace one implementation."""
         net = self.test_net
         assert net is not None, "no TEST-phase net in this config"
-
-        def step(params: Params, inputs: Dict[str, Array]):
-            blobs, _ = net.apply(params, inputs, train=False)
-            return {name: blobs[name] for name in net.output_blobs}
-
-        return step
+        from .serving.forward import make_forward_fn
+        return make_forward_fn(net, tuple(net.output_blobs))
 
     def jit_eval_step(self):
         if self._jit_eval_step is None:

@@ -1,0 +1,485 @@
+"""Sharded serving (ISSUE 10): mesh-parallel forward parity vs
+`extract_features`, zero-gather checkpoint streaming, mesh-aware
+bucket divisibility, hot-swap atomicity under a mesh, and per-topology
+AOT cache namespaces.
+
+All mesh cases run on the 8 virtual CPU devices the conftest forces
+(`--xla_force_host_platform_device_count=8`)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from caffeonspark_tpu import checkpoint
+from caffeonspark_tpu.config import Config
+from caffeonspark_tpu.parallel import (MeshLayout, ParallelSolver,
+                                       build_mesh, parse_mesh_spec)
+from caffeonspark_tpu.proto import NetParameter, SolverParameter
+from caffeonspark_tpu.serving import (Client, InferenceService,
+                                      MicroBatcher, make_buckets,
+                                      serve_mesh_spec)
+from caffeonspark_tpu.serving import aot
+from caffeonspark_tpu.solver import Solver
+
+# a net with a tp-shardable InnerProduct (num_output 1024 >= the
+# TP_MIN_FEATURES floor, divisible by tp=2/4) so the mesh layouts are
+# non-trivial on the test mesh
+NET_TMPL = """
+name: "shardnet"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param {{ source: "{root}/unused_lmdb" batch_size: 8
+    channels: 1 height: 12 width: 12 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param {{ num_output: 4 kernel_size: 3
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "relu" type: "ReLU" bottom: "conv1" top: "conv1" }}
+layer {{ name: "fc_big" type: "InnerProduct" bottom: "conv1"
+  top: "fc_big" inner_product_param {{ num_output: 1024
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "fc_big" top: "ip"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}
+"""
+
+SOLVER_TMPL = """
+net: "{net}"
+base_lr: 0.01
+momentum: 0.9
+lr_policy: "fixed"
+max_iter: 20
+random_seed: 5
+"""
+
+
+def _records(n, seed=0, h=12, w=12):
+    return [(f"{i:08d}", float(i % 3), 1, h, w, False,
+             np.random.RandomState(seed + i)
+             .rand(1, h, w).astype(np.float32) * 255.0)
+            for i in range(n)]
+
+
+@pytest.fixture(scope="module")
+def shard_model(tmp_path_factory):
+    """Written prototxts + a briefly-trained caffemodel."""
+    td = tmp_path_factory.mktemp("shard_serving")
+    net_path = td / "net.prototxt"
+    net_path.write_text(NET_TMPL.format(root=td))
+    solver_path = td / "solver.prototxt"
+    solver_path.write_text(SOLVER_TMPL.format(net=net_path))
+    s = Solver(SolverParameter.from_text(
+        SOLVER_TMPL.format(net=net_path)),
+        NetParameter.from_text(NET_TMPL.format(root=td)))
+    params, st = s.init()
+    import jax.numpy as jnp
+    step = s.jit_train_step()
+    rng = np.random.RandomState(7)
+    for i in range(2):
+        batch = {"data": jnp.asarray(
+            rng.rand(8, 1, 12, 12).astype(np.float32) * 255),
+            "label": jnp.asarray(
+                rng.randint(0, 10, 8).astype(np.float32))}
+        params, st, _ = step(params, st, batch, s.step_rng(i))
+    model = str(td / "m.caffemodel")
+    checkpoint.save_caffemodel(model, s.train_net, params)
+    return str(solver_path), model
+
+
+def _service(shard_model, *mesh_args, **kw):
+    solver_path, model = shard_model
+    conf = Config(["-conf", solver_path, "-model", model, *mesh_args])
+    kw.setdefault("blob_names", ("ip",))
+    return InferenceService(conf, **kw)
+
+
+def _extract_reference(shard_model, recs, blobs=("ip",)):
+    solver_path, model = shard_model
+    fconf = Config(["-conf", solver_path, "-model", model])
+    fconf.snapshotModelFile = model
+    from caffeonspark_tpu.processor import CaffeProcessor
+    proc = CaffeProcessor.instance(fconf)
+    try:
+        return proc.extract_rows(list(recs), list(blobs))
+    finally:
+        CaffeProcessor._instance = None
+
+
+# ------------------------------------------------------------- layouts
+
+def test_mesh_layout_is_shared_with_parallel_solver(shard_model):
+    """The spec-construction path is ONE object: ParallelSolver's
+    training shardings are the MeshLayout's, not a re-derivation."""
+    solver_path, _ = shard_model
+    s = Solver(SolverParameter.from_text(open(solver_path).read()),
+               NetParameter.from_text(
+                   open(solver_path.replace("solver.prototxt",
+                                            "net.prototxt")).read()))
+    mesh = build_mesh(tp=2)
+    ps = ParallelSolver(s, mesh)
+    assert isinstance(ps.layout, MeshLayout)
+    assert ps.param_specs is ps.layout.param_specs
+    assert ps.param_sharding is ps.layout.param_sharding
+    assert ps.input_shardings() == ps.layout.input_shardings()
+    # the big fc really is tp-sharded; the small ip is replicated
+    from jax.sharding import PartitionSpec as P
+    assert ps.layout.param_specs["fc_big"]["weight"] == P("tp", None)
+    assert ps.layout.param_specs["ip"]["weight"] == P()
+    desc = ps.layout.describe()
+    assert desc["axes"]["tp"] == 2
+    assert any(sp.startswith("fc_big/weight")
+               for sp in desc["sharded_params"])
+
+
+def test_serve_mesh_resolution(monkeypatch):
+    monkeypatch.delenv("COS_SERVE_TP", raising=False)
+    monkeypatch.delenv("COS_SERVE_MESH", raising=False)
+    assert serve_mesh_spec() is None
+    monkeypatch.setenv("COS_SERVE_TP", "2")
+    assert serve_mesh_spec() == {"tp": 2}
+    monkeypatch.setenv("COS_SERVE_TP", "junk")
+    assert serve_mesh_spec() is None         # parse fallback
+    monkeypatch.setenv("COS_SERVE_MESH", "4,2")
+    assert serve_mesh_spec() == {"dp": 4, "tp": 2}
+    conf = Config(["-serveMesh", "2,2"])
+    assert serve_mesh_spec(conf) == {"dp": 2, "tp": 2}
+    assert parse_mesh_spec("4,2") == {"dp": 4, "tp": 2}
+    with pytest.raises(ValueError):
+        parse_mesh_spec("1,1,1,1,1")
+
+
+def test_layout_signatures_distinct_per_topology(shard_model):
+    solver_path, _ = shard_model
+    net_path = solver_path.replace("solver.prototxt", "net.prototxt")
+    from caffeonspark_tpu.serving.registry import build_serving_net
+    net = build_serving_net(NetParameter.from_text(open(net_path).read()))
+    sig_tp2 = MeshLayout(net, build_mesh(tp=2)).signature()
+    sig_tp4 = MeshLayout(net, build_mesh(tp=4)).signature()
+    sig_dp8 = MeshLayout(net, build_mesh()).signature()
+    assert len({sig_tp2, sig_tp4, sig_dp8}) == 3
+    # stable across rebuilds of the same topology
+    assert sig_tp2 == MeshLayout(net, build_mesh(tp=2)).signature()
+
+
+def test_aot_cache_key_mesh_namespaces(monkeypatch, tmp_path):
+    """Single-device and tp=2 programs never share a cache
+    namespace."""
+    k_plain = aot.aot_cache_key("net", (1, 2), ("ip",))
+    k_tp2 = aot.aot_cache_key("net", (1, 2), ("ip",),
+                              mesh_sig="mesh(tp2,dp1)|fc/w=tp")
+    k_tp4 = aot.aot_cache_key("net", (1, 2), ("ip",),
+                              mesh_sig="mesh(tp4,dp1)|fc/w=tp")
+    assert len({k_plain, k_tp2, k_tp4}) == 3
+    assert k_plain == aot.aot_cache_key("net", (1, 2), ("ip",),
+                                        mesh_sig=None)
+    monkeypatch.setenv("COS_AOT_CACHE_DIR", str(tmp_path))
+    d_plain = aot.resolve_cache_dir("net", (1, 2), ("ip",))
+    d_mesh = aot.resolve_cache_dir("net", (1, 2), ("ip",),
+                                   mesh_sig="mesh(tp2,dp4)|")
+    assert d_plain != d_mesh
+
+
+# ------------------------------------------------------------- buckets
+
+def test_make_buckets_mesh_multiple():
+    assert make_buckets(64) == (1, 2, 4, 8, 16, 32, 64)   # legacy
+    assert make_buckets(8, 2) == (2, 4, 8)
+    assert make_buckets(8, 4) == (4, 8)
+    assert make_buckets(1, 2) == (2,)        # never below one row/rank
+    assert make_buckets(6, 4) == (4, 8)      # cap rounds UP to the dp
+    for mult in (2, 4):
+        for b in make_buckets(64, mult):
+            assert b % mult == 0
+
+
+def test_batcher_rounds_odd_counts_to_dp_divisible_bucket():
+    """Odd request counts pad to a dp-divisible bucket and padding
+    never leaks into rows (the mesh extension of the padding-no-leak
+    gate)."""
+    log = []
+
+    def run(records, bucket):
+        log.append((len(records), bucket))
+        return [{"v": [float(r)]} for r in records], 1
+
+    b = MicroBatcher(run, max_batch=8, batch_multiple=4,
+                     max_wait_ms=5000, queue_depth=32)
+    assert b.buckets == (4, 8)
+    pending = [b.submit(i) for i in range(3)]    # odd count
+    b.start()
+    rows = [p.wait(10.0) for p in pending]
+    assert [r["v"] for r in rows] == [[0.0], [1.0], [2.0]]
+    b.stop()
+    assert log == [(3, 4)]                       # padded to dp bucket
+    # max_batch was rounded to the largest bucket
+    b2 = MicroBatcher(run, max_batch=6, batch_multiple=4,
+                      max_wait_ms=1)
+    assert b2.max_batch == 8 and b2.buckets == (4, 8)
+
+
+# ------------------------------------------------- mesh forward parity
+
+@pytest.mark.parametrize("mesh_args, axes", [
+    (("-serveMesh", "4,2"), {"tp": 2, "dp": 4}),    # tp=2 across 8 dev
+    (("-serveMesh", "2", "-devices", "2"), {"dp": 2}),   # pure dp=2
+])
+def test_mesh_serving_parity_with_extract(shard_model, mesh_args,
+                                          axes):
+    """Acceptance gate: serving forward under a REAL Mesh (tp>=2 /
+    dp=2 on CPU devices) equals `extract_features` on the same
+    inputs."""
+    recs = _records(8)
+    ref_rows = _extract_reference(shard_model, recs)
+    assert len(ref_rows) == 8
+
+    svc = _service(shard_model, *mesh_args, max_batch=8,
+                   max_wait_ms=2000)
+    layout = svc.registry.layout
+    assert layout is not None
+    assert {k: v for k, v in layout.describe()["axes"].items()} == axes
+    # params really live on the mesh
+    w = svc.registry.current().params["fc_big"]["weight"]
+    assert w.sharding.mesh.devices.size == layout.mesh.devices.size
+    svc.start(warmup=True)
+    try:
+        rows = Client(svc).predict(recs)
+    finally:
+        svc.stop()
+    assert [r["SampleID"] for r in rows] == \
+        [r["SampleID"] for r in ref_rows]
+    for got, ref in zip(rows, ref_rows):
+        np.testing.assert_allclose(got["ip"], ref["ip"],
+                                   rtol=2e-5, atol=1e-6)
+    # the mesh is self-describing in the metrics/health surfaces
+    m = svc.metrics_summary()
+    assert m["info"]["serve_mesh"]["axes"] == axes
+    assert svc.mesh_info()["axes"] == axes
+    # bucket shapes divide by the dp extent
+    dp = layout.dp
+    assert all(b % dp == 0 for b in svc.batcher.buckets)
+
+
+def test_single_device_serving_unchanged(shard_model, monkeypatch):
+    """No mesh requested → layout is None, buckets/behavior exactly
+    the pre-mesh path (byte-parity with extract is pinned in
+    test_serving.py; here we pin the layout plumbing stays off)."""
+    monkeypatch.delenv("COS_SERVE_TP", raising=False)
+    monkeypatch.delenv("COS_SERVE_MESH", raising=False)
+    svc = _service(shard_model, max_batch=4, max_wait_ms=5)
+    assert svc.registry.layout is None
+    assert svc.mesh_info() is None
+    assert svc.batcher.buckets == (1, 2, 4)
+    assert "serve_mesh" not in svc.metrics_summary().get("info", {})
+
+
+def test_hot_swap_on_mesh_never_mixed(shard_model):
+    """Stream single-record requests while swapping the model under a
+    tp=2 mesh: every answer matches exactly one version (zero weights +
+    constant ip bias → output == bias, exact even through the mesh)."""
+    svc = _service(shard_model, "-serveMesh", "4,2", max_batch=4,
+                   max_wait_ms=1, queue_depth=64)
+    net = svc.registry.net
+
+    def constant_params(bias):
+        import jax.numpy as jnp
+        p = net.init(jax.random.key(0))
+        out = {ln: {bn: jnp.zeros_like(a) for bn, a in bl.items()}
+               for ln, bl in p.items()}
+        out["ip"]["bias"] = jnp.full_like(p["ip"]["bias"], bias)
+        return out
+
+    v_a = svc.registry.publish(constant_params(0.0), "A").version
+    # publish placed the params on the mesh layout
+    assert svc.registry.current().params["fc_big"]["weight"] \
+        .sharding.mesh.devices.size == 8
+    svc.start(warmup=False)
+    try:
+        results = []
+        rec = _records(1)[0]
+        for i in range(30):
+            if i == 15:
+                v_b = svc.registry.publish(constant_params(1.0),
+                                           "B").version
+            p = svc.submit(rec)
+            results.append((p.wait(30.0), p.model_version))
+    finally:
+        svc.stop()
+    expect = {v_a: [0.0] * 10, v_b: [1.0] * 10}
+    assert {v for _, v in results} == {v_a, v_b}
+    for row, version in results:
+        assert row["ip"] == expect[version], (row, version)
+
+
+# ------------------------------------------- zero-gather checkpointing
+
+def test_sharded_caffemodel_roundtrip_dense(shard_model, tmp_path):
+    """save_sharded_caffemodel → load_caffemodel_blobs assembles the
+    dense params back, byte-equal (the host-gather baseline path)."""
+    solver_path, model = shard_model
+    net_path = solver_path.replace("solver.prototxt", "net.prototxt")
+    s = Solver(SolverParameter.from_text(open(solver_path).read()),
+               NetParameter.from_text(open(net_path).read()))
+    params, _ = s.init()
+    layout = MeshLayout(s.train_net, build_mesh(tp=2))
+    placed = layout.place_params(params)
+    path = str(tmp_path / "sharded.caffemodel")
+    checkpoint.save_sharded_caffemodel(path, s.train_net, placed,
+                                       force_shards=True)
+    assert os.path.exists(path + ".shard0")
+    loaded = checkpoint.load_caffemodel_blobs(path)
+    for ln, specs in s.train_net.param_layout.items():
+        for i, (bn, shape, _) in enumerate(specs):
+            np.testing.assert_array_equal(
+                loaded[ln][i],
+                np.asarray(jax.device_get(params[ln][bn])))
+
+
+def test_zero_gather_streamed_mesh_load(shard_model, tmp_path,
+                                        monkeypatch):
+    """Acceptance gate: the mesh load path streams shards straight to
+    devices — monkeypatching the dense-host helpers
+    (gather_params_if_sharded / _dense_host_param / the dense file
+    loader) to FAIL proves no full-size host parameter buffer is
+    materialized; the loaded params are byte-equal and land on the
+    layout's shardings."""
+    solver_path, model = shard_model
+    net_path = solver_path.replace("solver.prototxt", "net.prototxt")
+    s = Solver(SolverParameter.from_text(open(solver_path).read()),
+               NetParameter.from_text(open(net_path).read()))
+    params, _ = s.init()
+    layout = MeshLayout(s.train_net, build_mesh(tp=2))
+    placed = layout.place_params(params)
+    path = str(tmp_path / "sharded.caffemodel")
+    checkpoint.save_sharded_caffemodel(path, s.train_net, placed,
+                                       force_shards=True)
+
+    def boom(*a, **k):
+        raise AssertionError("dense-host gather path touched on the "
+                             "mesh load path")
+
+    monkeypatch.setattr(checkpoint, "gather_params_if_sharded", boom)
+    monkeypatch.setattr(checkpoint, "_dense_host_param", boom)
+    monkeypatch.setattr(checkpoint, "load_caffemodel_blobs", boom)
+    loaded = checkpoint.load_serving_params(s.train_net, path,
+                                            layout=layout)
+    from jax.sharding import PartitionSpec as P
+    assert loaded["fc_big"]["weight"].sharding.spec == P("tp", None)
+    for ln, bl in params.items():
+        for bn, a in bl.items():
+            np.testing.assert_array_equal(
+                np.asarray(jax.device_get(loaded[ln][bn])),
+                np.asarray(jax.device_get(a)))
+
+
+def test_registry_mesh_load_serves_from_sharded_snapshot(
+        shard_model, tmp_path, monkeypatch):
+    """End-to-end: a registry under COS_SERVE_TP=2 hot-swaps straight
+    from a sharded snapshot with the dense-host path poisoned, and the
+    swapped version answers requests."""
+    solver_path, model = shard_model
+    net_path = solver_path.replace("solver.prototxt", "net.prototxt")
+    s = Solver(SolverParameter.from_text(open(solver_path).read()),
+               NetParameter.from_text(open(net_path).read()))
+    params, _ = s.init()
+    monkeypatch.setenv("COS_SERVE_TP", "2")
+    svc = _service(shard_model, max_batch=4, max_wait_ms=5)
+    layout = svc.registry.layout
+    assert layout is not None and layout.mesh.shape["tp"] == 2
+    sh_path = str(tmp_path / "swap.caffemodel")
+    checkpoint.save_sharded_caffemodel(
+        sh_path, s.train_net, layout.place_params(params),
+        force_shards=True)
+
+    def boom(*a, **k):
+        raise AssertionError("dense-host gather path touched")
+
+    monkeypatch.setattr(checkpoint, "gather_params_if_sharded", boom)
+    monkeypatch.setattr(checkpoint, "_dense_host_param", boom)
+    monkeypatch.setattr(checkpoint, "load_caffemodel_blobs", boom)
+    svc.start(warmup=False)
+    try:
+        v = svc.reload(sh_path)
+        assert v == 2                         # initial load + swap
+        row = Client(svc).predict_one(_records(1)[0])
+        assert len(row["ip"]) == 10
+    finally:
+        svc.stop()
+
+
+def test_dense_model_streams_per_shard_views(shard_model, monkeypatch):
+    """A DENSE .caffemodel under a mesh layout still avoids the
+    dense-host export helpers: blobs stream per-shard views."""
+    solver_path, model = shard_model
+    net_path = solver_path.replace("solver.prototxt", "net.prototxt")
+    s = Solver(SolverParameter.from_text(open(solver_path).read()),
+               NetParameter.from_text(open(net_path).read()))
+    layout = MeshLayout(s.train_net, build_mesh(tp=2))
+
+    def boom(*a, **k):
+        raise AssertionError("dense-host gather path touched")
+
+    monkeypatch.setattr(checkpoint, "gather_params_if_sharded", boom)
+    monkeypatch.setattr(checkpoint, "_dense_host_param", boom)
+    loaded = checkpoint.load_serving_params(s.train_net, model,
+                                            layout=layout)
+    ref = checkpoint.load_caffemodel_blobs(model)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(loaded["fc_big"]["weight"])),
+        ref["fc_big"][0])
+    from jax.sharding import PartitionSpec as P
+    assert loaded["fc_big"]["weight"].sharding.spec == P("tp", None)
+
+
+# ------------------------------------------------- AOT warmth per mesh
+
+def test_aot_warm_start_mesh_namespace(shard_model, tmp_path,
+                                       monkeypatch, recompile_guard):
+    """Warm start holds under meshes: warmup with a populated
+    COS_AOT_CACHE_DIR adds zero cache entries for the SAME topology
+    (pure hits, RecompileGuard-armed steady state), and a different
+    topology lands in a different namespace."""
+    monkeypatch.setenv("COS_AOT_CACHE_DIR", str(tmp_path / "aot"))
+    monkeypatch.setenv("COS_SERVE_TP", "2")
+    old_dir = jax.config.jax_compilation_cache_dir
+    try:
+        svc1 = _service(shard_model, max_batch=4, max_wait_ms=5)
+        svc1.start(warmup=True)
+        m1 = svc1.metrics_summary()
+        svc1.stop()
+        d = m1["aot_cache_dir"]
+        n_cold = aot.cache_entries(d)
+        assert n_cold >= len(svc1.batcher.buckets)
+
+        svc2 = _service(shard_model, max_batch=4, max_wait_ms=5)
+        svc2.start(warmup=True)
+        try:
+            assert svc2.metrics_summary()["aot_cache_dir"] == d
+            assert aot.cache_entries(d) == n_cold   # all cache hits
+            recompile_guard.watch(
+                "serving.forward",
+                svc2.registry.forward(svc2.blob_names))
+            recompile_guard.mark_steady()
+            rows = Client(svc2).predict(_records(6, seed=30))
+            assert len(rows) == 6
+            recompile_guard.check()
+        finally:
+            svc2.stop()
+
+        # a DIFFERENT topology must resolve a different namespace
+        monkeypatch.setenv("COS_SERVE_TP", "4")
+        svc3 = _service(shard_model, max_batch=4, max_wait_ms=5)
+        sig3 = svc3.registry.layout.signature()
+        d3 = aot.resolve_cache_dir(svc3.conf.netParam,
+                                   svc3.batcher.buckets,
+                                   svc3.blob_names, mesh_sig=sig3)
+        assert d3 != d
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
