@@ -8,8 +8,8 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 
 .PHONY: build native install lint test test-slow spark-test bench \
   smoke tpu-tests bench-evidence bench-ingest bench-steploop \
-  bench-serving bench-serving-sharded bench-gradsync onchip-artifacts \
-  docs clean
+  bench-serving bench-serving-sharded bench-gradsync bench-syncmode \
+  chaos onchip-artifacts docs clean
 
 build: native install
 
@@ -76,6 +76,22 @@ bench-gradsync:
 	mkdir -p bench_evidence
 	$(CPU_ENV) $(PY) scripts/bench_gradsync.py \
 	  --out bench_evidence/bench_gradsync.json
+
+# sync modes under an injected 5x-slow rank: rank-0 steps/s for
+# lockstep vs local_sgd vs async (straggler-tolerance sweep), with a
+# no-straggler control; ALWAYS exits 0 with one JSON document on
+# stdout (bench.py contract)
+bench-syncmode:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_syncmode.py \
+	  --out bench_evidence/bench_syncmode.json
+
+# chaos drills: the fault-injection test suite (kill-rank / slow-rank
+# / flaky-exchange / flaky-storage under each sync mode, supervisor
+# elastic relaunch + bad-snapshot fallback) — subprocess-heavy, so
+# they carry the `chaos` marker and stay out of tier-1
+chaos:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m "chaos"
 
 # online serving: dynamic micro-batching vs batch=1 dispatch across
 # offered loads; JSON artifact with p50/p99 latency + rows/s per cell

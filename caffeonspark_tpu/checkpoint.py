@@ -63,6 +63,36 @@ def params_partitioned(params: Params) -> bool:
                for bl in params.values() for a in bl.values())
 
 
+# -- flat host-param codec (sync-mode averaged-state exchange) -------------
+# The elastic sync modes (parallel/syncmode.py) move whole param
+# pytrees through a shared-filesystem store as flat {key: array} dicts
+# (npz members can't nest).  Key grammar: "<layer>::<blob>".
+FLAT_KEY_SEP = "::"
+
+
+def flatten_host_params(params: Params) -> Dict[str, np.ndarray]:
+    """Host (numpy) copy of a param pytree as a flat npz-able dict."""
+    out: Dict[str, np.ndarray] = {}
+    for ln, bl in params.items():
+        if FLAT_KEY_SEP in ln:
+            raise ValueError(
+                f"layer name {ln!r} contains {FLAT_KEY_SEP!r} — "
+                "cannot form a flat sync-store key")
+        for bn, arr in bl.items():
+            out[f"{ln}{FLAT_KEY_SEP}{bn}"] = np.asarray(
+                jax.device_get(arr))
+    return out
+
+
+def unflatten_host_params(flat: Dict[str, np.ndarray]) -> Params:
+    """Inverse of flatten_host_params (host arrays, caller places)."""
+    out: Params = {}
+    for key, arr in flat.items():
+        ln, bn = key.split(FLAT_KEY_SEP, 1)
+        out.setdefault(ln, {})[bn] = arr
+    return out
+
+
 @functools.lru_cache(maxsize=16)
 def _replicate_fn(rep_sharding):
     """One compiled identity-with-replicated-output per sharding —

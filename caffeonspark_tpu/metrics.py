@@ -37,8 +37,14 @@ Stage names used by the training runtime:
 
 Static run facts ride in the same JSON via `set_info`: the trainer
 publishes the gradient-exchange plan as `info.comm` (per-step wire
-bytes, bucket count and sizes, wire dtype, mode) so every pipeline-
-metrics artifact states what the exchange cost.
+bytes, bucket count and sizes, wire dtype, mode), the resolved
+fault-injection plan as `info.faults` (tools/chaos.py — {"active":
+false} on clean runs, the exact injectors otherwise, so every drill
+and bench artifact is self-describing), and the sync-mode policy +
+final exchange counts as `info.sync` (COS_SYNC_MODE, K/staleness,
+exchanges / skipped / adopted / timeouts / max_gap).  The relaxed
+sync modes also record a `sync_exchange` stage series (host-side
+round-average / global-merge wall time).
 
 Stages are NOT disjoint when staging (and, on the inline path, packing)
 runs synchronously inside next(gen): there queue_wait SUBSUMES the pack

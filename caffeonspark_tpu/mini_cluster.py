@@ -100,7 +100,19 @@ class MiniCluster:
         except Exception:
             pass
 
-        distributed_init(args.server, args.cluster, args.rank)
+        # sync-mode policy (COS_SYNC_MODE, parallel/syncmode.py):
+        # lockstep joins the global jax.distributed mesh as always;
+        # the relaxed modes (local_sgd/async) deliberately DO NOT —
+        # each rank trains on its own local devices and exchanges
+        # parameters host-side through the shared-filesystem store,
+        # which is what makes the fleet elastic (no collective to hang
+        # when a rank dies, no rendezvous to block a rejoiner)
+        from .parallel.syncmode import resolve_policy
+        self.sync_policy = resolve_policy()
+        self.elastic = (self.sync_policy.elastic
+                        and (args.cluster or 1) > 1)
+        if not self.elastic:
+            distributed_init(args.server, args.cluster, args.rank)
 
         from .config import resolve_net_path
         self.sp = read_solver(args.solver)
@@ -145,10 +157,14 @@ class MiniCluster:
         # the process rank: tp/sp ranks share replicated activations,
         # so their dropout masks / augmentation streams must be
         # identical, while dp ranks decorrelate (CaffeNet.cpp:614-618
-        # seed = seed + device semantics, mesh-aware)
+        # seed = seed + device semantics, mesh-aware).  Elastic modes
+        # have no global mesh — the process rank IS the dp coordinate
+        # there, so augment/dropout streams decorrelate across ranks.
         from .parallel import dp_data_rank
+        rng_rank = (args.rank or 0) if self.elastic \
+            else dp_data_rank(mesh)[0]
         self.solver = Solver(self.sp, self.net_param,
-                             rank=dp_data_rank(mesh)[0], dtype=dtype,
+                             rank=rng_rank, dtype=dtype,
                              compute_dtype=compute)
         self.psolver = ParallelSolver(self.solver, mesh)
         self.args = args
@@ -205,15 +221,45 @@ class MiniCluster:
             params = ps.shard_params(params)
             print(f"finetuning from {self.args.weights}")
 
+        # unified chaos layer (tools/chaos.py): every COS_FAULT_* knob
+        # resolves here, once, host-side; the active plan rides in the
+        # metrics artifact as info.faults so drills self-describe
+        from .tools.chaos import make_injector
+        inj = make_injector(self.args.rank or 0)
+        # elastic sync modes (COS_SYNC_MODE=local_sgd|async): the
+        # host-side exchange object over the shared store.  A
+        # (re)joining rank adopts the newest AVERAGED state — it wins
+        # over -snapshot (which may be a full round older): this is
+        # how a relaunched rank re-admits at the next round instead of
+        # rewinding the fleet
+        from .parallel.syncmode import make_sync
+        sync = make_sync(self.sync_policy, self.args.output,
+                         self.args.rank or 0, chaos=inj) \
+            if self.elastic else None
+        if sync is not None:
+            g = sync.adopt_latest(int(jax.device_get(st.iter)))
+            if g is not None:
+                params = ps.place_host_params(g["params"], params)
+                st = ps.set_iter(st, g["iter"])
+                print(f"rejoined pack at iter {g['iter']} from "
+                      f"averaged state v{g['version']}", flush=True)
+
         data_layers = solver.train_net.data_layers
         if not data_layers:
             raise ValueError("train net has no data layer")
         # data sharding follows the mesh's dp axis, not the process
         # rank: on a tp/sp-only multi-host mesh every process feeds
         # the SAME records (parallel.mesh.dp_data_rank) — process-rank
-        # sharding would hand each model shard different data
+        # sharding would hand each model shard different data.
+        # Elastic modes have no global mesh: the process rank shards
+        # the data (a permanently-departed rank's slice is simply not
+        # revisited this run — the epoch-level cost of elasticity).
         from .parallel import dp_data_rank
-        data_rank, data_ranks = dp_data_rank(self.mesh)
+        if self.elastic:
+            data_rank, data_ranks = (self.args.rank or 0,
+                                     self.args.cluster or 1)
+        else:
+            data_rank, data_ranks = dp_data_rank(self.mesh)
         src = get_source(data_layers[0], phase_train=True,
                          rank=data_rank, num_ranks=data_ranks,
                          seed=int(self.sp.random_seed)
@@ -336,10 +382,16 @@ class MiniCluster:
         # effective chunk size.
         k_loop = steps_per_loop()
         fused_step = ps.train_step_many(k_loop) if k_loop > 1 else None
+        # sync-mode exchanges are loop boundaries too: a fused chunk
+        # must never cross an averaging round / staleness sync point
+        # (local_sgd with COS_STEPS_PER_LOOP=K IS "K local steps in
+        # one dispatch, then one exchange")
+        sync_boundary = (self.sync_policy.boundary
+                         if sync is not None else 0)
         batches_it = chunked_feed(
             batches_it, start_iter=it, max_iter=max_iter, k=k_loop,
             boundaries=(display, test_interval if interleave else 0,
-                        snap_every),
+                        snap_every, sync_boundary),
             metrics=pmetrics)
         gen = device_prefetch(batches_it, depth=stage_depth(),
                               sharding=ps.input_shardings(),
@@ -355,62 +407,52 @@ class MiniCluster:
         timer = StepTimer(batch_size=src.batch_size)
         timer.start()
         smoothed = None
-        # fault-injection for failure drills (tests/test_multihost*.py):
-        # COS_FAULT_STEP_DELAY_MS widens the window in which a rank can
-        # be killed mid-run; COS_FAULT_DIE_ONCE="rank:iter:marker_path"
-        # makes that rank exit(3) at that iter ONCE (the marker file
-        # suppresses the fault after a supervisor relaunch)
-        fault_delay = float(
-            os.environ.get("COS_FAULT_STEP_DELAY_MS", "0") or 0) / 1e3
-        # gradient-exchange accounting + injected comm floor
-        # (scripts/bench_gradsync.py): publish the COS_GRAD_SYNC plan
-        # into the step-timeline JSON, and — when
-        # COS_FAULT_COMM_NS_PER_BYTE is set — sleep the modeled
-        # EXPOSED wire time per solver step (per-byte floor on the
-        # plan's non-hidden bytes + per-message latency,
-        # COS_FAULT_COMM_LAT_US; COS_FAULT_COMM_LOCAL is the modeled
-        # intra-host group size the hier mode divides the slow hop by).
-        # Same technique as the 45 ms dispatch floor in bench_steploop:
-        # on a CPU-only box the floor IS the controlled variable.
+        # fault injection for drills and benches is fully resolved in
+        # `inj` (tools/chaos.py): step delay widens kill windows,
+        # die-once kills a rank at an iter exactly once, slow-rank is
+        # the straggler injector, and the comm floor sleeps the
+        # gradsync plan's modeled EXPOSED wire time per step (same
+        # technique as bench_steploop's 45 ms dispatch floor: on a
+        # CPU-only box the floor IS the controlled variable).  The
+        # resolved plan is published so every artifact states what was
+        # injected.
+        pmetrics.set_info("faults", inj.plan.describe())
+        pmetrics.set_info("sync", self.sync_policy.describe())
         gs = getattr(solver, "grad_sync", None)
         comm_sleep = 0.0
         if gs is not None:
             pmetrics.set_info("comm", gs.plan.comm_info())
-            comm_ns = float(
-                os.environ.get("COS_FAULT_COMM_NS_PER_BYTE", "0") or 0)
-            if comm_ns > 0:
-                lat_us = float(
-                    os.environ.get("COS_FAULT_COMM_LAT_US", "0") or 0)
-                local = int(
-                    os.environ.get("COS_FAULT_COMM_LOCAL", "1") or 1)
-                hide = os.environ.get("COS_FAULT_COMM_HIDE_BYTES", "")
-                exposed = gs.plan.exposed_wire_bytes(
-                    local_size=local,
-                    hide_bytes=int(float(hide)) if hide else None)
-                comm_sleep = (exposed * comm_ns
-                              + gs.plan.n_messages * lat_us * 1e3) / 1e9
-        die_once = os.environ.get("COS_FAULT_DIE_ONCE", "")
-        die_rank = die_iter = -1
-        die_marker = ""
-        if die_once:
-            r_, i_, die_marker = die_once.split(":", 2)
-            die_rank, die_iter = int(r_), int(i_)
+            comm_sleep = inj.plan.comm.sleep_seconds(gs.plan)
+
+        # host-side param exchange callbacks for the sync modes (the
+        # rebinding closure: an adopted/averaged state replaces the
+        # live pytree between dispatches)
+        def _sync_get():
+            return ps.host_params(params)
+
+        def _sync_put(flat):
+            nonlocal params
+            params = ps.place_host_params(flat, params)
+
+        if sync is not None:
+            sync.on_start(it)
+        # two clocks: `it` is the PACK clock (LR schedule, sync
+        # boundaries, logging — a re-admission jump moves it), while
+        # `sched_it` advances exactly with consumed chunks and drives
+        # the display/validation/snapshot conditions — chunked_feed
+        # ends chunks on ITS counter's boundaries, so the conditions
+        # must use the same arithmetic or a jump would silently
+        # disable every boundary action for the rest of the run.
+        # Lockstep never jumps: the clocks are identical there and the
+        # conditions compute exactly what they always did.  Jumps are
+        # multiples of the sync boundary k, so `it` and `sched_it`
+        # stay congruent mod k and exchange boundaries keep firing.
+        sched_it = it
         try:
             with profile_trace(self.args.profile):
                 while it < max_iter and not self._stop:
-                    if fault_delay:
-                        time.sleep(fault_delay)
-                    # >= not ==: with COS_STEPS_PER_LOOP>1 the counter
-                    # advances in chunks and may never equal die_iter —
-                    # die at the first dispatch at-or-after it (the
-                    # marker file keeps this one-shot)
-                    if (die_iter >= 0 and it >= die_iter
-                            and (self.args.rank or 0) == die_rank
-                            and not os.path.exists(die_marker)):
-                        open(die_marker, "w").close()
-                        print(f"FAULT INJECTION: rank {die_rank} dying at "
-                              f"iter {it}", flush=True)
-                        os._exit(3)
+                    inj.step_delay()
+                    inj.maybe_die(it)
                     t_wait = time.perf_counter()
                     n, batch = next(gen)
                     pmetrics.add("queue_wait",
@@ -428,6 +470,9 @@ class MiniCluster:
                         it += n
                         pmetrics.add_chunk(
                             n, time.perf_counter() - t_step)
+                    sched_it += n
+                    # straggler injector: this rank runs factor× slower
+                    inj.slow_sleep(time.perf_counter() - t_step)
                     if comm_sleep:
                         # one exchange per solver step, fused or not;
                         # n per-step samples so the series stays
@@ -435,8 +480,28 @@ class MiniCluster:
                         time.sleep(comm_sleep * n)
                         for _ in range(n):
                             pmetrics.add("comm", comm_sleep)
+                    if sync is not None:
+                        t_x = time.perf_counter()
+                        new_it = sync.maybe_exchange(it, _sync_get,
+                                                     _sync_put)
+                        if sync_boundary and (new_it != it
+                                              or it % sync_boundary
+                                              == 0):
+                            pmetrics.add("sync_exchange",
+                                         time.perf_counter() - t_x)
+                        if new_it != it:
+                            # re-admission: the exchange fast-forwarded
+                            # us to the pack's clock — the LR schedule
+                            # follows via the opt-state counter
+                            print(f"sync: re-admitted at iter {new_it}"
+                                  f" (was {it})", flush=True)
+                            it = new_it
+                            st = ps.set_iter(st, it)
                     timer.tick(n)
-                    if display and it % display == 0:
+                    # boundary actions fire on the SCHEDULE clock (see
+                    # the sched_it note above) — identical to `it` in
+                    # lockstep, chunk-aligned after an elastic jump
+                    if display and sched_it % display == 0:
                         # fused chunks stack outputs (K, …); the chunk
                         # schedule ends chunks ON display boundaries,
                         # so the last slice is this iteration's value
@@ -464,7 +529,7 @@ class MiniCluster:
                                      "records_per_sec": round(
                                          timer.records_per_sec, 1),
                                      "ts": time.time()}) + "\n")
-                    if interleave and it % test_interval == 0:
+                    if interleave and sched_it % test_interval == 0:
                         for _ in range(test_iter):
                             vb = val_src.apply_device_stage(
                                 _stage_val(next(val_gen)),
@@ -483,7 +548,7 @@ class MiniCluster:
                                 it, " ".join(f"{n}={v:.4f}"
                                              for n, v in row.items())),
                                 flush=True)
-                    if (snap_every and it % snap_every == 0) \
+                    if (snap_every and sched_it % snap_every == 0) \
                             or self._want_snapshot:
                         signalled = self._want_snapshot
                         self._want_snapshot = False
@@ -504,7 +569,7 @@ class MiniCluster:
                                   "sidecar set will be incomplete",
                                   file=sys.stderr)
                         lockstep = bool(snap_every
-                                        and it % snap_every == 0)
+                                        and sched_it % snap_every == 0)
                         if not lockstep \
                                 and checkpoint.params_partitioned(params):
                             # signal-only snapshot with cross-host tp/ep
@@ -543,6 +608,11 @@ class MiniCluster:
                 pass
             if feed is not None:
                 feed.close()
+            if sync is not None:
+                # mark done so peers' soft barriers stop expecting us,
+                # and land the final exchange counts in the artifact
+                sync.finalize(it)
+                pmetrics.set_info("sync", sync.info())
             if self._is_rank0 and self.args.pipeline_metrics \
                     and pmetrics.has_samples():
                 try:

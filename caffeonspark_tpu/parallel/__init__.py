@@ -17,3 +17,5 @@ from .mesh import (MeshLayout, build_mesh, data_sharding,
                    parse_mesh_spec, replicated)
 from .pp import PipelineSolver, partition_layers
 from .sp import attention, ring_attention, sp_shard_time
+from .syncmode import (AsyncSync, LocalSGDSync, ParamStore, SyncPolicy,
+                       env_sync_mode, make_sync, resolve_policy)

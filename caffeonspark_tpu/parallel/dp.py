@@ -140,6 +140,38 @@ class ParallelSolver:
     def shard_params(self, params) -> Dict:
         return self.layout.place_params(params)
 
+    # -- host-side param exchange (sync modes) -------------------------
+    def host_params(self, params) -> Dict[str, "np.ndarray"]:
+        """Flat host copy of the live params, for the elastic sync
+        modes' host-side exchange (parallel/syncmode.py) — the local
+        mesh's layout is erased (device_get densifies local shards),
+        so ranks with different local meshes can still average."""
+        from ..checkpoint import flatten_host_params
+        return flatten_host_params(params)
+
+    def place_host_params(self, flat: Dict[str, "np.ndarray"],
+                          like) -> Dict:
+        """Inverse of host_params: place a flat (f32) host dict back
+        onto the mesh with each blob cast to the dtype of the current
+        params `like` (the store's averaging math runs f32 regardless
+        of the net dtype)."""
+        from ..checkpoint import unflatten_host_params
+        host = unflatten_host_params(flat)
+        cast = {ln: {bn: np.asarray(arr, like[ln][bn].dtype)
+                     for bn, arr in bl.items()}
+                for ln, bl in host.items()}
+        return self.shard_params(cast)
+
+    def set_iter(self, st: OptState, it: int) -> OptState:
+        """Rebuild the opt-state iteration counter (elastic re-
+        admission fast-forwards a rank to the pack's clock; the LR
+        schedule must follow)."""
+        import jax.numpy as jnp
+        return OptState(
+            iter=jax.device_put(jnp.asarray(int(it), jnp.int32),
+                                self.repl),
+            history=st.history, history2=st.history2)
+
     def shard_opt_state(self, st: OptState) -> OptState:
         hist = {ln: {bn: jax.device_put(arr, self.state_sharding[ln][bn])
                      for bn, arr in blobs.items()}

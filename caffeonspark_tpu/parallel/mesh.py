@@ -50,6 +50,16 @@ def distributed_init(coordinator: Optional[str] = None,
     analog).  No-op for single-process runs."""
     if coordinator is None:
         return
+    # CPU backends need the gloo collectives implementation for real
+    # cross-process collectives (the default CPU client rejects
+    # "multiprocess computations"): the multihost failure drills and
+    # the lockstep leg of scripts/bench_syncmode.py run 2-4 CPU ranks
+    # through here.  Must be set BEFORE the backend initializes; inert
+    # on accelerator backends, best-effort across jax versions.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:           # noqa: BLE001 — flag name drifts
+        pass
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)

@@ -1,0 +1,720 @@
+"""Sync-mode layer (COS_SYNC_MODE): lockstep | local_sgd | async.
+
+Training so far is synchronous lockstep — every rank joins one
+jax.distributed mesh and every step's gradient all-reduce is a fleet-
+wide barrier, so one slow or dead rank stalls the whole fleet (the
+failure CaffeOnSpark inherited from its peer-to-peer all-reduce).
+SparkNet (periodic model averaging) and DeepSpark (asynchronous
+updates with explicit staleness bounds on commodity clusters) — both
+in PAPERS.md — show that relaxed sync modes recover throughput under
+heterogeneous capacity without giving up convergence.  This module is
+that relaxation, beside `COS_GRAD_SYNC` (which tunes HOW the lockstep
+exchange moves bytes; this layer tunes WHETHER steps synchronize at
+all):
+
+  COS_SYNC_MODE=lockstep   today's behavior, byte-identical — no sync
+                           object is even constructed, the training
+                           path is untouched (the same inertness
+                           contract as COS_GRAD_SYNC=default)
+  COS_SYNC_MODE=local_sgd  SparkNet-style: each rank runs K local
+                           steps (the PR 4 fused loop makes a round
+                           ONE dispatch), then the fleet averages
+                           parameters once — one exchange per K steps,
+                           the ultimate comm amortization.  The round
+                           barrier is SOFT: only live ranks within one
+                           round of the boundary are waited for (a
+                           straggler >1 round behind detaches and
+                           adopts the pack average when it arrives;
+                           a dead rank drops out after its heartbeat
+                           goes stale), so the pack is never stalled.
+  COS_SYNC_MODE=async      DeepSpark-style bounded staleness: ranks
+                           never barrier at all — each rank merges its
+                           params into a versioned global state at
+                           least every S steps (S = the staleness
+                           bound).  A rank's params are therefore
+                           never more than S of its own steps away
+                           from the last global sync; if the merge
+                           cannot land (lock contention, flaky
+                           storage) the rank WAITS and retries — fast
+                           ranks proceed up to S steps ahead, then
+                           wait on the sync, never on the straggler.
+
+Ranks in the relaxed modes do NOT join a global jax.distributed mesh:
+each process trains on its own local devices (any local dp/tp mesh —
+COS_GRAD_SYNC still applies to that intra-rank exchange, which is how
+the wire modes compose), and the cross-rank exchange is host-side
+through a shared-filesystem `ParamStore` in the run's output directory
+(NFS on pods — the same shared-storage assumption the supervisor's
+snapshot resume already makes).  That is precisely what makes the
+fleet ELASTIC: there is no collective to hang when a rank dies, the
+pack just stops waiting for it (heartbeat timeout), and a relaunched
+rank re-admits itself by adopting the latest averaged state at the
+next round (`adopt_latest`).
+
+Knobs (docs/tuning.md has the full table):
+
+  COS_SYNC_MODE                 lockstep (default) | local_sgd | async
+  COS_SYNC_K                    local steps per averaging round
+                                (local_sgd; default 8)
+  COS_SYNC_STALENESS            max local steps between global merges
+                                (async; default 8)
+  COS_SYNC_ALPHA                async merge weight (default 0 = auto:
+                                1/live_ranks)
+  COS_SYNC_ROUND_TIMEOUT_S      soft-barrier cap per round (default 30)
+  COS_SYNC_HEARTBEAT_TIMEOUT_S  silence before a rank counts as dead
+                                (default 10)
+  COS_SYNC_WIRE_DTYPE           float32 (default) | bfloat16 — dtype
+                                of the published param payload (the
+                                gradsync wire-dtype idea applied to
+                                the averaging exchange; averaging math
+                                stays f32)
+
+Fault injection composes through `tools/chaos.py`: a flaky-exchange
+fault makes local_sgd SKIP the round (round semantics tolerate a
+missing contribution) but makes async RETRY (the staleness bound is a
+promise); flaky-storage faults are absorbed by the store's own retry
+loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+# one repo-wide env-number parser (utils/envutils.py) — strict flavor:
+# a mistyped COS_SYNC_* knob is a config error worth failing loudly on
+from ..utils.envutils import env_num as _env_num
+
+MODES = ("lockstep", "local_sgd", "async")
+WIRE_DTYPES = ("float32", "bfloat16")
+
+# flat param keys are "<layer>::<blob>" (checkpoint.flatten_host_params)
+KEY_SEP = "::"
+
+HostFlat = Dict[str, np.ndarray]
+
+
+def env_sync_mode() -> str:
+    m = os.environ.get("COS_SYNC_MODE", "lockstep").strip().lower()
+    if m not in MODES:
+        raise ValueError(
+            f"COS_SYNC_MODE={m!r}: expected one of {'|'.join(MODES)}")
+    return m
+
+
+
+
+class SyncPolicy(NamedTuple):
+    """Resolved sync-mode configuration (env read once, at startup —
+    coslint COS003 discipline, same as GradSync)."""
+    mode: str
+    k: int                        # local steps per round (local_sgd)
+    staleness: int                # max steps between merges (async)
+    alpha: float                  # async merge weight (0 = 1/live)
+    round_timeout_s: float
+    heartbeat_timeout_s: float
+    wire_dtype: str
+
+    @property
+    def elastic(self) -> bool:
+        """Relaxed modes run without a global mesh: ranks may join and
+        leave mid-run."""
+        return self.mode != "lockstep"
+
+    @property
+    def boundary(self) -> int:
+        """The iteration interval exchanges happen on — fed to the
+        fused-loop chunk schedule so no chunk crosses an exchange."""
+        if self.mode == "local_sgd":
+            return self.k
+        if self.mode == "async":
+            return self.staleness
+        return 0
+
+    def describe(self) -> dict:
+        out = {"mode": self.mode}
+        if self.mode == "local_sgd":
+            out["k"] = self.k
+        if self.mode == "async":
+            out["staleness"] = self.staleness
+            out["alpha"] = self.alpha or "auto(1/live)"
+        if self.elastic:
+            out["round_timeout_s"] = self.round_timeout_s
+            out["heartbeat_timeout_s"] = self.heartbeat_timeout_s
+            out["wire_dtype"] = self.wire_dtype
+        return out
+
+
+def resolve_policy(mode: Optional[str] = None) -> SyncPolicy:
+    mode = env_sync_mode() if mode is None else mode
+    if mode not in MODES:
+        raise ValueError(f"sync mode {mode!r}: expected one of "
+                         f"{'|'.join(MODES)}")
+    k = int(_env_num("COS_SYNC_K", 8))
+    s = int(_env_num("COS_SYNC_STALENESS", 8))
+    if k < 1 or s < 1:
+        raise ValueError("COS_SYNC_K / COS_SYNC_STALENESS must be >= 1")
+    wire = os.environ.get("COS_SYNC_WIRE_DTYPE",
+                          "float32").strip().lower()
+    if wire not in WIRE_DTYPES:
+        raise ValueError(
+            f"COS_SYNC_WIRE_DTYPE={wire!r}: expected one of "
+            f"{'|'.join(WIRE_DTYPES)}")
+    return SyncPolicy(
+        mode=mode, k=k, staleness=s,
+        alpha=float(_env_num("COS_SYNC_ALPHA", 0.0)),
+        round_timeout_s=_env_num("COS_SYNC_ROUND_TIMEOUT_S", 30.0),
+        heartbeat_timeout_s=_env_num("COS_SYNC_HEARTBEAT_TIMEOUT_S",
+                                     10.0),
+        wire_dtype=wire)
+
+
+# ---------------------------------------------------------------------------
+# wire encode/decode: the published payload's dtype (averaging stays f32)
+def _encode_wire(flat: HostFlat, wire: str) -> Dict[str, np.ndarray]:
+    if wire == "bfloat16":
+        import ml_dtypes
+        # npz has no bf16: ship the raw 16-bit pattern, tagged
+        out = {k: np.asarray(v, ml_dtypes.bfloat16).view(np.uint16)
+               for k, v in flat.items()}
+        out["__wire__"] = np.asarray(1, np.int32)
+        return out
+    return {k: np.asarray(v, np.float32) for k, v in flat.items()}
+
+
+def _decode_wire(npz) -> HostFlat:
+    if "__wire__" in npz:
+        import ml_dtypes
+        return {k: np.asarray(npz[k].view(ml_dtypes.bfloat16),
+                              np.float32)
+                for k in npz.files if k != "__wire__"}
+    return {k: np.asarray(npz[k], np.float32) for k in npz.files}
+
+
+def average_flats(flats: List[HostFlat]) -> HostFlat:
+    """Equal-weight f32 mean over contributions (SparkNet's periodic
+    model average).  Every contribution must carry the same keys — a
+    mismatch means two ranks compiled different nets, which is a
+    config error worth failing loudly on."""
+    if not flats:
+        raise ValueError("average_flats: no contributions")
+    keys = set(flats[0])
+    for f in flats[1:]:
+        if set(f) != keys:
+            raise ValueError("param-average key mismatch between "
+                             "contributions (different nets?)")
+    n = float(len(flats))
+    return {k: sum(np.asarray(f[k], np.float32) for f in flats) / n
+            for k in keys}
+
+
+# ---------------------------------------------------------------------------
+class ParamStore:
+    """Shared-filesystem parameter store: heartbeats, per-round
+    contributions, and a versioned global (averaged) state.
+
+    All writes are atomic (tmp + os.replace) so readers only ever see
+    complete files; all I/O runs under a short retry loop that absorbs
+    transient failures — including the ones `COS_FAULT_FLAKY_STORAGE`
+    injects.  The root lives in the run's output directory
+    (`<output>/.sync`), the same shared-storage assumption the
+    supervisor's snapshot resume makes (NFS on pods; object stores
+    without atomic rename are out of scope for the store)."""
+
+    RETRIES = 8
+    RETRY_BASE_S = 0.005
+    LOCK_STALE_S = 10.0
+
+    def __init__(self, root: str, rank: int, policy: SyncPolicy,
+                 chaos=None):
+        self.root = root
+        self.rank = int(rank)
+        self.policy = policy
+        self.chaos = chaos          # ChaosInjector or None
+        os.makedirs(root, exist_ok=True)
+        self._last_hb = 0.0
+
+    # -- I/O core ------------------------------------------------------
+    def _retry(self, fn: Callable, what: str):
+        import zipfile
+        last = None
+        for attempt in range(self.RETRIES):
+            try:
+                if self.chaos is not None:
+                    self.chaos.storage_fault()
+                return fn()
+            except (OSError, ValueError, json.JSONDecodeError,
+                    KeyError, EOFError,
+                    zipfile.BadZipFile) as e:  # noqa: PERF203
+                last = e
+                time.sleep(self.RETRY_BASE_S * (2 ** attempt))
+        raise OSError(f"ParamStore: {what} failed after "
+                      f"{self.RETRIES} attempts") from last
+
+    def _write_atomic(self, name: str, writer: Callable[[str], None]):
+        path = os.path.join(self.root, name)
+        tmp = f"{path}.tmp.{os.getpid()}"
+
+        def _do():
+            writer(tmp)
+            os.replace(tmp, path)
+
+        try:
+            self._retry(_do, f"write {name}")
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    def _write_json(self, name: str, obj: dict):
+        def w(tmp):
+            with open(tmp, "w") as f:
+                json.dump(obj, f)
+        self._write_atomic(name, w)
+
+    def _read_json(self, name: str) -> Optional[dict]:
+        path = os.path.join(self.root, name)
+        if not os.path.exists(path):
+            return None
+
+        def r():
+            with open(path) as f:
+                return json.load(f)
+        return self._retry(r, f"read {name}")
+
+    def _write_npz(self, name: str, flat: HostFlat):
+        payload = _encode_wire(flat, self.policy.wire_dtype)
+
+        def w(tmp):
+            with open(tmp, "wb") as f:
+                np.savez(f, **payload)
+        self._write_atomic(name, w)
+
+    def _read_npz(self, name: str) -> HostFlat:
+        path = os.path.join(self.root, name)
+
+        def r():
+            with np.load(path) as npz:
+                return _decode_wire(npz)
+        return self._retry(r, f"read {name}")
+
+    # -- heartbeats / membership ---------------------------------------
+    def heartbeat(self, it: int, *, done: bool = False,
+                  force: bool = False):
+        """Publish liveness + progress.  Rate-limited off the hot path
+        (the step loop calls this every dispatch); exchange boundaries
+        force a write so membership sees boundary-accurate progress."""
+        now = time.time()
+        min_gap = min(1.0, self.policy.heartbeat_timeout_s / 4.0)
+        if not force and not done and now - self._last_hb < min_gap:
+            return
+        self._last_hb = now
+        self._write_json(f"hb_rank{self.rank}.json",
+                         {"rank": self.rank, "iter": int(it),
+                          "ts": now, "done": bool(done)})
+
+    def members(self) -> Dict[int, dict]:
+        """Every rank ever seen: rank -> {iter, ts, done, live}."""
+        now = time.time()
+        out: Dict[int, dict] = {}
+        for name in os.listdir(self.root):
+            if not (name.startswith("hb_rank")
+                    and name.endswith(".json")):
+                continue
+            hb = self._read_json(name)
+            if hb is None:
+                continue
+            hb["live"] = (not hb.get("done")
+                          and now - hb["ts"]
+                          <= self.policy.heartbeat_timeout_s)
+            out[int(hb["rank"])] = hb
+        return out
+
+    def live_ranks(self) -> Dict[int, int]:
+        """rank -> last-heartbeat iter, live (fresh, not done) only."""
+        return {r: hb["iter"] for r, hb in self.members().items()
+                if hb["live"]}
+
+    # -- local_sgd rounds ----------------------------------------------
+    def _round_name(self, rnd: int, rank: int) -> str:
+        return f"round_{rnd:08d}_rank{rank}.npz"
+
+    def publish_round(self, rnd: int, flat: HostFlat):
+        self._write_npz(self._round_name(rnd, self.rank), flat)
+
+    def round_ranks(self, rnd: int) -> List[int]:
+        prefix = f"round_{rnd:08d}_rank"
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith(prefix) and name.endswith(".npz"):
+                out.append(int(name[len(prefix):-len(".npz")]))
+        return sorted(out)
+
+    def read_round(self, rnd: int) -> Dict[int, HostFlat]:
+        out = {}
+        for r in self.round_ranks(rnd):
+            try:
+                out[r] = self._read_npz(self._round_name(rnd, r))
+            except OSError:
+                # a contribution that cannot be read after retries is
+                # treated like a rank that missed the round
+                continue
+        return out
+
+    # -- global (averaged) state ---------------------------------------
+    def publish_global(self, version: int, it: int,
+                       members: List[int], flat: HostFlat):
+        fname = f"global_v{version:08d}.npz"
+        self._write_npz(fname, flat)
+        self._write_json("global.json",
+                         {"version": int(version), "iter": int(it),
+                          "members": sorted(int(m) for m in members),
+                          "file": fname, "ts": time.time()})
+        self._gc(version)
+
+    def latest_global_meta(self) -> Optional[dict]:
+        return self._read_json("global.json")
+
+    def load_global(self) -> Optional[dict]:
+        """Latest averaged state: meta dict + 'params' HostFlat."""
+        meta = self.latest_global_meta()
+        if meta is None:
+            return None
+        meta = dict(meta)
+        meta["params"] = self._read_npz(meta["file"])
+        return meta
+
+    def _gc(self, version: int):
+        """Best-effort cleanup: keep the last two globals and the last
+        three rounds' contributions (a detached straggler may still be
+        reading slightly-old files; anything older is garbage)."""
+        for name in os.listdir(self.root):
+            try:
+                if name.startswith("global_v") and name.endswith(".npz"):
+                    v = int(name[len("global_v"):-len(".npz")])
+                    if v <= version - 2:
+                        os.unlink(os.path.join(self.root, name))
+                elif name.startswith("round_"):
+                    rnd = int(name[len("round_"):len("round_") + 8])
+                    if rnd <= version - 3:
+                        os.unlink(os.path.join(self.root, name))
+            except (OSError, ValueError):
+                continue
+
+    # -- async merge lock ----------------------------------------------
+    def lock_global(self) -> bool:
+        """Try-acquire the merge lock (O_EXCL create).  A lock older
+        than LOCK_STALE_S is broken — its holder died mid-merge.  The
+        break itself is a RENAME, not an unlink: exactly one contender
+        wins the rename (the rest get ENOENT and simply retry), so two
+        waiters can never both "break" the same lock and overlap their
+        merges; the winner still re-acquires through O_EXCL on its next
+        attempt rather than inheriting the lock."""
+        path = os.path.join(self.root, "global.lock")
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, json.dumps(
+                {"rank": self.rank, "ts": time.time()}).encode())
+            os.close(fd)
+            return True
+        except FileExistsError:
+            try:
+                age = time.time() - os.path.getmtime(path)
+                if age > self.LOCK_STALE_S:
+                    broken = f"{path}.broken.{os.getpid()}"
+                    os.rename(path, broken)
+                    os.unlink(broken)
+            except OSError:
+                pass
+            return False
+
+    def unlock_global(self):
+        try:
+            os.unlink(os.path.join(self.root, "global.lock"))
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+class _SyncBase:
+    """Common machinery for the relaxed modes.  The trainer's step loop
+    calls `maybe_exchange(it, get, put)` after every dispatch; `get`
+    returns the host (flat f32) params, `put` places a flat dict back
+    onto the devices.  The call returns the rank's iteration — USUALLY
+    `it` unchanged, but a detached straggler or a rejoiner is fast-
+    forwarded to the pack's clock when it adopts the pack average (the
+    re-admission: from then on its boundaries align with the pack's
+    and it contributes again).  At startup `adopt_latest()` offers the
+    newest averaged state for the elastic rejoin path."""
+
+    def __init__(self, policy: SyncPolicy, store: ParamStore,
+                 rank: int, chaos=None):
+        self.policy = policy
+        self.store = store
+        self.rank = int(rank)
+        self.chaos = chaos
+        self._last_exchange = 0
+        self.counts = {"exchanges": 0, "skipped": 0, "adopted": 0,
+                       "timeouts": 0}
+        self.max_gap = 0
+
+    # -- rejoin --------------------------------------------------------
+    def adopt_latest(self, after_iter: int = -1) -> Optional[dict]:
+        """Newest averaged state from the store STRICTLY ahead of
+        `after_iter`, for a (re)joining rank: {'iter', 'version',
+        'params'} or None.  The caller jumps its iteration to 'iter'
+        so it re-admits at the next round; the adoption is only
+        counted when a usable state is actually returned."""
+        meta = self.store.latest_global_meta()
+        if meta is None or meta["iter"] <= after_iter:
+            return None
+        g = self.store.load_global()
+        if g is None or g["iter"] <= after_iter:
+            return None
+        self.counts["adopted"] += 1
+        return g
+
+    def on_start(self, it: int):
+        self._last_exchange = it
+        self.store.heartbeat(it, force=True)
+
+    def finalize(self, it: int):
+        """Mark this rank done so peers' soft barriers stop expecting
+        it immediately instead of after a heartbeat timeout."""
+        try:
+            self.store.heartbeat(it, done=True, force=True)
+        except OSError:
+            pass
+
+    def info(self) -> dict:
+        out = dict(self.policy.describe())
+        out.update(self.counts)
+        out["max_gap"] = self.max_gap
+        if self.chaos is not None:
+            out.update(self.chaos.injected)
+        return out
+
+    # -- shared helpers ------------------------------------------------
+    def _at_boundary(self, it: int, interval: int) -> bool:
+        return (it > 0 and it % interval == 0
+                and it != self._last_exchange)
+
+    def _adopt(self, put: Callable[[HostFlat], None]) -> Optional[int]:
+        """Adopt the pack's averaged state and jump to its clock."""
+        g = self.store.load_global()
+        if g is None:
+            return None
+        put(g["params"])
+        self.counts["adopted"] += 1
+        self._last_exchange = int(g["iter"])
+        self.store.heartbeat(self._last_exchange, force=True)
+        return self._last_exchange
+
+    def maybe_exchange(self, it: int,
+                       get: Callable[[], HostFlat],
+                       put: Callable[[HostFlat], None]) -> int:
+        raise NotImplementedError
+
+
+class LocalSGDSync(_SyncBase):
+    """SparkNet-style periodic model averaging with a SOFT round
+    barrier: wait (up to round_timeout_s) only for live, attached
+    ranks within one round of this boundary.  Detachment is STICKY —
+    a rank that times out a round is not waited for again until its
+    contribution actually shows up in a current round (otherwise a
+    persistent straggler sitting exactly one round behind would tax
+    the pack a full slow-round EVERY round).  A detached straggler
+    that reaches a boundary and finds the pack's global state ahead
+    drops its stale round, adopts the average, and jumps to the
+    pack's clock — the same re-admission path a supervisor-relaunched
+    rank takes; if it then keeps pace, its next contribution lands in
+    a live round and re-attaches it."""
+
+    POLL_S = 0.05
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._detached: set = set()
+        self._last_boundary_t: Optional[float] = None
+
+    def maybe_exchange(self, it, get, put) -> int:
+        k = self.policy.k
+        if not self._at_boundary(it, k):
+            self.store.heartbeat(it)
+            return it
+        prev, self._last_exchange = self._last_exchange, it
+        # adaptive patience: a healthy peer arrives within about one
+        # of OUR round wall-times, so don't wait the full configured
+        # timeout for one that doesn't (round 1 has no measurement —
+        # and carries the jit-compile skew — so it gets the full
+        # timeout)
+        now_t = time.monotonic()
+        own_round = (now_t - self._last_boundary_t
+                     if self._last_boundary_t is not None else None)
+        self._last_boundary_t = now_t
+        patience = self.policy.round_timeout_s
+        if own_round is not None:
+            patience = min(patience,
+                           max(4 * self.POLL_S, 1.5 * own_round))
+        self.store.heartbeat(it, force=True)
+        self.max_gap = max(self.max_gap, it - prev)
+
+        # detached / late: the pack already averaged past this point —
+        # our K steps since the last average are stale against a pack
+        # that moved on; adopt + fast-forward (re-admission)
+        meta = self.store.latest_global_meta()
+        if meta is not None and meta["iter"] > it:
+            new_it = self._adopt(put)
+            if new_it is not None:
+                return new_it
+
+        if self.chaos is not None and self.chaos.exchange_fault():
+            # transient exchange fault: local_sgd SKIPS the round —
+            # round semantics tolerate a missing contribution, and the
+            # next boundary resynchronizes us
+            self.counts["skipped"] += 1
+            return it
+
+        rnd = it // k
+        flat = get()
+        self.store.publish_round(rnd, flat)
+        deadline = time.monotonic() + patience
+        while True:
+            have = set(self.store.round_ranks(rnd))
+            # a detached rank whose contribution shows up in THIS
+            # round is keeping pace again: re-attach it
+            self._detached -= have
+            # the PACK: live, attached ranks within one round of this
+            # boundary (a dead rank's heartbeat goes stale and drops
+            # out; a straggler >1 round behind never qualifies)
+            expected = ({self.rank} | {
+                r for r, hb_it in self.store.live_ranks().items()
+                if hb_it >= it - k}) - self._detached
+            if expected <= have:
+                break
+            if time.monotonic() >= deadline:
+                # whoever kept the pack waiting past the timeout is
+                # detached until they demonstrably keep pace again
+                self._detached |= expected - have - {self.rank}
+                self.counts["timeouts"] += 1
+                break
+            time.sleep(self.POLL_S)
+
+        conts = self.store.read_round(rnd)
+        conts.setdefault(self.rank, flat)
+        avg = average_flats(list(conts.values()))
+        put(avg)
+        # lowest contributing rank publishes the round average as the
+        # new global — the adoption point for rejoiners and the
+        # averaged-state resume
+        if self.rank == min(conts):
+            self.store.publish_global(rnd, it, sorted(conts), avg)
+        self.counts["exchanges"] += 1
+        return it
+
+    def info(self) -> dict:
+        out = super().info()
+        out["detached_now"] = sorted(self._detached)
+        return out
+
+
+class AsyncSync(_SyncBase):
+    """DeepSpark-style bounded staleness without any barrier: at least
+    every `staleness` local steps the rank merges its params into the
+    versioned global state (new = (1-a)·global + a·local, a = 1/live
+    by default, down-weighted by how stale the contribution is) under
+    a short file lock.  The bound is a promise, so a merge that cannot
+    land is RETRIED — the rank waits on the sync, never on a
+    straggler; a rank more than 4 bounds behind re-admits itself by
+    adopting the global state at the pack's clock."""
+
+    # the retry schedule must OUTLAST the lock's stale window (a dead
+    # holder's lock is only breakable after LOCK_STALE_S): ~17s of
+    # capped backoff vs the 10s window
+    MERGE_RETRIES = 16
+    RETRY_BASE_S = 0.05
+    RETRY_CAP_S = 2.0
+
+    def _merge_once(self, it: int, flat: HostFlat) -> HostFlat:
+        if self.chaos is not None and self.chaos.exchange_fault():
+            raise OSError("injected flaky-exchange fault")
+        if not self.store.lock_global():
+            raise OSError("global merge lock busy")
+        try:
+            g = self.store.load_global()
+            live = self.store.live_ranks()
+            if g is None:
+                new, version, members = flat, 1, [self.rank]
+            else:
+                a = self.policy.alpha or 1.0 / max(1, len(live) or 1)
+                # staleness-aware weight: a contribution computed on
+                # params `lag` steps behind the global clock merges
+                # with proportionally less authority (DeepSpark's
+                # staleness-dependent update)
+                lag = max(0, g["iter"] - it)
+                a = a / (1.0 + lag / float(self.policy.staleness))
+                gp = g["params"]
+                new = {k2: (1.0 - a) * gp[k2] + a * np.asarray(
+                    v, np.float32) for k2, v in flat.items()}
+                version = g["version"] + 1
+                members = sorted(set(g.get("members", []))
+                                 | {self.rank})
+            self.store.publish_global(version, max(
+                it, g["iter"] if g else 0), members, new)
+            return new
+        finally:
+            self.store.unlock_global()
+
+    def maybe_exchange(self, it, get, put) -> int:
+        s = self.policy.staleness
+        if not self._at_boundary(it, s):
+            self.store.heartbeat(it)
+            return it
+        prev, self._last_exchange = self._last_exchange, it
+        self.store.heartbeat(it, force=True)
+        self.max_gap = max(self.max_gap, it - prev)
+
+        # hopelessly stale (over 4 staleness bounds behind the global
+        # clock): merging would only drag the average back — re-admit
+        # at the pack's clock instead
+        meta = self.store.latest_global_meta()
+        if meta is not None and meta["iter"] - it > 4 * s:
+            new_it = self._adopt(put)
+            if new_it is not None:
+                return new_it
+
+        flat = get()
+        last = None
+        for attempt in range(self.MERGE_RETRIES):
+            try:
+                new = self._merge_once(it, flat)
+                put(new)
+                self.counts["exchanges"] += 1
+                return it
+            except OSError as e:    # noqa: PERF203 — retry loop
+                last = e
+                time.sleep(min(self.RETRY_CAP_S,
+                               self.RETRY_BASE_S * (1.5 ** attempt)))
+        raise OSError(
+            "async sync: global merge failed after "
+            f"{self.MERGE_RETRIES} attempts — the staleness bound "
+            f"cannot be honored at iter {it}") from last
+
+
+def make_sync(policy: SyncPolicy, output_dir: str, rank: int,
+              chaos=None, store_root: Optional[str] = None
+              ) -> Optional[_SyncBase]:
+    """Sync object for a trainer process, or None for lockstep (the
+    default stays byte-identical by never constructing anything)."""
+    if not policy.elastic:
+        return None
+    root = store_root or os.path.join(output_dir, ".sync")
+    store = ParamStore(root, rank, policy, chaos=chaos)
+    cls = LocalSGDSync if policy.mode == "local_sgd" else AsyncSync
+    return cls(policy, store, rank, chaos=chaos)

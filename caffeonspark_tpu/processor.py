@@ -321,6 +321,18 @@ class CaffeProcessor:
             gs = getattr(solver, "grad_sync", None)
             if gs is not None:
                 self.metrics.set_info("comm", gs.plan.comm_info())
+            # unified chaos layer (tools/chaos.py): the driver path
+            # honors the step-delay / die-once / slow-rank injectors
+            # too, and publishes the resolved plan so every metrics
+            # artifact states what was injected.  The sync-mode policy
+            # rides along (the driver is one process — the relaxed
+            # modes' cross-rank exchange lives in mini_cluster; here
+            # lockstep IS the only shape, but the artifact says so).
+            from .tools.chaos import make_injector
+            inj = make_injector(self.rank)
+            self.metrics.set_info("faults", inj.plan.describe())
+            self.metrics.set_info(
+                "sync", getattr(solver, "sync_policy").describe())
             step = ps.train_step()
             eval_step = (ps.eval_step()
                          if solver.test_net is not None else None)
@@ -421,6 +433,8 @@ class CaffeProcessor:
             params, st = self.params, self.opt_state
             m = self.metrics
             while True:
+                inj.step_delay()
+                inj.maybe_die(it)
                 t_wait = time.perf_counter()
                 try:
                     n, batch = next(gen)
@@ -439,6 +453,7 @@ class CaffeProcessor:
                     params, st, out = fused_step(params, st, batch)
                     it += n
                     m.add_chunk(n, time.perf_counter() - t_step)
+                inj.slow_sleep(time.perf_counter() - t_step)
                 # interleaved validation: rank-0 records, all ranks step
                 if self.interleave_validation and test_interval \
                         and it % test_interval == 0 \

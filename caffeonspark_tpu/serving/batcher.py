@@ -44,7 +44,6 @@ expired_deadline).
 from __future__ import annotations
 
 import logging
-import os
 import queue
 import threading
 import time
@@ -71,26 +70,19 @@ class ServingStopped(RuntimeError):
 
 
 # -- config knobs (env, COS_SERVE_*) ------------------------------------
+# One definition for the whole repo lives in utils/envutils.py; the
+# serving layer binds the LENIENT flavor (a bad knob must not take a
+# running fleet down — warn and fall back).  retry/fleet import these
+# names from here, keep them.
 
 def _env_int(name: str, default: int) -> int:
-    """Shared across the serving package (retry, fleet import these) —
-    one copy of parse-or-warn-and-default, so the env-knob behavior
-    cannot drift between modules."""
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        _LOG.warning("ignoring non-integer %s=%r", name,
-                     os.environ.get(name))
-        return default
+    from ..utils.envutils import env_int
+    return env_int(name, default, strict=False)
 
 
 def _env_num(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        _LOG.warning("ignoring non-numeric %s=%r", name,
-                     os.environ.get(name))
-        return default
+    from ..utils.envutils import env_num
+    return env_num(name, default, strict=False)
 
 
 def serve_max_batch(default: int = 64) -> int:

@@ -162,6 +162,13 @@ class Solver:
             from .parallel.gradsync import make_gradsync
             grad_sync = make_gradsync(self.train_net)
         self.grad_sync = grad_sync
+        # sync-mode policy (COS_SYNC_MODE): resolved HERE, once, like
+        # grad_sync — lockstep (the default) constructs nothing and
+        # changes nothing; the relaxed modes are driven by the runtime
+        # (mini_cluster) through parallel/syncmode.py, the traced step
+        # itself is identical in every mode
+        from .parallel.syncmode import resolve_policy
+        self.sync_policy = resolve_policy()
         # COS_RECOMPILE_GUARD=1: every jitted step is watched and a
         # steady-state recompile (shape drift, trace-time host read)
         # raises instead of silently storming XLA (analysis/runtime.py)

@@ -1,0 +1,236 @@
+"""Unified chaos / fault-injection layer (COS_FAULT_*).
+
+Every failure drill in the repo injects its fault through an env knob,
+but until now each knob was parsed ad hoc at its use site
+(`mini_cluster.py` read four of them inline) and none of them were
+visible in the run's metrics artifact.  This module is the one place
+faults are resolved and described:
+
+  * `resolve(rank)` reads every COS_FAULT_* knob ONCE, host-side, at
+    startup (never at trace time — coslint COS003 discipline) and
+    returns an immutable `FaultPlan`;
+  * `FaultPlan.describe()` is the `info.faults` block of
+    `PipelineMetrics` — every bench/drill artifact states exactly what
+    was injected, the same self-description contract as `info.comm`;
+  * `ChaosInjector` is the runtime face: the step loop calls
+    `step_delay()` / `slow_sleep()` / `maybe_die()`, the sync-mode
+    exchange layer calls `exchange_fault()` / `storage_fault()`.
+
+Knobs (all default off; see docs/tuning.md for the full table):
+
+  COS_FAULT_STEP_DELAY_MS      sleep N ms before every step dispatch
+                               (widens kill windows in drills)
+  COS_FAULT_DIE_ONCE           "rank:iter:marker" — that rank exits(3)
+                               at-or-after that iter ONCE (the marker
+                               file suppresses the fault after a
+                               relaunch)
+  COS_FAULT_SLOW_RANK          "rank:factor" — that rank runs factor×
+                               slower (each step is followed by a
+                               sleep of (factor-1)× the measured step
+                               time): the straggler injector for the
+                               sync-mode bench and drills
+  COS_FAULT_FLAKY_EXCHANGE     probability [0,1) that a sync-mode
+                               parameter exchange fails transiently
+                               (local_sgd skips the round; async
+                               retries until the staleness bound is
+                               honored)
+  COS_FAULT_FLAKY_STORAGE      probability [0,1) that a ParamStore
+                               read/write raises OSError (exercises
+                               the store's retry path on flaky shared
+                               storage)
+  COS_FAULT_SEED               seed for the flaky-fault RNG (default
+                               rank-derived, so ranks decorrelate but
+                               a drill replays deterministically)
+  COS_FAULT_COMM_NS_PER_BYTE   injected per-EXPOSED-wire-byte comm
+  COS_FAULT_COMM_LAT_US        floor for the gradsync bench — see
+  COS_FAULT_COMM_LOCAL         `GradSyncPlan.exposed_wire_bytes` and
+  COS_FAULT_COMM_HIDE_BYTES    scripts/bench_gradsync.py
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import NamedTuple, Optional, Tuple
+
+from ..utils.envutils import env_num as _env_float
+
+
+class CommFloor(NamedTuple):
+    """Injected comm-floor model knobs (scripts/bench_gradsync.py)."""
+    ns_per_byte: float
+    lat_us: float
+    local: int
+    hide_bytes: Optional[int]
+
+    @property
+    def active(self) -> bool:
+        return self.ns_per_byte > 0
+
+    def sleep_seconds(self, gs_plan) -> float:
+        """Modeled exposed wire time per solver step for a
+        GradSyncPlan (the sleep mini_cluster charges per step)."""
+        if not self.active or gs_plan is None:
+            return 0.0
+        exposed = gs_plan.exposed_wire_bytes(
+            local_size=self.local, hide_bytes=self.hide_bytes)
+        return (exposed * self.ns_per_byte
+                + gs_plan.n_messages * self.lat_us * 1e3) / 1e9
+
+
+class FaultPlan(NamedTuple):
+    """Every injected fault for this process, resolved once from env."""
+    rank: int
+    step_delay_s: float
+    die_once: Optional[Tuple[int, int, str]]     # (rank, iter, marker)
+    slow_rank: Optional[Tuple[int, float]]       # (rank, factor)
+    flaky_exchange: float
+    flaky_storage: float
+    seed: int
+    comm: CommFloor
+
+    @property
+    def active(self) -> bool:
+        return bool(self.step_delay_s or self.die_once
+                    or self.slow_rank or self.flaky_exchange
+                    or self.flaky_storage or self.comm.active)
+
+    @property
+    def slow_factor(self) -> float:
+        """This rank's slowdown factor (1.0 = healthy)."""
+        if self.slow_rank and self.slow_rank[0] == self.rank:
+            return max(1.0, self.slow_rank[1])
+        return 1.0
+
+    def describe(self) -> dict:
+        """The `info.faults` block: only ACTIVE injectors, so a clean
+        run's artifact says {"active": false} and nothing else."""
+        out: dict = {"active": self.active}
+        if self.step_delay_s:
+            out["step_delay_ms"] = round(self.step_delay_s * 1e3, 3)
+        if self.die_once:
+            out["die_once"] = {"rank": self.die_once[0],
+                               "iter": self.die_once[1]}
+        if self.slow_rank:
+            out["slow_rank"] = {"rank": self.slow_rank[0],
+                                "factor": self.slow_rank[1]}
+        if self.flaky_exchange:
+            out["flaky_exchange_p"] = self.flaky_exchange
+        if self.flaky_storage:
+            out["flaky_storage_p"] = self.flaky_storage
+        if self.comm.active:
+            out["comm_floor"] = {
+                "ns_per_byte": self.comm.ns_per_byte,
+                "lat_us": self.comm.lat_us,
+                "local": self.comm.local,
+                "hide_bytes": self.comm.hide_bytes,
+            }
+        return out
+
+
+def _parse_prob(name: str) -> float:
+    p = _env_float(name, 0.0)
+    if not 0.0 <= p < 1.0:
+        raise ValueError(f"{name}={p}: expected a probability in [0,1)")
+    return p
+
+
+def resolve(rank: int = 0) -> FaultPlan:
+    """Read every COS_FAULT_* knob once (host-side, at startup)."""
+    die = os.environ.get("COS_FAULT_DIE_ONCE", "")
+    die_once = None
+    if die:
+        r_, i_, marker = die.split(":", 2)
+        die_once = (int(r_), int(i_), marker)
+    slow = os.environ.get("COS_FAULT_SLOW_RANK", "")
+    slow_rank = None
+    if slow:
+        r_, f_ = slow.split(":", 1)
+        factor = float(f_)
+        if factor < 1.0:
+            raise ValueError(
+                f"COS_FAULT_SLOW_RANK factor {factor}: must be >= 1")
+        slow_rank = (int(r_), factor)
+    hide = os.environ.get("COS_FAULT_COMM_HIDE_BYTES", "")
+    comm = CommFloor(
+        ns_per_byte=_env_float("COS_FAULT_COMM_NS_PER_BYTE", 0.0),
+        lat_us=_env_float("COS_FAULT_COMM_LAT_US", 0.0),
+        local=int(_env_float("COS_FAULT_COMM_LOCAL", 1) or 1),
+        hide_bytes=int(float(hide)) if hide else None)
+    return FaultPlan(
+        rank=rank,
+        step_delay_s=_env_float("COS_FAULT_STEP_DELAY_MS", 0.0) / 1e3,
+        die_once=die_once,
+        slow_rank=slow_rank,
+        flaky_exchange=_parse_prob("COS_FAULT_FLAKY_EXCHANGE"),
+        flaky_storage=_parse_prob("COS_FAULT_FLAKY_STORAGE"),
+        seed=int(_env_float("COS_FAULT_SEED", 1000 + rank)),
+        comm=comm)
+
+
+class ChaosInjector:
+    """Runtime face of a FaultPlan: all sleeps/exits/failures happen
+    through here, so the step loop and the sync layer stay free of env
+    parsing, and a plan with nothing active costs one attribute check
+    per call."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._rng = random.Random(plan.seed)
+        self.injected = {"exchange_faults": 0, "storage_faults": 0}
+
+    # -- step-loop injectors -------------------------------------------
+    def step_delay(self) -> None:
+        """COS_FAULT_STEP_DELAY_MS floor, scaled by this rank's slow
+        factor — the injected floor stands in for device step time, and
+        a slow rank is slower at that too (otherwise combining the two
+        knobs would dilute the slowdown to nothing on fast CPU nets)."""
+        if self.plan.step_delay_s:
+            time.sleep(self.plan.step_delay_s * self.plan.slow_factor)
+
+    def slow_sleep(self, step_seconds: float) -> None:
+        """Straggler injector: after a step that took `step_seconds`,
+        sleep (factor-1)× that, making this rank factor× slower end to
+        end regardless of the net/box."""
+        f = self.plan.slow_factor
+        if f > 1.0 and step_seconds > 0:
+            time.sleep((f - 1.0) * step_seconds)
+
+    def maybe_die(self, it: int) -> None:
+        """COS_FAULT_DIE_ONCE: exit(3) at-or-after the target iter,
+        once (>= not ==: with fused chunks the counter may never equal
+        the target; the marker file keeps it one-shot across
+        relaunches)."""
+        if not self.plan.die_once:
+            return
+        rank, die_iter, marker = self.plan.die_once
+        if (rank == self.plan.rank and it >= die_iter
+                and not os.path.exists(marker)):
+            open(marker, "w").close()
+            print(f"FAULT INJECTION: rank {rank} dying at iter {it}",
+                  flush=True)
+            os._exit(3)
+
+    # -- sync-layer injectors ------------------------------------------
+    def exchange_fault(self) -> bool:
+        """True with probability flaky_exchange: the caller must treat
+        the exchange as transiently failed."""
+        if (self.plan.flaky_exchange
+                and self._rng.random() < self.plan.flaky_exchange):
+            self.injected["exchange_faults"] += 1
+            return True
+        return False
+
+    def storage_fault(self) -> None:
+        """Raise OSError with probability flaky_storage (called inside
+        ParamStore I/O; the store's retry loop absorbs it)."""
+        if (self.plan.flaky_storage
+                and self._rng.random() < self.plan.flaky_storage):
+            self.injected["storage_faults"] += 1
+            raise OSError("injected flaky-storage fault "
+                          "(COS_FAULT_FLAKY_STORAGE)")
+
+
+def make_injector(rank: int = 0) -> ChaosInjector:
+    return ChaosInjector(resolve(rank))

@@ -43,19 +43,21 @@ Exit code 0 iff a run completes (every local rank exits 0).
 from __future__ import annotations
 
 import argparse
+import random
 import re
 import signal
 import subprocess
 import sys
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..utils import fsutils
 
 
-def find_latest_snapshot(outdir: str, prefix: str
-                         ) -> Optional[Tuple[str, str]]:
-    """Newest (state, model) pair `<prefix>_iter_<N>.*` in outdir.
+def find_snapshots(outdir: str, prefix: str
+                   ) -> List[Tuple[str, str]]:
+    """All complete (state, model) pairs `<prefix>_iter_<N>.*` in
+    outdir, NEWEST FIRST.
 
     Listing goes through fsutils so `-output gs://bucket/run` (the
     documented multi-host layout, docs/deploy.md) resumes correctly —
@@ -63,17 +65,56 @@ def find_latest_snapshot(outdir: str, prefix: str
     relaunch restarted from scratch."""
     names = set(fsutils.listdir(outdir))
     pat = re.compile(re.escape(prefix) + r"_iter_(\d+)\.solverstate(\.h5)?$")
-    best, best_it = None, -1
+    pairs: List[Tuple[int, Tuple[str, str]]] = []
     for name in names:
         m = pat.match(name)
         if not m:
             continue
-        it = int(m.group(1))
         model = name.replace(".solverstate", ".caffemodel")
-        if it > best_it and model in names:
-            best, best_it = (fsutils.join(outdir, name),
-                             fsutils.join(outdir, model)), it
-    return best
+        if model in names:
+            pairs.append((int(m.group(1)),
+                          (fsutils.join(outdir, name),
+                           fsutils.join(outdir, model))))
+    pairs.sort(key=lambda p: p[0], reverse=True)
+    return [p for _, p in pairs]
+
+
+def find_latest_snapshot(outdir: str, prefix: str
+                         ) -> Optional[Tuple[str, str]]:
+    """Newest (state, model) pair, or None (historical API; the
+    restart path uses `pick_snapshot` so a bad pair can be skipped)."""
+    pairs = find_snapshots(outdir, prefix)
+    return pairs[0] if pairs else None
+
+
+def pick_snapshot(outdir: str, prefix: str,
+                  bad: frozenset = frozenset()
+                  ) -> Optional[Tuple[str, str]]:
+    """Newest snapshot pair whose state file is NOT in `bad` — the
+    fallback that keeps one corrupt/partial snapshot on shared storage
+    from burning every restart attempt (the supervisor marks a pair
+    bad when an attempt resuming from it crashes immediately without
+    making progress, and falls back to the previous pair)."""
+    for state, model in find_snapshots(outdir, prefix):
+        if state not in bad:
+            return (state, model)
+    return None
+
+
+def relaunch_backoff(attempt: int, *, base_s: float = 1.0,
+                     cap_s: float = 30.0,
+                     rng: Optional[random.Random] = None) -> float:
+    """Capped exponential backoff with full jitter between relaunch
+    attempts (delay ~ U[0, min(cap, base·2^attempt)]) — the same shape
+    as serving/retry.py's RetryPolicy, for the same reason: an
+    immediate relaunch of a fast-crashing rank storms the coordinator
+    port and the shared snapshot storage, and multiple supervisors
+    that failed together must not relaunch together.  attempt 0 (the
+    first launch) never waits."""
+    if attempt <= 0:
+        return 0.0
+    ceil = min(cap_s, base_s * (2 ** (attempt - 1)))
+    return (rng or random).uniform(0.0, ceil)
 
 
 def terminate_processes(procs: List[subprocess.Popen],
@@ -162,16 +203,48 @@ class Supervisor:
 
     def run(self) -> int:
         a = self.args
+        import os
         from ..proto import read_solver
         prefix = read_solver(a.solver).snapshot_prefix or "model"
+        # sync-mode dispatch (must mirror parallel/syncmode.MODES —
+        # read inline so the launcher never imports the jax-heavy
+        # parallel package): lockstep ranks hang in the collective
+        # when a peer dies, so recovery is full teardown + relaunch;
+        # the relaxed modes have no fleet-wide collective, so rank
+        # death is handled PER RANK (elastic membership)
+        mode = (a.sync_mode or os.environ.get("COS_SYNC_MODE", "")
+                or "lockstep").strip().lower()
+        if mode not in ("lockstep", "local_sgd", "async"):
+            raise ValueError(f"sync mode {mode!r}: expected "
+                             "lockstep|local_sgd|async")
+        if a.sync_mode:
+            # children resolve COS_SYNC_MODE from env
+            os.environ["COS_SYNC_MODE"] = mode
+        if mode != "lockstep" and a.cluster > 1:
+            return self._run_elastic(prefix, mode)
+        return self._run_lockstep(prefix)
+
+    # ------------------------------------------------------------------
+    def _run_lockstep(self, prefix: str) -> int:
+        a = self.args
         base_port = a.port
         if a.server and ":" in a.server:
             base_port = int(a.server.rsplit(":", 1)[1])
         local_ranks = list(range(
             a.rank_base, a.rank_base + (a.local_ranks or a.cluster)))
         attempt = 0
+        bad: set = set()
+        rng = random.Random()
         while True:
-            snap = find_latest_snapshot(a.output, prefix)
+            delay = relaunch_backoff(attempt, base_s=a.backoff_base,
+                                     cap_s=a.backoff_cap, rng=rng)
+            if delay > 0:
+                # a fast crash-loop relaunched immediately storms the
+                # coordinator port and the shared snapshot storage
+                print(f"supervisor: backing off {delay:.1f}s before "
+                      f"attempt {attempt + 1}", flush=True)
+                time.sleep(delay)
+            snap = pick_snapshot(a.output, prefix, frozenset(bad))
             print(f"supervisor: attempt {attempt + 1} ranks "
                   f"{local_ranks} from "
                   f"{snap[0] if snap else 'scratch'}", flush=True)
@@ -180,10 +253,12 @@ class Supervisor:
             # attempt number keeps independent supervisors converging
             # on the same coordinator address)
             self.attempt_port = base_port + attempt
+            t_launch = time.time()
+            launch_stamp = self._progress_stamp(prefix)
             self.procs = [self._launch(r, snap) for r in local_ranks]
             failed = False
             stall_base = time.time()
-            stall_stamp = self._progress_stamp(prefix)
+            stall_stamp = launch_stamp
             while True:
                 time.sleep(a.poll_interval)
                 codes = [p.poll() for p in self.procs]
@@ -216,10 +291,113 @@ class Supervisor:
             self._teardown()
             if not failed:
                 return 0
+            if (snap is not None
+                    and time.time() - t_launch < a.min_uptime
+                    and self._progress_stamp(prefix) <= launch_stamp):
+                # the attempt died immediately without making ANY
+                # progress while resuming from a snapshot: blame the
+                # snapshot (bad/partial write on shared storage), not
+                # the cluster — fall back to the previous pair instead
+                # of burning every remaining attempt against it
+                print("supervisor: attempt died at once with no "
+                      f"progress — marking snapshot {snap[0]} bad, "
+                      "falling back to the previous pair", flush=True)
+                bad.add(snap[0])
             attempt += 1
             if attempt > a.max_restarts:
                 print("supervisor: max_restarts exceeded", flush=True)
                 return 1
+
+    # ------------------------------------------------------------------
+    def _run_elastic(self, prefix: str, mode: str) -> int:
+        """Per-rank supervision for the relaxed sync modes: a dead
+        rank is relaunched ALONE (with backoff) while the survivors
+        keep training — there is no collective to hang them, and the
+        relaunched rank re-admits itself from the store's averaged
+        state at the next round (mini_cluster's adopt path).  A rank
+        that exhausts its per-rank restart budget is dropped and the
+        fleet simply shrinks; no full-restart attempt is ever burned
+        on a single rank's death."""
+        a = self.args
+        local_ranks = list(range(
+            a.rank_base, a.rank_base + (a.local_ranks or a.cluster)))
+        rng = random.Random()
+        bad: set = set()
+        recs: Dict[int, dict] = {
+            r: {"proc": None, "attempts": 0, "next": 0.0,
+                "t_launch": 0.0, "snap": None,
+                "done": False, "dropped": False}
+            for r in local_ranks}
+        self.attempt_port = a.port   # elastic ranks never rendezvous
+        print(f"supervisor[elastic:{mode}]: ranks {local_ranks}",
+              flush=True)
+        while True:
+            now = time.time()
+            pending = False
+            for r, rec in recs.items():
+                if rec["done"] or rec["dropped"]:
+                    continue
+                pending = True
+                p = rec["proc"]
+                if p is None:
+                    if now >= rec["next"]:
+                        snap = pick_snapshot(a.output, prefix,
+                                             frozenset(bad))
+                        rec["snap"] = snap
+                        rec["t_launch"] = now
+                        rec["stamp"] = self._progress_stamp(prefix)
+                        print(f"supervisor: launching rank {r} "
+                              f"(attempt {rec['attempts'] + 1}) from "
+                              f"{snap[0] if snap else 'scratch'}",
+                              flush=True)
+                        rec["proc"] = self._launch(r, snap)
+                    continue
+                code = p.poll()
+                if code is None:
+                    continue
+                if code == 0:
+                    rec["done"] = True
+                    print(f"supervisor: rank {r} complete", flush=True)
+                    continue
+                rec["proc"] = None
+                if (rec["snap"] is not None
+                        and now - rec["t_launch"] < a.min_uptime
+                        and self._progress_stamp(prefix)
+                        <= rec.get("stamp", (-1, 0))):
+                    # instant death on resume WITHOUT any fleet
+                    # progress: suspect the snapshot (store adoption
+                    # usually overrides it, but a corrupt pair must
+                    # not poison every relaunch — and a death with an
+                    # unrelated cause must not blacklist a good pair)
+                    bad.add(rec["snap"][0])
+                rec["attempts"] += 1
+                if rec["attempts"] > a.max_restarts:
+                    rec["dropped"] = True
+                    print(f"supervisor: rank {r} exceeded "
+                          f"{a.max_restarts} restarts — dropping it; "
+                          "fleet shrinks, survivors continue from the "
+                          "averaged state", flush=True)
+                else:
+                    delay = relaunch_backoff(
+                        rec["attempts"], base_s=a.backoff_base,
+                        cap_s=a.backoff_cap, rng=rng)
+                    rec["next"] = now + delay
+                    print(f"supervisor: rank {r} died (exit {code}) — "
+                          f"relaunching in {delay:.1f}s; survivors "
+                          "keep training", flush=True)
+            self.procs = [rec["proc"] for rec in recs.values()
+                          if rec["proc"] is not None]
+            if not pending:
+                break
+            time.sleep(a.poll_interval)
+        done = [r for r in local_ranks if recs[r]["done"]]
+        # success needs a surviving fleet — and rank 0 in particular
+        # when it is ours (it writes the final model)
+        ok = bool(done) and (0 not in local_ranks or recs[0]["done"])
+        print(f"supervisor[elastic]: ranks {done} completed, "
+              f"{[r for r in local_ranks if recs[r]['dropped']]} "
+              f"dropped → {'ok' if ok else 'FAILED'}", flush=True)
+        return 0 if ok else 1
 
 
 def main(argv=None) -> int:
@@ -249,6 +427,23 @@ def main(argv=None) -> int:
                     help="seconds without snapshot progress before "
                          "assuming a remote-rank failure (0 = off; "
                          "set on multi-host pods)")
+    ap.add_argument("-sync_mode", default=None,
+                    choices=("lockstep", "local_sgd", "async"),
+                    help="training sync mode (default: COS_SYNC_MODE "
+                         "env or lockstep).  local_sgd/async run "
+                         "ELASTIC: a dead rank is relaunched alone "
+                         "with backoff while survivors keep training, "
+                         "and re-admits from the averaged state")
+    ap.add_argument("-backoff_base", type=float, default=1.0,
+                    help="relaunch backoff base seconds (capped "
+                         "exponential with full jitter)")
+    ap.add_argument("-backoff_cap", type=float, default=30.0,
+                    help="relaunch backoff ceiling seconds")
+    ap.add_argument("-min_uptime", type=float, default=5.0,
+                    help="an attempt that dies faster than this while "
+                         "resuming from a snapshot (without progress) "
+                         "marks that snapshot bad and falls back to "
+                         "the previous pair")
     args, passthrough = ap.parse_known_args(argv)
     if passthrough and passthrough[0] == "--":
         passthrough = passthrough[1:]

@@ -1,0 +1,46 @@
+"""Env-knob parsing, shared by every layer that reads numeric knobs.
+
+One definition instead of the per-module copies that had accumulated
+(serving/batcher.py grew the first shared one in PR 8; the sync-mode /
+chaos layers would have been the 3rd and 4th).  Unset or empty always
+means the default; a non-numeric value is a config error — `strict`
+(the trainer-side default) raises a ValueError naming the knob, while
+`strict=False` (the serving-side behavior, where a bad knob must not
+take a running fleet down) logs and falls back to the default.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_LOG = logging.getLogger(__name__)
+
+
+def env_num(name: str, default: float, *, strict: bool = True
+            ) -> float:
+    v = os.environ.get(name, "")
+    if not v:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        if strict:
+            raise ValueError(
+                f"{name}={v!r}: expected a number") from None
+        _LOG.warning("ignoring non-numeric %s=%r", name, v)
+        return default
+
+
+def env_int(name: str, default: int, *, strict: bool = True) -> int:
+    v = os.environ.get(name, "")
+    if not v:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        if strict:
+            raise ValueError(
+                f"{name}={v!r}: expected an integer") from None
+        _LOG.warning("ignoring non-integer %s=%r", name, v)
+        return default

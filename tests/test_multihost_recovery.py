@@ -28,8 +28,10 @@ import pytest
 
 # slow/e2e: 2-4 OS processes per test joining a jax.distributed
 # cluster, with kill/relaunch choreography — tens of seconds each on
-# the CI box.  Run with `-m slow`.
-pytestmark = pytest.mark.slow
+# the CI box.  Run with `-m slow`; these are the LOCKSTEP legs of the
+# chaos drill suite (`make chaos`) — the elastic sync-mode legs live
+# in tests/test_syncmode.py.
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

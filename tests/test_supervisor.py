@@ -32,6 +32,7 @@ def test_find_latest_snapshot(tmp_path):
 
 
 @pytest.mark.slow  # spawns a mini-cluster subprocess fleet (12-24 s)
+@pytest.mark.chaos
 def test_supervisor_recovers_from_rank_death(tmp_path):
     from caffeonspark_tpu.data import LmdbWriter
     from caffeonspark_tpu.data.synthetic import make_images
@@ -155,6 +156,7 @@ def test_per_host_supervisors_complete_pod_job(tmp_path):
 
 
 @pytest.mark.slow  # spawns a mini-cluster subprocess fleet (12-24 s)
+@pytest.mark.chaos
 def test_stall_timeout_detects_remote_death(tmp_path):
     """cluster=2 but only rank 0 exists (the 'remote host died before
     joining' case): rank 0 blocks in the rendezvous, no snapshots
