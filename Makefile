@@ -9,7 +9,7 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 .PHONY: build native install lint test test-slow spark-test bench \
   smoke tpu-tests bench-evidence bench-ingest bench-steploop \
   bench-serving bench-serving-sharded bench-gradsync bench-syncmode \
-  chaos onchip-artifacts docs clean
+  bench-autotune chaos onchip-artifacts docs clean
 
 build: native install
 
@@ -86,6 +86,16 @@ bench-syncmode:
 	$(CPU_ENV) $(PY) scripts/bench_syncmode.py \
 	  --out bench_evidence/bench_syncmode.json
 
+# per-layer autotuner: untuned vs COS_AUTOTUNE plan on the worst-MFU
+# zoo net (googlenet) under the injected HBM-bandwidth floor; the
+# chosen plan is cached under artifacts/autotune and embedded in the
+# artifact (with a floor=0 control); ALWAYS exits 0 with one JSON
+# document on stdout (bench.py contract)
+bench-autotune:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_autotune.py \
+	  --out bench_evidence/bench_autotune.json
+
 # chaos drills: the fault-injection test suite (kill-rank / slow-rank
 # / flaky-exchange / flaky-storage under each sync mode, supervisor
 # elastic relaunch + bad-snapshot fallback) — subprocess-heavy, so
@@ -136,6 +146,8 @@ bench-evidence:
 	-BENCH_BATCH=64 BENCH_DTYPE=float32 $(PY) bench.py
 	-BENCH_FORWARD=1 $(PY) bench.py
 	-BENCH_MODEL=resnet50 $(PY) bench.py
+	-$(CPU_ENV) $(PY) scripts/bench_autotune.py \
+	  --out bench_evidence/bench_autotune.json
 
 # everything the judge wants from ONE healthy tunnel window, in
 # priority order: headline number + evidence, on-chip test artifact,

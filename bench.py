@@ -557,12 +557,32 @@ def _host_pipeline_scaling(batch, dshape, tmpdir, threads_list,
     return out
 
 
+def _peak_tflops_default():
+    """(peak, source): BENCH_PEAK_TFLOPS env wins; else the RUNNING
+    chip's bf16 peak by device_kind (analysis/roofline.py table — the
+    same fix scripts/bench_attention.py got per ADVICE r05); unknown
+    chips fall back to the explicitly-labeled v5e 197 reference so zoo
+    MFU fields are never silently wrong on other generations."""
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env is not None:
+        return float(env), "env:BENCH_PEAK_TFLOPS"
+    try:
+        import jax
+        from caffeonspark_tpu.analysis.roofline import peak_tflops
+        peak, src = peak_tflops(jax.devices()[0])
+        if peak is not None:
+            return peak, src
+    except Exception:  # noqa: BLE001 — peak lookup must never kill a run
+        pass
+    return 197.0, "fallback:v5e_197tflops"
+
+
 def _emit_record(metric, ips, flops_step, iters, dt, batch, precision,
                  chip, extra):
     """Compute MFU, refuse impossible numbers, print the JSON record.
     Callable more than once per worker (the pipeline path prints before
     and after its host-scaling sweep; the parent takes the last line)."""
-    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+    peak_tflops, peak_source = _peak_tflops_default()
     tflops = flops_step * iters / dt / 1e12
     mfu = tflops / peak_tflops
     if mfu > 1.0:
@@ -581,6 +601,8 @@ def _emit_record(metric, ips, flops_step, iters, dt, batch, precision,
         "vs_baseline": (1.0 if model == "lstm"
                         else round(ips / 150.0, 3)),
         "mfu": round(mfu, 4),
+        "peak_tflops_per_sec": peak_tflops,
+        "peak_source": peak_source,
         "model_tflops_per_sec": round(tflops, 2),
         "flops_per_step": flops_step,
         "batch": batch, "iters": iters,

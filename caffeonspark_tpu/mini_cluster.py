@@ -418,6 +418,7 @@ class MiniCluster:
         # injected.
         pmetrics.set_info("faults", inj.plan.describe())
         pmetrics.set_info("sync", self.sync_policy.describe())
+        pmetrics.set_info("autotune", solver.train_net.autotune_info())
         gs = getattr(solver, "grad_sync", None)
         comm_sleep = 0.0
         if gs is not None:

@@ -199,6 +199,71 @@ def data_layer_input_specs(lp: LayerParameter) -> List[Tuple[str, Tuple[int, ...
     raise NotImplementedError(f"data layer {t}")
 
 
+def fusable_relu_for_lrn(layers: Sequence[LayerParameter],
+                         lrn_lp: LayerParameter
+                         ) -> Optional[LayerParameter]:
+    """THE ReLU→LRN fusion-eligibility rule, as a predicate: the ReLU
+    layer `_fuse_relu_lrn` would absorb into `lrn_lp`, or None.  One
+    copy — the peephole applies it, and the autotuner's variant
+    enumeration (`ops/autotune.py`) and the roofline byte model
+    (`analysis/roofline.py`) consult the SAME rule, so neither can
+    enumerate or credit a fusion the build refuses.
+
+    Eligible: `lrn_lp` is a 1-bottom ACROSS_CHANNELS LRN whose
+    bottom's last producer is a plain ReLU (negative_slope 0, no loss
+    weight, 1 bottom / 1 top) consumed by nothing but the LRN."""
+    from .proto.caffe import NormRegion
+    if (lrn_lp.type != "LRN" or len(lrn_lp.bottom) != 1
+            or lrn_lp.lrn_param.norm_region
+            != NormRegion.ACROSS_CHANNELS):
+        return None
+    prod, pi = None, -1
+    found = False
+    for j, l2 in enumerate(layers):
+        if l2 is lrn_lp:
+            found = True
+            break
+        if lrn_lp.bottom[0] in l2.top:
+            prod, pi = l2, j
+    if not found or prod is None or prod.type != "ReLU":
+        return None
+    if len(prod.bottom) != 1 or len(prod.top) != 1:
+        return None
+    if float(getattr(prod.relu_param, "negative_slope", 0.0) or 0.0):
+        return None
+    if any(float(w) for w in prod.loss_weight):
+        return None
+    consumers = [l2 for j, l2 in enumerate(layers)
+                 if j > pi and prod.top[0] in l2.bottom]
+    if consumers != [lrn_lp]:
+        return None
+    return prod
+
+
+def prefuse_conv_bias_eligible(layers: Sequence[LayerParameter],
+                               lrn_lp: LayerParameter,
+                               relu_lp: LayerParameter) -> bool:
+    """PRE-fuse mirror of `_fuse_conv_bias`'s rule (which runs on the
+    post-fuse layer list): would the conv feeding `relu_lp` get its
+    bias deferred into `lrn_lp` once the relu is fused away?  True
+    when that producer is a bias_term Convolution whose top feeds
+    nothing but the relu chain (for an in-place relu, the LRN also
+    reads the name — that IS the chain)."""
+    conv, ci = None, -1
+    for j, l2 in enumerate(layers):
+        if l2 is relu_lp:
+            break
+        if relu_lp.bottom[0] in l2.top:
+            conv, ci = l2, j
+    if (conv is None or conv.type != "Convolution"
+            or not conv.convolution_param.bias_term):
+        return False
+    others = [l2 for j, l2 in enumerate(layers)
+              if j > ci and conv.top[0] in l2.bottom
+              and l2 is not relu_lp]
+    return others in ([], [lrn_lp])
+
+
 class Net:
     """A compiled, phase-filtered network."""
 
@@ -206,7 +271,8 @@ class Net:
                  input_shapes: Optional[Dict[str, Sequence[int]]] = None,
                  dtype=jnp.float32,
                  remat: Optional[Union[bool, str]] = None,
-                 compute_dtype=None):
+                 compute_dtype=None,
+                 autotune: Union[None, bool, str, dict] = None):
         self.net_param = net_param
         self.state = state or NetState(phase=Phase.TRAIN)
         self.name = net_param.name
@@ -284,7 +350,23 @@ class Net:
         self.compute_layers = [lp for lp in self.layers
                                if not L.get_op(lp.type).is_data]
 
-        # --- ReLU→LRN peephole (COS_FUSE_RELU_LRN=1, opt-in) -------------
+        # --- autotune plan (COS_AUTOTUNE, resolved ONCE here — never at
+        # trace time; COS003 discipline).  None/unset/"0" is INERT:
+        # no plan, no per-layer variants, byte-identical construction.
+        # `autotune` arg: False forces inert (the tuner's candidate
+        # nets), a dict is an explicit plan, a str a plan path, None
+        # defers to the env.
+        self.autotune_plan: Optional[dict] = None
+        self.layer_variants: Dict[str, dict] = {}
+        if autotune is not False:
+            from .ops.autotune import dtype_policy_str, resolve_plan
+            self.autotune_plan, self.layer_variants = resolve_plan(
+                net_param, self.state, autotune,
+                dtype_policy=dtype_policy_str(self.dtype,
+                                              self.compute_dtype))
+
+        # --- ReLU→LRN peephole (COS_FUSE_RELU_LRN=1, opt-in; also
+        # requested per-layer by the autotune plan) -----------------------
         # XLA cannot fuse a producer into an opaque pallas call, so a
         # ReLU feeding the Pallas LRN kernel materializes its output as
         # the kernel's residual AND keeps the pre-activation live for
@@ -295,12 +377,34 @@ class Net:
         # longer a materialized blob — for an in-place relu the name
         # then holds the PRE-activation, so feature extraction of that
         # blob changes meaning.
+        # COS_FUSE_BIAS_RELU_LRN=1 (or a plan variant fuse=bias_relu)
+        # generalizes the epilogue one producer further: the conv's
+        # bias add joins relu+lrn in the kernel, the conv emits its RAW
+        # matmul output, and d_bias is recovered exactly from the
+        # kernel's dx (ops/pallas_kernels.bias_relu_lrn_across_channels).
         self.fused_relu_lrn: frozenset = frozenset()
-        if os.environ.get("COS_FUSE_RELU_LRN") == "1":
+        self.fused_bias_lrn: Dict[str, str] = {}      # lrn → conv
+        plan_fuse = {n for n, v in self.layer_variants.items()
+                     if v.get("fuse") in ("relu", "bias_relu")}
+        plan_deny = frozenset(n for n, v in self.layer_variants.items()
+                              if v.get("fuse") == "none")
+        env_fuse_all = os.environ.get("COS_FUSE_RELU_LRN") == "1"
+        env_bias = os.environ.get("COS_FUSE_BIAS_RELU_LRN") == "1"
+        if env_fuse_all or env_bias or plan_fuse:
             fused: set = set()
             self.compute_layers = self._fuse_relu_lrn(
-                self.compute_layers, fused)
+                self.compute_layers, fused,
+                want=None if (env_fuse_all or env_bias) else plan_fuse,
+                deny=plan_deny)
             self.fused_relu_lrn = frozenset(fused)
+            bias_want = (None if env_bias else
+                         {n for n, v in self.layer_variants.items()
+                          if v.get("fuse") == "bias_relu"})
+            if env_bias or bias_want:
+                self.fused_bias_lrn = self._fuse_conv_bias(bias_want)
+        self._bias_lrn_set = frozenset(self.fused_bias_lrn)
+        self._defer_bias = frozenset(self.fused_bias_lrn.values())
+        self._validate_variants()
 
         # --- shape inference + param spec construction -------------------
         blob_shapes: Dict[str, Tuple[int, ...]] = {
@@ -323,10 +427,22 @@ class Net:
             # abstract evaluation for top shapes
             dummy_params = [jax.ShapeDtypeStruct(s, dtype)
                             for (_, s, _) in specs]
+            if lp.name in self.fused_bias_lrn:
+                # the bias-fused LRN consumes the producing conv's bias
+                # as params[0] (the conv is earlier in topo order, so
+                # its layout is already known)
+                conv = self.fused_bias_lrn[lp.name]
+                bshape = next(s for (n2, s, _) in
+                              self.param_layout[conv] if n2 == "bias")
+                dummy_params = [jax.ShapeDtypeStruct(bshape, dtype)] \
+                    + dummy_params
             dummy_bottoms = [jax.ShapeDtypeStruct(s, dtype) for s in bshapes]
             ctx = L.Ctx(train=self.state.phase == Phase.TRAIN,
                         rng=jax.random.key(0), layer_name=lp.name,
-                        fused_relu_lrn=self.fused_relu_lrn)
+                        fused_relu_lrn=self.fused_relu_lrn,
+                        variant=self.layer_variants.get(lp.name),
+                        defer_bias=self._defer_bias,
+                        bias_lrn=self._bias_lrn_set)
             tops = jax.eval_shape(
                 lambda p, b, lp=lp, op=op, ctx=ctx: op.apply(ctx, lp, p, b),
                 dummy_params, dummy_bottoms)
@@ -362,43 +478,157 @@ class Net:
                     self.loss_weights[t] = w
 
     # ------------------------------------------------------------------
-    def _fuse_relu_lrn(self, layers: List[LayerParameter], fused: set
+    def _fuse_relu_lrn(self, layers: List[LayerParameter], fused: set,
+                       want: Optional[set] = None,
+                       deny: frozenset = frozenset()
                        ) -> List[LayerParameter]:
         """Replace eligible [ReLU, LRN] pairs with one LRN layer whose
-        op applies relu in-kernel (see __init__).  Eligible: plain relu
-        (negative_slope 0, no loss weight, 1 bottom / 1 top) whose top
-        is consumed by exactly one later layer, an ACROSS_CHANNELS LRN.
-        The LRN entry is a deep copy (the source NetParameter may build
-        other Nets); its name is added to `fused` (becomes
-        self.fused_relu_lrn, which Net.apply threads to the op through
-        Ctx)."""
-        from .proto.caffe import NormRegion
-        out: List[Optional[LayerParameter]] = list(layers)
-        for i, r in enumerate(out):
-            if r is None or r.type != "ReLU":
+        op applies relu in-kernel (see __init__).  Eligibility is the
+        module-level `fusable_relu_for_lrn` predicate — the ONE copy
+        the autotuner and roofline model also consult.  The LRN entry
+        is a deep copy (the source NetParameter may build other Nets);
+        its name is added to `fused` (becomes self.fused_relu_lrn,
+        which Net.apply threads to the op through Ctx).  `want`
+        restricts fusion to the named LRN layers (the autotune plan's
+        per-layer request; None = every eligible pair, the env-knob
+        behavior); `deny` always blocks the named LRNs (a plan
+        fuse=none beats the env knob)."""
+        out: List[LayerParameter] = list(layers)
+        i = 0
+        while i < len(out):
+            nl = out[i]
+            if nl.type != "LRN" or nl.name in deny \
+                    or (want is not None and nl.name not in want):
+                i += 1
                 continue
-            if len(r.bottom) != 1 or len(r.top) != 1:
-                continue
-            if float(getattr(r.relu_param, "negative_slope", 0.0) or 0.0):
-                continue
-            if any(float(w) for w in r.loss_weight):
-                continue
-            rtop = r.top[0]
-            consumers = [(j, lp) for j, lp in enumerate(out)
-                         if lp is not None and j > i and rtop in lp.bottom]
-            if len(consumers) != 1:
-                continue
-            j, nl = consumers[0]
-            if (nl.type != "LRN" or len(nl.bottom) != 1
-                    or nl.lrn_param.norm_region
-                    != NormRegion.ACROSS_CHANNELS):
+            r = fusable_relu_for_lrn(out, nl)
+            if r is None:
+                i += 1
                 continue
             fused_lp = LayerParameter.from_binary(nl.to_binary())
             fused_lp.bottom = [r.bottom[0]]
-            out[j] = fused_lp
-            out[i] = None
+            out[i] = fused_lp
+            ri = next(j for j, l2 in enumerate(out) if l2 is r)
+            del out[ri]            # ri < i: the producer sits earlier,
+            #                        so out[i-1] is now the fused LRN
+            #                        and out[i] the next layer to scan
             fused.add(nl.name)
-        return [lp for lp in out if lp is not None]
+        return out
+
+    # ------------------------------------------------------------------
+    def _fuse_conv_bias(self, want: Optional[set]) -> Dict[str, str]:
+        """Second stem-peephole pass: for relu-fused LRN layers (their
+        bottom is now the conv's raw top), defer the producing conv's
+        bias add into the LRN kernel's epilogue.  Eligible: the LRN's
+        single bottom is produced by a bias_term Convolution whose top
+        is consumed by NO other layer.  Returns {lrn_name: conv_name};
+        Net.apply routes the conv's bias blob to the LRN as params[0]
+        and tells the conv op to skip its own add (Ctx.defer_bias) —
+        gradients still land on the conv's bias through the fused
+        kernel's VJP.  `want` restricts to the named LRNs (autotune
+        plan); None = every eligible fused pair (the env knob).
+
+        Caveat (the relu peephole's, one producer deeper — why this
+        too is opt-in): the conv's top name now holds the RAW matmul
+        output, so feature-extracting that blob returns UNBIASED
+        activations.  The layer-consumer check above cannot see the
+        extraction surface (-features names arbitrary blobs at run
+        time); don't enable bias fusion on nets whose conv stems feed
+        feature extraction."""
+        out: Dict[str, str] = {}
+        by_top: Dict[str, LayerParameter] = {}
+        for lp in self.compute_layers:
+            for t in lp.top:
+                by_top[t] = lp
+        for lp in self.compute_layers:
+            if lp.name not in self.fused_relu_lrn:
+                continue
+            if want is not None and lp.name not in want:
+                continue
+            src = by_top.get(lp.bottom[0])
+            if (src is None or src.type != "Convolution"
+                    or not src.convolution_param.bias_term):
+                continue
+            consumers = [o for o in self.compute_layers
+                         if o is not lp and src.top[0] in o.bottom]
+            if consumers:
+                continue     # someone else needs the biased activation
+            out[lp.name] = src.name
+        return out
+
+    # ------------------------------------------------------------------
+    def _validate_variants(self) -> None:
+        """Drop plan entries that cannot apply to THIS net: unknown
+        layer names (pruned relus, other phases), int8 on a TRAIN-phase
+        net (the quantized matmul is forward-only serving), and
+        type-mismatched knobs.  Dropping with a log line — never
+        erroring — keeps one plan applicable to the train/test net pair
+        it was tuned against."""
+        self._variant_dtype: Dict[str, object] = {}
+        if not self.layer_variants:
+            return
+        import logging
+        log = logging.getLogger(__name__)
+        by_name = {lp.name: lp.type for lp in self.compute_layers}
+        train = self.state.phase == Phase.TRAIN
+        keep: Dict[str, dict] = {}
+        for name, v in self.layer_variants.items():
+            t = by_name.get(name)
+            if t is None:
+                continue                 # fused-away or other-phase layer
+            v = dict(v)
+            if v.get("int8") and (train or t != "InnerProduct"):
+                log.warning("autotune: dropping int8 variant on %s "
+                            "(%s, train=%s) — serving InnerProduct only",
+                            name, t, train)
+                v.pop("int8")
+            if v.get("layout") and t != "Convolution":
+                v.pop("layout")
+            if v.get("attention") and t != "MultiHeadAttention":
+                v.pop("attention")
+            if v.get("fuse") and t != "LRN":
+                v.pop("fuse")
+            # reconcile fuse with what the peephole ACTUALLY did:
+            # info.autotune publishes "the variants applied to THIS
+            # net", so a refused fusion must not be reported as
+            # applied (a bias_relu the bias pass refused downgrades
+            # to the relu fusion that did land, or disappears)
+            fuse = v.get("fuse")
+            if fuse == "bias_relu" and name not in self.fused_bias_lrn:
+                fuse = "relu" if name in self.fused_relu_lrn else None
+            elif fuse == "relu" and name not in self.fused_relu_lrn:
+                fuse = None
+            if fuse != v.get("fuse") and v.get("fuse") != "none":
+                log.warning(
+                    "autotune: fuse=%s on %s not applied (peephole "
+                    "eligibility) — reporting %s", v.get("fuse"), name,
+                    fuse or "unfused")
+                if fuse is None:
+                    v.pop("fuse")
+                else:
+                    v["fuse"] = fuse
+            if v:
+                keep[name] = v
+        self.layer_variants = keep
+        self._variant_dtype = {
+            n: jnp.dtype(v["dtype"]) for n, v in keep.items()
+            if v.get("dtype")}
+
+    def autotune_info(self) -> dict:
+        """The self-describing `info.autotune` block every metrics
+        artifact carries (like info.comm / info.sync): {"active":
+        False} when COS_AUTOTUNE is unset, else the plan's key, source,
+        and the per-layer variants actually applied to THIS net."""
+        if not self.autotune_plan:
+            return {"active": False}
+        p = self.autotune_plan
+        return {"active": True,
+                "source": p.get("source", "explicit"),
+                "key": p.get("key", {}),
+                "tolerance": p.get("tolerance"),
+                "measured": p.get("measured"),
+                "layers": {n: dict(v)
+                           for n, v in self.layer_variants.items()}}
 
     # ------------------------------------------------------------------
     def init(self, key: Array) -> Params:
@@ -447,32 +677,49 @@ class Net:
         blobs: Dict[str, Array] = dict(inputs)
         ctx = L.Ctx(train=train, rng=rng,
                     state_in=net_state or {}, state_out={},
-                    fused_relu_lrn=self.fused_relu_lrn)
+                    fused_relu_lrn=self.fused_relu_lrn,
+                    defer_bias=self._defer_bias,
+                    bias_lrn=self._bias_lrn_set)
         cast = (self.compute_dtype != self.dtype)
         for lp in self.compute_layers:
             op = L.get_op(lp.type)
             ctx.layer_name = lp.name
+            ctx.variant = self.layer_variants.get(lp.name)
+            # per-layer compute dtype: the autotune plan's dtype variant
+            # beats the net-wide compute_dtype (stat layers stay exempt
+            # — see the f32_stats comment below); with no variant this
+            # is exactly the pre-autotune cast, op for op
+            vdt = (None if op.f32_stats
+                   else self._variant_dtype.get(lp.name))
+            target = (self.dtype if op.f32_stats
+                      else (vdt or self.compute_dtype))
+            # any per-layer dtype variant makes EVERY layer normalize
+            # its floating bottoms to its own target (a bf16 layer's
+            # output must cast back up entering its f32 consumer);
+            # with no variants this reduces to the pre-autotune gate
+            docast = cast or bool(self._variant_dtype)
             lparams = []
             if lp.name in self.param_layout:
                 pd = params[lp.name]
                 lparams = [pd[bname]
                            for bname, _, _ in self.param_layout[lp.name]]
-                if cast and not op.f32_stats:
-                    lparams = [p.astype(self.compute_dtype)
-                               for p in lparams]
+            if lp.name in self.fused_bias_lrn:
+                # bias-fused stem LRN: the producing conv's bias rides
+                # in as params[0]; its gradient flows back to the conv
+                # blob through the fused kernel's VJP
+                lparams = [params[self.fused_bias_lrn[lp.name]]["bias"]] \
+                    + lparams
+            if docast and not op.f32_stats and lparams:
+                lparams = [p.astype(target) for p in lparams]
             bottoms = [blobs[b] for b in lp.bottom]
-            if cast and not op.f32_stats:
-                # stat layers (BatchNorm) also keep their INPUT at full
+            if docast:
+                # stat layers (BatchNorm) keep their INPUT at full
                 # precision: E[x²]−E[x]² cancels catastrophically in
-                # bf16 for unnormalized activations
-                bottoms = [b.astype(self.compute_dtype)
+                # bf16 for unnormalized activations — their target is
+                # self.dtype above
+                bottoms = [b.astype(target)
                            if jnp.issubdtype(b.dtype, jnp.floating)
-                           and b.dtype != self.compute_dtype else b
-                           for b in bottoms]
-            elif cast and op.f32_stats:
-                bottoms = [b.astype(self.dtype)
-                           if jnp.issubdtype(b.dtype, jnp.floating)
-                           and b.dtype != self.dtype else b
+                           and b.dtype != target else b
                            for b in bottoms]
             if self.remat and train and lparams \
                     and not op.f32_stats:

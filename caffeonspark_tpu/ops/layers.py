@@ -52,10 +52,29 @@ class Ctx:
     # LRN layer names whose op applies relu in-kernel (net.py's
     # COS_FUSE_RELU_LRN peephole)
     fused_relu_lrn: frozenset = frozenset()
+    # this layer's autotune variant (per-layer precision/layout/fusion
+    # plan entry, resolved ONCE at Net construction — ops must never
+    # read env for these; None = no override, the inert default)
+    variant: Optional[Dict] = None
+    # conv-stem bias fusion (net.py peephole, generalized): conv layer
+    # names whose bias add is deferred into the consuming LRN kernel,
+    # and the LRN layer names that receive the bias as params[0]
+    defer_bias: frozenset = frozenset()
+    bias_lrn: frozenset = frozenset()
 
     def take_rng(self) -> Array:
         assert self.rng is not None, "layer needs rng but none provided"
         return jax.random.fold_in(self.rng, stable_hash(self.layer_name))
+
+    def precision(self):
+        """MXU precision pin for this layer's contractions: a layer the
+        autotune plan holds at float32 computes at HIGHEST precision
+        (the COS002 precision-floor discipline — an f32 variant that
+        still multiplied in bf16 passes would be a lie); None
+        otherwise (jax default)."""
+        if self.variant and self.variant.get("dtype") == "float32":
+            return jax.lax.Precision.HIGHEST
+        return None
 
 
 def stable_hash(name: str) -> int:
@@ -200,6 +219,15 @@ def _conv_params(lp, shapes):
     return specs
 
 
+def _s2d_geometry_ok(c_in, cp, kh, kw, sh, sw, dh, dw) -> bool:
+    """Geometric eligibility for the space-to-depth stem rewrite:
+    C_in<=4, square stride>=2, no dilation, no groups.  Separated from
+    the enable decision so the autotuner can both force the rewrite on
+    a layer and enumerate it from blob shapes — ONE copy of the rule."""
+    return (c_in <= 4 and sh == sw and sh >= 2
+            and dh == dw == 1 and max(1, cp.group) == 1)
+
+
 def _s2d_eligible(x, cp, kh, kw, sh, sw, dh, dw) -> bool:
     """Stem convs (C_in<=4, stride>=2) hit the MXU badly: the 8-lane
     channel padding and the strided 11x11/7x7 window waste most of the
@@ -216,8 +244,8 @@ def _s2d_eligible(x, cp, kh, kw, sh, sw, dh, dw) -> bool:
     else:
         from .pallas_kernels import pallas_enabled
         enabled = pallas_enabled()
-    return (enabled and x.shape[1] <= 4 and sh == sw and sh >= 2
-            and dh == dw == 1 and max(1, cp.group) == 1)
+    return enabled and _s2d_geometry_ok(x.shape[1], cp, kh, kw, sh, sw,
+                                        dh, dw)
 
 
 def _conv_layout() -> str:
@@ -232,18 +260,20 @@ def _conv_layout() -> str:
     return os.environ.get("COS_CONV_LAYOUT", "NCHW").upper()
 
 
-def _nhwc_conv(x, w, strides, padding, rhs_dilation, groups):
+def _nhwc_conv(x, w, strides, padding, rhs_dilation, groups,
+               precision=None):
     """x (N,C,H,W), w (O,I/g,kh,kw) → NHWC-internal conv → (N,O,oh,ow)."""
     xt = x.transpose(0, 2, 3, 1)
     wt = w.transpose(2, 3, 1, 0)  # OIHW → HWIO
     out = lax.conv_general_dilated(
         xt, wt, window_strides=strides, padding=padding,
         rhs_dilation=rhs_dilation, feature_group_count=groups,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=precision)
     return out.transpose(0, 3, 1, 2)
 
 
-def _s2d_conv(x, w, s, kh, kw, ph, pw):
+def _s2d_conv(x, w, s, kh, kw, ph, pw, precision=None):
     """stride-s conv as a stride-1 conv over s x s space-to-depth blocks.
 
     x: (N, C, H, W) already conceptually padded by (ph, pw) — padding is
@@ -270,7 +300,8 @@ def _s2d_conv(x, w, s, kh, kw, ph, pw):
     wp = wp.reshape(oc, c * s * s, kb_h, kb_w)
     return lax.conv_general_dilated(
         xt, wp, window_strides=(1, 1), padding="VALID",
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=precision)
 
 
 @register("Convolution", params=_conv_params)
@@ -279,22 +310,34 @@ def _conv(ctx, lp, params, bottoms):
     (kh, kw), (sh, sw), (ph, pw), (dh, dw) = _conv_geometry(cp)
     x = bottoms[0]
     w = params[0]
+    # per-layer autotune variant (resolved at Net construction) beats
+    # the global env knobs; absent a variant the env behavior is
+    # byte-identical to pre-autotune
+    v = ctx.variant or {}
+    layout = (v.get("layout") or "").lower()
+    prec = ctx.precision()
     # no preferred_element_type: the TPU MXU accumulates in f32
     # internally either way, and forcing an f32 output breaks the
     # conv transpose (backward) for bf16 nets with a dtype mismatch
-    if _conv_layout() == "NHWC":
+    if layout == "nhwc" or (not layout and _conv_layout() == "NHWC"):
         # NHWC experiment measures the plain conv, not the s2d rewrite —
         # one variable at a time (s2d is itself a layout transform).
         out = _nhwc_conv(x, w, (sh, sw), [(ph, ph), (pw, pw)],
-                         (dh, dw), max(1, cp.group))
-    elif _s2d_eligible(x, cp, kh, kw, sh, sw, dh, dw):
-        out = _s2d_conv(x, w, sh, kh, kw, ph, pw)
+                         (dh, dw), max(1, cp.group), precision=prec)
+    elif (layout == "s2d"
+          and _s2d_geometry_ok(x.shape[1], cp, kh, kw, sh, sw, dh, dw)) \
+            or (not layout
+                and _s2d_eligible(x, cp, kh, kw, sh, sw, dh, dw)):
+        out = _s2d_conv(x, w, sh, kh, kw, ph, pw, precision=prec)
     else:
         out = lax.conv_general_dilated(
             x, w, window_strides=(sh, sw), padding=[(ph, ph), (pw, pw)],
             rhs_dilation=(dh, dw), feature_group_count=max(1, cp.group),
-            dimension_numbers=("NCHW", "OIHW", "NCHW"))
-    if cp.bias_term:
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            precision=prec)
+    if cp.bias_term and ctx.layer_name not in ctx.defer_bias:
+        # defer_bias: the bias add (and relu+LRN) runs in the consuming
+        # LRN layer's fused epilogue (net.py stem peephole)
         out = out + params[1].reshape(1, -1, 1, 1)
     return [out]
 
@@ -366,7 +409,17 @@ def _inner_product(ctx, lp, params, bottoms):
     lead = x.shape[:axis]
     x2 = x.reshape((math.prod(lead), -1))
     w = params[0]
-    y = x2 @ w if ip.transpose else x2 @ w.T
+    v = ctx.variant or {}
+    if v.get("int8") and not ctx.train:
+        # quantized serving forward (autotune variant; TEST-phase nets
+        # only — net.py refuses int8 on a TRAIN net): int8×int8 MXU
+        # matmul on per-blob max-abs scales, int32 accumulation
+        from .pallas_kernels import int8_inner_product
+        y = int8_inner_product(x2, w, transpose=bool(ip.transpose))
+    else:
+        prec = ctx.precision()
+        y = (jnp.matmul(x2, w, precision=prec) if ip.transpose
+             else jnp.matmul(x2, w.T, precision=prec))
     if ip.bias_term:
         y = y + params[1]
     return [y.reshape(lead + (ip.num_output,))]
@@ -613,6 +666,18 @@ def _lrn(ctx, lp, params, bottoms):
     # relu in-kernel (pallas) or inline (XLA fallback) — identical
     # semantics on every backend
     fuse_relu = lp.name in ctx.fused_relu_lrn
+    if lp.name in ctx.bias_lrn:
+        # generalized stem epilogue (net.py bias peephole): the
+        # producing conv's bias arrives as params[0] and bias-add +
+        # relu + LRN run in one fused pass (pallas on TPU, the
+        # identical-semantics XLA chain elsewhere)
+        from .pallas_kernels import (bias_relu_lrn_across_channels,
+                                     pallas_enabled, xla_bias_relu_lrn)
+        bias = params[0]
+        if pallas_enabled() and x.ndim == 4:
+            return [bias_relu_lrn_across_channels(x, bias, n, alpha,
+                                                  beta, k)]
+        return [xla_bias_relu_lrn(x, bias, n, alpha, beta, k)]
     if p.norm_region == NormRegion.ACROSS_CHANNELS:
         from .pallas_kernels import lrn_across_channels, pallas_enabled
         if pallas_enabled() and x.ndim == 4:
@@ -622,12 +687,10 @@ def _lrn(ctx, lp, params, bottoms):
                                         fuse_relu)]
         if fuse_relu:
             x = jnp.maximum(x, 0)
-        sq = x * x
-        pad = n // 2
-        sqp = jnp.pad(sq, ((0, 0), (pad, pad), (0, 0), (0, 0)))
-        s = lax.reduce_window(sqp, 0.0, lax.add, (1, n, 1, 1),
-                              (1, 1, 1, 1), "VALID")
-        scale = k + (alpha / n) * s
+        # one shared XLA fallback chain (pallas_kernels owns it so the
+        # fused-epilogue fallback can never drift from this path)
+        from .pallas_kernels import xla_lrn_across_channels
+        return [xla_lrn_across_channels(x, n, alpha, beta, k)]
     else:  # WITHIN_CHANNEL: spatial window average of squares
         sq = x * x
         pad = n // 2
@@ -1266,7 +1329,14 @@ def _mha(ctx, lp, params, bottoms):
     # (B, H, T, hd)
     q, k, v = (jnp.moveaxis(qkv[:, :, i], (0, 1, 2), (2, 0, 1))
                for i in range(3))
-    o = _attention_dispatch(q, k, v, causal=bool(ap.causal))
+    var = ctx.variant or {}
+    if var.get("attention") == "reference":
+        # autotune variant: pin the einsum reference path (A/B partner
+        # of the flash dispatch; same math, see tests/test_pallas.py)
+        with suppress_flash():
+            o = _attention_dispatch(q, k, v, causal=bool(ap.causal))
+    else:
+        o = _attention_dispatch(q, k, v, causal=bool(ap.causal))
     # back to (T, B, H*hd)
     o = jnp.moveaxis(o, (0, 1, 2), (1, 2, 0)).reshape(t_steps, batch,
                                                       h * hd)

@@ -165,6 +165,223 @@ def _lrn_vjp_bwd(local_size, alpha, beta, k, interpret, fuse_relu, res,
 lrn_across_channels.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Fused conv-stem epilogue: bias + ReLU + LRN in one VMEM pass
+# ---------------------------------------------------------------------------
+# Generalizes the fuse_relu LRN kernel one producer further: the conv's
+# per-channel bias add joins relu+lrn in the epilogue, so the conv can
+# emit its RAW matmul output and the stem chain conv→(+bias)→relu→lrn
+# costs one HBM read + one write per element instead of materializing
+# the biased pre-activation as the kernel's residual.  Backward parity
+# follows the existing kernel's design: the VJP kernel recomputes the
+# biased input, the relu mask, and the normalizers in VMEM from the
+# saved RAW x + bias; d_bias is the channel-sum of d_x (exact — the
+# bias add is an affine shift), reduced in XLA where it fuses.
+
+def _lrn_kernel_fwd_bias(x_ref, b_ref, o_ref, *, local_size: int,
+                         alpha: float, beta: float, k: float):
+    """lrn(relu(x + bias)) — the bias_relu epilogue forward.  Math in
+    f32 in VMEM regardless of I/O dtype (see _lrn_kernel_fwd_only)."""
+    x = x_ref[0].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    x = jnp.maximum(x, 0.0)
+    pad = local_size // 2
+    scale = k + (alpha / local_size) * _window_sum(x * x, pad)
+    o_ref[0] = (x * jnp.exp(-beta * jnp.log(scale))).astype(o_ref.dtype)
+
+
+def _lrn_bwd_kernel_bias(x_ref, b_ref, dy_ref, dx_ref, *,
+                         local_size: int, alpha: float, beta: float,
+                         k: float):
+    """d/d(x) of lrn(relu(x + bias)): the _lrn_bwd_kernel math on the
+    recomputed biased input, masked where x + bias < 0.  The returned
+    dx is ALSO d/d(x + bias), so the caller derives d_bias as its
+    (N, H, W) channel sum."""
+    xr = x_ref[0].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    dy = dy_ref[0].astype(jnp.float32)
+    x = jnp.maximum(xr, 0.0)
+    pad = local_size // 2
+    s = k + (alpha / local_size) * _window_sum(x * x, pad)
+    s_nb = jnp.exp(-beta * jnp.log(s))        # s^{-β}
+    u = dy * x * s_nb / s                      # dy·x·s^{-β-1}
+    dx = dy * s_nb - (2.0 * alpha * beta / local_size) * x \
+        * _window_sum(u, pad)
+    dx = jnp.where(xr > 0.0, dx, 0.0)
+    dx_ref[0] = dx.astype(dx_ref.dtype)
+
+
+def _bias_spec(c):
+    return pl.BlockSpec((c, 1), lambda i, j: (0, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _bias_col(bias):
+    # (C,) → (C, 1) f32 column: broadcasts against the (C, TILE) block
+    return bias.astype(jnp.float32).reshape(-1, 1)
+
+
+def _bias_lrn_fwd_call(x, bias, local_size, alpha, beta, k, interpret):
+    n, c, h, w = x.shape
+    xf, hw, padded = _pad_flat(x)
+    kern = functools.partial(_lrn_kernel_fwd_bias, local_size=local_size,
+                             alpha=alpha, beta=beta, k=k)
+    out = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((n, c, padded), x.dtype),
+        grid=(n, padded // TILE),
+        in_specs=[_block_spec(c), _bias_spec(c)],
+        out_specs=_block_spec(c),
+        interpret=interpret,
+    )(xf, _bias_col(bias))
+    return out[:, :, :hw].reshape(n, c, h, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def bias_relu_lrn_across_channels(x: jax.Array, bias: jax.Array,
+                                  local_size: int = 5,
+                                  alpha: float = 1e-4,
+                                  beta: float = 0.75, k: float = 1.0,
+                                  interpret: bool = False) -> jax.Array:
+    """(N, C, H, W) raw conv output + (C,) bias → lrn(relu(x + bias)),
+    Caffe LRN semantics, one fused pass.  Differentiable in x AND bias:
+    the VJP kernel recomputes bias-add, relu mask and normalizers in
+    VMEM (residuals: the raw x and the (C,) bias — no biased
+    pre-activation is ever materialized in HBM)."""
+    return _bias_lrn_fwd_call(x, bias, local_size, alpha, beta, k,
+                              interpret)
+
+
+def _bias_lrn_vjp_fwd(x, bias, local_size, alpha, beta, k, interpret):
+    out = _bias_lrn_fwd_call(x, bias, local_size, alpha, beta, k,
+                             interpret)
+    return out, (x, bias)
+
+
+def _bias_lrn_vjp_bwd(local_size, alpha, beta, k, interpret, res, dy):
+    x, bias = res
+    n, c, h, w = x.shape
+    xf, hw, padded = _pad_flat(x)
+    dyf, _, _ = _pad_flat(dy)
+    kern = functools.partial(_lrn_bwd_kernel_bias, local_size=local_size,
+                             alpha=alpha, beta=beta, k=k)
+    dx = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((n, c, padded), x.dtype),
+        grid=(n, padded // TILE),
+        in_specs=[_block_spec(c), _bias_spec(c), _block_spec(c)],
+        out_specs=_block_spec(c),
+        interpret=interpret,
+    )(xf, _bias_col(bias), dyf)
+    dx = dx[:, :, :hw].reshape(n, c, h, w)
+    # the padded tail lanes of dx are exact zeros (dy padding), so the
+    # channel sum over the CROPPED dx is the exact d_bias
+    db = jnp.sum(dx.astype(jnp.float32), axis=(0, 2, 3)).astype(
+        bias.dtype)
+    return dx, db
+
+
+bias_relu_lrn_across_channels.defvjp(_bias_lrn_vjp_fwd,
+                                     _bias_lrn_vjp_bwd)
+
+
+def xla_lrn_across_channels(x, local_size, alpha, beta, k):
+    """THE XLA across-channels LRN fallback chain (square → channel
+    reduce_window → scale → divide) — one copy shared by
+    ops.layers._lrn's off-TPU path and the fused-epilogue fallback
+    below, so a numerics fix can never land in one and miss the
+    other."""
+    from jax import lax
+    sq = x * x
+    pad = local_size // 2
+    sqp = jnp.pad(sq, ((0, 0), (pad, pad), (0, 0), (0, 0)))
+    s = lax.reduce_window(sqp, 0.0, lax.add, (1, local_size, 1, 1),
+                          (1, 1, 1, 1), "VALID")
+    scale = k + (alpha / local_size) * s
+    return x / jnp.power(scale, beta)
+
+
+def xla_bias_relu_lrn(x, bias, local_size, alpha, beta, k):
+    """Reference/fallback path for the fused stem epilogue — identical
+    semantics on every backend (ops.layers._lrn routes here off-TPU)."""
+    x = jnp.maximum(x + bias.reshape(1, -1, 1, 1).astype(x.dtype), 0)
+    return xla_lrn_across_channels(x, local_size, alpha, beta, k)
+
+
+# ---------------------------------------------------------------------------
+# int8 forward matmul (serving InnerProduct)
+# ---------------------------------------------------------------------------
+# The quantized-serving down payment (ROADMAP item 3): InnerProduct
+# forward as an int8×int8 MXU matmul with int32 accumulation, weights
+# and activations on per-blob max-abs scales — the exact scale
+# machinery gradsync's int8 wire uses (parallel/gradsync.quantize_int8,
+# round-to-nearest here: inference wants determinism, not unbiased
+# accumulation).  int8 quarters the weight HBM read and doubles MXU
+# issue rate on chips with int8 MXU paths; accuracy drift is gated by
+# the autotuner's pinned parity tolerance before the variant is chosen.
+
+INT8_BLOCK_M = 32          # int8 min sublane tile
+INT8_BLOCK_N = 128
+INT8_BLOCK_LANE = 128      # K must tile the 128-lane dimension
+
+
+def _int8_matmul_kernel(x_ref, w_ref, o_ref):
+    # (bm, K) int8 · (bn, K) int8 → (bm, bn) int32 on the MXU
+    o_ref[...] = jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+def int8_matmul(xq: jax.Array, wq: jax.Array, *,
+                interpret: bool = False) -> jax.Array:
+    """(M, K) int8 @ (N, K) int8ᵀ → (M, N) int32.  Pallas-tiled when
+    the shapes tile (grid over M/N blocks, K resident per block — the
+    flash kernels' layout); XLA int8 dot_general otherwise (same
+    int32-accumulated math on every backend, incl. CPU)."""
+    m, kk = xq.shape
+    n = wq.shape[0]
+    tiles = (m % INT8_BLOCK_M == 0 and n % INT8_BLOCK_N == 0
+             and kk % INT8_BLOCK_LANE == 0)
+    if tiles and (interpret or pallas_enabled()):
+        xspec = pl.BlockSpec((INT8_BLOCK_M, kk), lambda i, j: (i, 0),
+                             memory_space=pltpu.VMEM)
+        wspec = pl.BlockSpec((INT8_BLOCK_N, kk), lambda i, j: (j, 0),
+                             memory_space=pltpu.VMEM)
+        ospec = pl.BlockSpec((INT8_BLOCK_M, INT8_BLOCK_N),
+                             lambda i, j: (i, j),
+                             memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            _int8_matmul_kernel,
+            out_shape=jax.ShapeDtypeStruct((m, n), jnp.int32),
+            grid=(m // INT8_BLOCK_M, n // INT8_BLOCK_N),
+            in_specs=[xspec, wspec],
+            out_specs=ospec,
+            interpret=interpret,
+        )(xq, wq)
+    return jax.lax.dot_general(xq, wq, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.int32)
+
+
+def int8_inner_product(x: jax.Array, w: jax.Array, *,
+                       transpose: bool = False,
+                       interpret: bool = False) -> jax.Array:
+    """Quantized InnerProduct forward: y ≈ x @ wᵀ (Caffe layout; or
+    x @ w when `transpose`), both operands on per-blob max-abs int8
+    scales, int32 accumulation, output in x's dtype.  Forward-only —
+    the serving path; training never routes here.
+
+    The weight quantizes INSIDE the traced forward (per call, not per
+    model): an O(N·K) abs-max+round the published model pays on every
+    flush.  The autotuner's A/B measures the variant WITH this cost,
+    so a net where re-quantization eats the matmul win simply never
+    selects int8; hoisting (wq, sw) to ModelRegistry.publish is the
+    follow-on for ROADMAP item 3's full quantized-serving story."""
+    from ..parallel.gradsync import quantize_int8
+    wn = w.T if transpose else w              # (N, K)
+    xq, sx = quantize_int8(x, None)
+    wqn, sw = quantize_int8(wn, None)
+    acc = int8_matmul(xq, wqn, interpret=interpret)
+    return (acc.astype(jnp.float32) * (sx * sw)).astype(x.dtype)
+
+
 def pallas_enabled() -> bool:
     """Pallas kernels activate on real TPU backends only (CPU tests use
     interpret=True explicitly)."""

@@ -321,6 +321,11 @@ class CaffeProcessor:
             gs = getattr(solver, "grad_sync", None)
             if gs is not None:
                 self.metrics.set_info("comm", gs.plan.comm_info())
+            # autotune plan (COS_AUTOTUNE) into the artifact exactly
+            # like info.comm/info.sync: {"active": false} when unset,
+            # else the plan key + per-layer variants applied
+            self.metrics.set_info(
+                "autotune", solver.train_net.autotune_info())
             # unified chaos layer (tools/chaos.py): the driver path
             # honors the step-delay / die-once / slow-rank injectors
             # too, and publishes the resolved plan so every metrics

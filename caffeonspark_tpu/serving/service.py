@@ -105,6 +105,12 @@ class InferenceService:
             # scrapers, and bench artifacts read it from the same
             # PipelineMetrics info block PR 6 used for the comm plan
             self.metrics.set_info("serve_mesh", layout.describe())
+        # the serving net resolves COS_AUTOTUNE at construction like
+        # any Net (int8 InnerProduct is serving-only, so a serve-mode
+        # plan lands here); publish what was applied so replica
+        # /metrics and warmup artifacts are self-describing
+        self.metrics.set_info("autotune",
+                              self.registry.net.autotune_info())
         self._started = False
         self._draining = False   # rolling-swap state: reject new work
         self._warmup_wall_s: Optional[float] = None

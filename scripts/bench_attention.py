@@ -29,28 +29,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 import numpy as np
 
-# bf16 peak TFLOP/s per chip by device_kind substring (public spec
-# sheets); MFU is reported against the RUNNING chip's peak, not a
-# hard-coded generation, so committed evidence is self-describing.
-_PEAK_BF16_TFLOPS = (
-    ("v6e", 918.0), ("trillium", 918.0),
-    ("v5p", 459.0),
-    ("v5e", 197.0), ("v5 lite", 197.0), ("v5litepod", 197.0),
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-)
-
-
-def peak_tflops(device) -> tuple:
-    """(peak_bf16_tflops, source) for the local device, or
-    (None, 'unknown') when the device_kind matches no known chip —
-    callers then fall back to an explicitly-labeled v5e reference."""
-    kind = str(getattr(device, "device_kind", "")).lower()
-    for sub, peak in _PEAK_BF16_TFLOPS:
-        if sub in kind:
-            return peak, f"device_kind:{kind}"
-    return None, "unknown"
+# bf16 peak TFLOP/s per chip by device_kind substring: ONE copy, in
+# analysis/roofline.py (bench.py resolves through it too); MFU is
+# reported against the RUNNING chip's peak, not a hard-coded
+# generation, so committed evidence is self-describing.
+from caffeonspark_tpu.analysis.roofline import peak_tflops  # noqa: E402
 
 
 def main():

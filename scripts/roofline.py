@@ -1,20 +1,16 @@
-"""Roofline analysis for a prototxt net on TPU (VERDICT r3 ask #4).
+"""Roofline analysis CLI for a prototxt net on TPU (VERDICT r3 ask #4).
 
-For every compute layer, bounds one train step's time by
-max(FLOPs / MXU peak, HBM bytes / bandwidth) and aggregates into the
-roofline-implied throughput ceiling — the quantitative answer to
-"is 38% MFU the ceiling for CaffeNet's profile, or is there headroom?"
+The per-layer FLOPs/bytes model lives in
+`caffeonspark_tpu.analysis.roofline` (importable — the per-layer
+autotuner ranks its variant search with it); this script is the CLI
+shim: it builds the Net, runs the model, adds the gradient-exchange
+accounting, and prints the report.
 
-Model (estimate-grade, stated so the numbers are auditable):
-  * forward bytes/layer = in + out activations + params read;
-  * backward ≈ 2x forward traffic (dL/dx needs weights + stashed
-    activations; dL/dW needs activations + writes grads) and 2x
-    forward FLOPs for weighted layers;
-  * optimizer: read param+momentum, write param+momentum in f32
-    (16 bytes/param) regardless of compute dtype;
-  * --fused drops elementwise layers' activation traffic (XLA fuses
-    ReLU/Dropout/eltwise into the producing matmul/conv) — the fused
-    and unfused totals bracket reality;
+Model (estimate-grade — see analysis/roofline.py for the full
+statement):
+  * step time per layer = max(FLOPs / MXU peak, HBM bytes / bandwidth);
+  * backward ≈ 2x forward traffic and FLOPs; optimizer 16 bytes/param;
+  * --fused drops elementwise layers' activation traffic;
   * gradient exchange (--dp > 1): per-layer ring all-reduce wire
     traffic 2·params·wire_bytes·(dp-1)/dp against --interconnect-gbs,
     wire dtype from --grad-sync (default/bucket f32, quant bf16 — or
@@ -30,6 +26,8 @@ Usage:
 
 Defaults model TPU v5e (197 bf16 TFLOP/s, 819 GB/s HBM) and the
 bench.py default config (bvlc_reference_net @ batch 256, mixed).
+--json output carries `schema` and `model_version` (from
+analysis/roofline.py) so downstream consumers detect model changes.
 """
 
 from __future__ import annotations
@@ -37,43 +35,17 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import sys
-from math import prod
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-
-ELEMENTWISE = {"ReLU", "Dropout", "Eltwise", "Scale", "Bias", "PReLU",
-               "Sigmoid", "TanH", "ELU", "AbsVal", "Power", "Exp",
-               "Log", "BNLL"}
-MEMBOUND = {"Pooling", "LRN", "Softmax", "SoftmaxWithLoss", "Concat",
-            "Slice", "Flatten", "Reshape", "BatchNorm", "Accuracy"}
-
-
-def analyze(net, *, act_bytes: int, param_bytes: int, fused: bool):
-    from caffeonspark_tpu.utils.flops import layer_forward_flops
-    per_layer = layer_forward_flops(net)
-    rows = []
-    for lp in net.compute_layers:
-        tops = net._top_shapes.get(lp.name, {})
-        out_elems = sum(prod(s) for s in tops.values())
-        in_elems = sum(prod(net.blob_shapes[b]) for b in lp.bottom
-                       if b in net.blob_shapes)
-        p_elems = sum(prod(s) for _, s, _ in
-                      net.param_layout.get(lp.name, []))
-        flops = per_layer.get(lp.name, 0)
-        fwd_bytes = ((in_elems + out_elems) * act_bytes
-                     + p_elems * param_bytes)
-        if fused and lp.type in ELEMENTWISE:
-            fwd_bytes = 0          # fused into the producer's epilogue
-        # backward: ~2x forward traffic and 2x weighted FLOPs; +
-        # optimizer f32 param/momentum round trip
-        step_bytes = 3 * fwd_bytes + 16 * p_elems
-        step_flops = 3 * flops
-        rows.append({"layer": lp.name, "type": lp.type,
-                     "flops": step_flops, "bytes": step_bytes,
-                     "params": p_elems})
-    return rows
+try:
+    import caffeonspark_tpu  # noqa: F401  — installed: the normal case
+except ModuleNotFoundError:
+    # uninstalled checkout only: make the repo root importable.  The
+    # MODEL no longer needs this (it lives in the package,
+    # caffeonspark_tpu.analysis.roofline); this is just how the CLI
+    # shim finds the package before `make install` has run.
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
 
 
 def main():
@@ -106,6 +78,7 @@ def main():
                     "the slow hop by")
     args = ap.parse_args()
 
+    from caffeonspark_tpu.analysis import roofline as rl
     from caffeonspark_tpu.net import Net
     from caffeonspark_tpu.proto import NetState, Phase, read_net
     from caffeonspark_tpu.models import zoo
@@ -135,10 +108,10 @@ def main():
     act_bytes = 2 if args.dtype == "mixed" else 4
     # mixed keeps f32 master weights but computes in bf16: the compute
     # path reads a bf16 copy (2B); the optimizer traffic (16B/param) is
-    # accounted separately in analyze()
+    # accounted separately in the model
     param_bytes = 2 if args.dtype == "mixed" else 4
-    rows = analyze(net, act_bytes=act_bytes, param_bytes=param_bytes,
-                   fused=args.fused)
+    rows = rl.analyze_net(net, act_bytes=act_bytes,
+                          param_bytes=param_bytes, fused=args.fused)
 
     peak = args.peak_tflops * 1e12
     bw = args.hbm_gbs * 1e9
@@ -196,7 +169,9 @@ def main():
     }
 
     if args.json:
-        print(json.dumps({"rows": rows, "total_flops": total_flops,
+        print(json.dumps({"schema": rl.SCHEMA,
+                          "model_version": rl.MODEL_VERSION,
+                          "rows": rows, "total_flops": total_flops,
                           "roofline_step_us": round(t_roof, 1),
                           "ceiling_images_per_sec": round(ceil_ips, 0),
                           "ceiling_mfu": round(ceil_mfu, 4),

@@ -493,6 +493,34 @@ def test_s2d_conv_layer_path(monkeypatch):
                                rtol=2e-5, atol=2e-4)
 
 
+def test_s2d_conv_layer_grad_parity(monkeypatch):
+    """Backward parity pin for the s2d stem rewrite through the layer
+    op: input AND weight gradients match the direct strided conv —
+    the autotuner composes this variant, so it is pinned individually
+    (forward parity is test_s2d_conv_layer_path)."""
+    from caffeonspark_tpu.proto.caffe import LayerParameter
+    from caffeonspark_tpu.ops.layers import get_op, Ctx
+    lp = LayerParameter.from_text(
+        'name: "conv1" type: "Convolution" bottom: "data" top: "conv1" '
+        'convolution_param { num_output: 16 kernel_size: 11 stride: 4 }')
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.rand(2, 3, 67, 67).astype(np.float32))
+    w = jnp.asarray(rs.randn(16, 3, 11, 11).astype(np.float32) * 0.05)
+    b = jnp.asarray(rs.randn(16).astype(np.float32))
+    op = get_op("Convolution")
+
+    def loss(a, p):
+        return jnp.sum(op.apply(Ctx(), lp, [p, b], [a])[0] ** 2)
+
+    monkeypatch.setenv("COS_CONV_S2D", "0")
+    g0 = jax.grad(loss, argnums=(0, 1))(x, w)
+    monkeypatch.setenv("COS_CONV_S2D", "1")
+    g1 = jax.grad(loss, argnums=(0, 1))(x, w)
+    for a, bb in zip(g0, g1):
+        np.testing.assert_allclose(np.asarray(bb), np.asarray(a),
+                                   rtol=2e-4, atol=2e-3)
+
+
 def test_nhwc_conv_layout_parity(monkeypatch):
     """COS_CONV_LAYOUT=NHWC (layout A/B lever) matches the default NCHW
     path — forward and grads — across plain/strided/grouped/dilated
@@ -789,3 +817,74 @@ layer { name: "ip2" type: "InnerProduct" bottom: "conv1" top: "ip2"
     net = Net(NetParameter.from_text(txt), NetState(phase=Phase.TRAIN))
     assert net.fused_relu_lrn == set()
     assert any(lp.name == "relu1" for lp in net.compute_layers)
+
+
+def test_bias_relu_lrn_peephole_matches_unfused(monkeypatch):
+    """COS_FUSE_BIAS_RELU_LRN=1 additionally defers the conv's bias
+    add into the fused LRN epilogue: the conv emits its raw matmul
+    output, the LRN kernel applies bias+relu+lrn, and EVERY gradient
+    — including the conv's bias — matches the unfused net."""
+    np_ = NetParameter.from_text(_FUSE_NET)
+    key = jax.random.key(7)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 6, 5, 5),
+                    jnp.float32)
+
+    monkeypatch.delenv("COS_FUSE_RELU_LRN", raising=False)
+    net_ref = Net(np_, NetState(phase=Phase.TRAIN))
+    p_ref = net_ref.init(key)
+    monkeypatch.setenv("COS_FUSE_BIAS_RELU_LRN", "1")
+    net_fu = Net(np_, NetState(phase=Phase.TRAIN))
+    assert net_fu.fused_relu_lrn == {"norm1"}
+    assert net_fu.fused_bias_lrn == {"norm1": "conv1"}
+    p_fu = net_fu.init(key)
+
+    def out_sum(net, p):
+        blobs, _ = net.apply(p, {"data": x}, train=True,
+                             rng=jax.random.key(1))
+        return jnp.sum(blobs["ip"] ** 2)
+
+    np.testing.assert_allclose(float(out_sum(net_fu, p_fu)),
+                               float(out_sum(net_ref, p_ref)),
+                               rtol=1e-6)
+    g_ref = jax.grad(lambda p: out_sum(net_ref, p))(p_ref)
+    g_fu = jax.grad(lambda p: out_sum(net_fu, p))(p_fu)
+    for ln in g_ref:
+        for bn in g_ref[ln]:
+            np.testing.assert_allclose(
+                np.asarray(g_fu[ln][bn]), np.asarray(g_ref[ln][bn]),
+                rtol=1e-5, atol=1e-6, err_msg=f"{ln}/{bn}")
+
+
+def test_bias_fusion_skips_shared_conv_top(monkeypatch):
+    """If another layer consumes the conv's top, the bias must stay in
+    the conv (only relu fuses); the consumer needs the biased value."""
+    txt = _FUSE_NET + """
+layer { name: "ip2" type: "InnerProduct" bottom: "norm1" top: "ip2"
+  inner_product_param { num_output: 3
+    weight_filler { type: "xavier" } } }"""
+    # non-in-place relu so a second consumer can reach the conv top
+    # directly: relu still fuses (its own top has one consumer), but
+    # the bias must NOT defer — pool_extra needs the biased conv1
+    txt2 = """
+name: "fuse2"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 2 dim: 6 dim: 5 dim: 5 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "c1"
+  convolution_param { num_output: 8 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "c1" top: "r1" }
+layer { name: "norm1" type: "LRN" bottom: "r1" top: "norm1"
+  lrn_param { local_size: 3 alpha: 0.05 beta: 0.75 } }
+layer { name: "pool_extra" type: "Pooling" bottom: "c1"
+  top: "pool_extra"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "ip" type: "InnerProduct" bottom: "norm1" top: "ip"
+  inner_product_param { num_output: 4
+    weight_filler { type: "xavier" } } }"""
+    monkeypatch.setenv("COS_FUSE_BIAS_RELU_LRN", "1")
+    ok = Net(NetParameter.from_text(txt), NetState(phase=Phase.TRAIN))
+    assert ok.fused_bias_lrn == {"norm1": "conv1"}
+    shared = Net(NetParameter.from_text(txt2),
+                 NetState(phase=Phase.TRAIN))
+    assert shared.fused_relu_lrn == {"norm1"}     # relu still fuses
+    assert shared.fused_bias_lrn == {}            # bias must not

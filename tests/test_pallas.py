@@ -85,6 +85,93 @@ def test_lrn_pallas_fused_relu_matches_unfused():
         rtol=3e-4, atol=3e-5)
 
 
+def test_bias_relu_lrn_matches_chain():
+    """The generalized stem epilogue: bias_relu_lrn(x, b) must equal
+    lrn(relu(x + b)) — forward, dx AND d_bias (the bias gradient is
+    recovered as the channel sum of the kernel's dx)."""
+    from caffeonspark_tpu.ops.pallas_kernels import (
+        bias_relu_lrn_across_channels)
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(2, 8, 5, 7).astype(np.float32) * 2)
+    b = jnp.asarray(rng.randn(8).astype(np.float32))
+    dy = jnp.asarray(rng.randn(2, 8, 5, 7).astype(np.float32))
+
+    def chain(x, b):
+        xb = jax.nn.relu(x + b.reshape(1, -1, 1, 1))
+        return _xla_lrn(xb, alpha=0.05)
+
+    def f_ref(x, b):
+        return jnp.sum(chain(x, b) * dy)
+
+    def f_fused(x, b):
+        return jnp.sum(bias_relu_lrn_across_channels(
+            x, b, 5, 0.05, 0.75, 1.0, True) * dy)
+
+    np.testing.assert_allclose(
+        np.asarray(bias_relu_lrn_across_channels(x, b, 5, 0.05, 0.75,
+                                                 1.0, True)),
+        np.asarray(chain(x, b)), rtol=2e-5, atol=2e-6)
+    g_ref = jax.grad(f_ref, argnums=(0, 1))(x, b)
+    g_fus = jax.grad(f_fused, argnums=(0, 1))(x, b)
+    np.testing.assert_allclose(np.asarray(g_fus[0]),
+                               np.asarray(g_ref[0]),
+                               rtol=3e-4, atol=3e-5)
+    np.testing.assert_allclose(np.asarray(g_fus[1]),
+                               np.asarray(g_ref[1]),
+                               rtol=3e-4, atol=3e-5)
+
+
+def test_bias_relu_lrn_xla_fallback_matches_kernel():
+    """The off-TPU fallback (ops.layers routes through it) and the
+    pallas kernel are the same math."""
+    from caffeonspark_tpu.ops.pallas_kernels import (
+        bias_relu_lrn_across_channels, xla_bias_relu_lrn)
+    rng = np.random.RandomState(8)
+    x = jnp.asarray(rng.randn(1, 6, 4, 5).astype(np.float32))
+    b = jnp.asarray(rng.randn(6).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(bias_relu_lrn_across_channels(x, b, 5, 1e-4, 0.75,
+                                                 1.0, True)),
+        np.asarray(xla_bias_relu_lrn(x, b, 5, 1e-4, 0.75, 1.0)),
+        rtol=2e-5, atol=2e-6)
+
+
+def test_int8_matmul_pallas_matches_xla():
+    """The tiled int8 kernel is EXACT vs the XLA int8 dot_general
+    (int32 accumulation both ways)."""
+    from caffeonspark_tpu.ops.pallas_kernels import int8_matmul
+    rng = np.random.RandomState(9)
+    xq = jnp.asarray(rng.randint(-127, 128, (64, 256)).astype(np.int8))
+    wq = jnp.asarray(rng.randint(-127, 128, (128, 256)).astype(np.int8))
+    got = int8_matmul(xq, wq, interpret=True)
+    ref = jax.lax.dot_general(xq, wq, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    assert got.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    # non-tiling shapes take the XLA fallback — same result contract
+    got2 = int8_matmul(xq[:50], wq[:100], interpret=True)
+    np.testing.assert_array_equal(np.asarray(got2),
+                                  np.asarray(ref[:50, :100]))
+
+
+def test_int8_inner_product_tolerance():
+    """Per-blob max-abs int8 forward: bounded relative error vs f32,
+    and output dtype follows the activation."""
+    from caffeonspark_tpu.ops.pallas_kernels import int8_inner_product
+    rng = np.random.RandomState(10)
+    x = jnp.asarray(rng.randn(16, 64).astype(np.float32))
+    w = jnp.asarray(rng.randn(32, 64).astype(np.float32) * 0.1)
+    y8 = int8_inner_product(x, w)
+    yf = x @ w.T
+    assert y8.dtype == x.dtype
+    rel = float(jnp.max(jnp.abs(y8 - yf)) / jnp.max(jnp.abs(yf)))
+    assert 0 < rel < 0.05, rel
+    # transpose layout (ip.transpose weights are (K, N))
+    y8t = int8_inner_product(x, w.T, transpose=True)
+    np.testing.assert_allclose(np.asarray(y8t), np.asarray(y8),
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_lrn_pallas_bf16_io_f32_normalizer():
     """Mixed-precision training feeds the kernel bf16 activations; the
     normalizer must still be computed in f32.  In bf16 (eps ~ 8e-3)
