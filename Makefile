@@ -8,8 +8,9 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 
 .PHONY: build native install lint test test-slow spark-test bench \
   smoke tpu-tests bench-evidence bench-ingest bench-steploop \
-  bench-serving bench-serving-sharded bench-gradsync bench-syncmode \
-  bench-autotune chaos onchip-artifacts docs clean
+  bench-serving bench-serving-sharded bench-serving-multimodel \
+  bench-gradsync bench-syncmode bench-autotune chaos \
+  onchip-artifacts docs clean
 
 build: native install
 
@@ -130,6 +131,17 @@ bench-serving-sharded:
 	$(CPU_ENV) $(PY) scripts/bench_serving.py --tp 2 \
 	  --out bench_evidence/bench_serving_sharded.json
 
+# multi-model serving: models-per-chip x rows/s under a pinned HBM
+# budget — int8 quantized residency + LRU paging vs the f32 resident
+# baseline (gate: >=2x models at equal p99), per-net accuracy-drift
+# table, publish-time-vs-per-call weight-quantization A/B, zero fresh
+# compiles across every page-in (COS_RECOMPILE_GUARD armed); ALWAYS
+# exits 0 with one JSON document on stdout (bench.py contract)
+bench-serving-multimodel:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_serving.py --multimodel \
+	  --out bench_evidence/bench_serving_multimodel.json
+
 smoke:
 	BENCH_SMOKE=1 $(PY) bench.py
 
@@ -148,6 +160,8 @@ bench-evidence:
 	-BENCH_MODEL=resnet50 $(PY) bench.py
 	-$(CPU_ENV) $(PY) scripts/bench_autotune.py \
 	  --out bench_evidence/bench_autotune.json
+	-$(CPU_ENV) $(PY) scripts/bench_serving.py --multimodel \
+	  --out bench_evidence/bench_serving_multimodel.json
 
 # everything the judge wants from ONE healthy tunnel window, in
 # priority order: headline number + evidence, on-chip test artifact,

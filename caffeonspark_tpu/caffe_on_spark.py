@@ -423,7 +423,8 @@ def serve_fleet_main(conf: Config, replicas: int) -> int:
         # orphan N freshly-warmed replica subprocesses
         httpd = RouterHTTPServer(fleet.router, host=conf.serveHost,
                                  port=conf.servePort,
-                                 reload_fn=fleet.rolling_reload)
+                                 reload_fn=fleet.rolling_reload,
+                                 publish_fn=fleet.publish_model)
     except BaseException:
         fleet.stop()
         raise

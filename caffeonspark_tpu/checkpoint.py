@@ -93,6 +93,94 @@ def unflatten_host_params(flat: Dict[str, np.ndarray]) -> Params:
     return out
 
 
+# -- quantized sidecar (serving weight residency, serving/quant.py) --------
+# `<model>.quant` holds the PUBLISH-TIME compressed weights beside the
+# f32 .caffemodel: int8/bf16 blobs + per-blob max-abs scales, flat npz
+# under the "layer::blob" key grammar above (scales as
+# "layer::blob::scale").  Loading it lets a serving replica skip the
+# f32 parse, the quantization pass, AND the accuracy-drift gate that
+# already ran when the sidecar was written — a cold multi-model
+# replica pages straight from compressed bytes.  bfloat16 has no
+# stable npz dtype, so bf16 blobs persist as uint16 bit patterns and
+# the meta record lists which keys to view back.
+
+QUANT_SIDECAR_SUFFIX = ".quant"
+_QUANT_META_KEY = "__quant_meta__"
+_QUANT_SCHEMA = "cos-quant-sidecar-v1"
+
+
+def save_quant_sidecar(path: str,
+                       blobs: Dict[str, Dict[str, np.ndarray]],
+                       scales: Dict[str, Dict[str, float]],
+                       weight_dtype: str) -> str:
+    """Write the compressed-weight sidecar (atomic tmp+rename).
+    `blobs` are host arrays in STORAGE dtype (int8 / ml_dtypes
+    bfloat16 / f32), `scales` the int8 blobs' dequant scalars."""
+    import json
+    flat: Dict[str, np.ndarray] = {}
+    bf16_keys = []
+    for ln, bl in blobs.items():
+        if FLAT_KEY_SEP in ln:
+            raise ValueError(f"layer name {ln!r} contains "
+                             f"{FLAT_KEY_SEP!r}")
+        for bn, arr in bl.items():
+            key = f"{ln}{FLAT_KEY_SEP}{bn}"
+            a = np.asarray(arr)
+            if a.dtype.name == "bfloat16":
+                a = a.view(np.uint16)
+                bf16_keys.append(key)
+            flat[key] = a
+    # scales live in their OWN key namespace (a "__scale__::" prefix,
+    # not a suffix): a Scale layer's learnable blob is literally named
+    # "scale", so a suffix grammar would collide with real blob data
+    for ln, bl in scales.items():
+        for bn, s in bl.items():
+            flat[f"__scale__{FLAT_KEY_SEP}{ln}{FLAT_KEY_SEP}{bn}"] = \
+                np.asarray(s, np.float32)
+    flat[_QUANT_META_KEY] = np.frombuffer(json.dumps({
+        "schema": _QUANT_SCHEMA, "weight_dtype": weight_dtype,
+        "bf16_keys": bf16_keys}).encode(), np.uint8)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **flat)
+    os.replace(tmp, path)
+    return path
+
+
+def load_quant_sidecar(path: str) -> Tuple[
+        Dict[str, Dict[str, np.ndarray]],
+        Dict[str, Dict[str, float]], str]:
+    """Read a quant sidecar → (blobs, scales, weight_dtype); bf16
+    blobs come back as ml_dtypes.bfloat16 views."""
+    import json
+    import ml_dtypes
+    with np.load(path) as z:
+        if _QUANT_META_KEY not in z:
+            raise ValueError(f"{path}: not a {_QUANT_SCHEMA} sidecar")
+        meta = json.loads(bytes(z[_QUANT_META_KEY].tobytes()).decode())
+        if meta.get("schema") != _QUANT_SCHEMA:
+            raise ValueError(f"{path}: schema "
+                             f"{meta.get('schema')!r} != "
+                             f"{_QUANT_SCHEMA}")
+        bf16 = set(meta.get("bf16_keys", ()))
+        blobs: Dict[str, Dict[str, np.ndarray]] = {}
+        scales: Dict[str, Dict[str, float]] = {}
+        scale_prefix = f"__scale__{FLAT_KEY_SEP}"
+        for key in z.files:
+            if key == _QUANT_META_KEY:
+                continue
+            if key.startswith(scale_prefix):
+                ln, bn = key[len(scale_prefix):].split(FLAT_KEY_SEP, 1)
+                scales.setdefault(ln, {})[bn] = float(z[key])
+                continue
+            ln, bn = key.split(FLAT_KEY_SEP, 1)
+            arr = z[key]
+            if key in bf16:
+                arr = arr.view(ml_dtypes.bfloat16)
+            blobs.setdefault(ln, {})[bn] = arr
+    return blobs, scales, meta["weight_dtype"]
+
+
 @functools.lru_cache(maxsize=16)
 def _replicate_fn(rep_sharding):
     """One compiled identity-with-replicated-output per sharding —

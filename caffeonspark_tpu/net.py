@@ -663,7 +663,8 @@ class Net:
     # ------------------------------------------------------------------
     def apply(self, params: Params, inputs: Dict[str, Array], *,
               train: Optional[bool] = None, rng: Optional[Array] = None,
-              net_state: Optional[Dict] = None
+              net_state: Optional[Dict] = None,
+              qscales: Optional[Dict] = None
               ) -> Tuple[Dict[str, Array], Dict]:
         """Forward pass. Returns (all blobs, updated_param_blobs).
 
@@ -671,7 +672,13 @@ class Net:
         that update their own param blobs during the forward pass
         (BatchNorm running stats).  `Solver.train_step` merges it back
         into params with `merge_forward_state`; stat blobs are pinned to
-        lr_mult = decay_mult = 0 so the optimizer never touches them."""
+        lr_mult = decay_mult = 0 so the optimizer never touches them.
+
+        `qscales` ({layer: {blob: f32 scalar}}) carries the publish-
+        time max-abs scales for quantized-resident serving weights
+        (serving/quant.py): an op receiving an int8 param finds its
+        dequant scale via Ctx.qscale and runs the dequant-free kernel
+        path.  None (every training/eval caller) is inert."""
         if train is None:
             train = self.state.phase == Phase.TRAIN
         blobs: Dict[str, Array] = dict(inputs)
@@ -679,7 +686,8 @@ class Net:
                     state_in=net_state or {}, state_out={},
                     fused_relu_lrn=self.fused_relu_lrn,
                     defer_bias=self._defer_bias,
-                    bias_lrn=self._bias_lrn_set)
+                    bias_lrn=self._bias_lrn_set,
+                    qscales=qscales)
         cast = (self.compute_dtype != self.dtype)
         for lp in self.compute_layers:
             op = L.get_op(lp.type)
@@ -710,7 +718,12 @@ class Net:
                 lparams = [params[self.fused_bias_lrn[lp.name]]["bias"]] \
                     + lparams
             if docast and not op.f32_stats and lparams:
-                lparams = [p.astype(target) for p in lparams]
+                # non-floating params (int8 quantized-resident serving
+                # weights) must pass through untouched — a dtype-policy
+                # cast would silently dequantize without the scale
+                lparams = [p.astype(target)
+                           if jnp.issubdtype(p.dtype, jnp.floating)
+                           else p for p in lparams]
             bottoms = [blobs[b] for b in lp.bottom]
             if docast:
                 # stat layers (BatchNorm) keep their INPUT at full

@@ -64,9 +64,16 @@ TUNABLE_TYPES = ("Convolution", "InnerProduct", "LRN",
 # non-variant layer: recorded in the plan key at tune time, compared
 # (warn-only) at apply time — a plan measured under COS_CONV_LAYOUT=
 # NHWC applied in a bare shell runs its non-variant convs in a regime
-# nobody measured
+# nobody measured.  COS_SERVE_WEIGHT_DTYPE matters the same way for
+# serve-mode plans resolved per model: under int8/bf16 RESIDENCY
+# (serving/quant.py) the InnerProduct weight arrives pre-quantized and
+# the int8 variant's per-call weight-quantization cost — which the
+# tuner's A/B measured — is gone, so a plan tuned in one regime and
+# applied in the other states the mismatch instead of silently
+# reporting stale numbers
 AMBIENT_ENV_KNOBS = ("COS_CONV_LAYOUT", "COS_CONV_S2D",
-                     "COS_FUSE_RELU_LRN", "COS_FUSE_BIAS_RELU_LRN")
+                     "COS_FUSE_RELU_LRN", "COS_FUSE_BIAS_RELU_LRN",
+                     "COS_SERVE_WEIGHT_DTYPE")
 
 
 def ambient_env() -> Dict[str, str]:

@@ -61,6 +61,16 @@ class Ctx:
     # and the LRN layer names that receive the bias as params[0]
     defer_bias: frozenset = frozenset()
     bias_lrn: frozenset = frozenset()
+    # per-blob dequant scales for quantized-resident serving weights
+    # ({layer: {blob: f32 scalar}}, serving/quant.py): an int8 weight
+    # arriving at an op finds its max-abs scale here and runs the
+    # dequant-free kernel path instead of quantizing per call
+    qscales: Optional[Dict] = None
+
+    def qscale(self, bname: str):
+        if not self.qscales:
+            return None
+        return self.qscales.get(self.layer_name, {}).get(bname)
 
     def take_rng(self) -> Array:
         assert self.rng is not None, "layer needs rng but none provided"
@@ -410,7 +420,15 @@ def _inner_product(ctx, lp, params, bottoms):
     x2 = x.reshape((math.prod(lead), -1))
     w = params[0]
     v = ctx.variant or {}
-    if v.get("int8") and not ctx.train:
+    if not ctx.train and w.dtype == jnp.int8:
+        # quantized-RESIDENT serving weight (serving/quant.py): the
+        # blob was quantized once at ModelRegistry.publish and lives
+        # in HBM as the int8 operand itself — the kernel consumes it
+        # with its cached max-abs scale, no per-call re-quantization
+        from .pallas_kernels import int8_inner_product
+        y = int8_inner_product(x2, w, transpose=bool(ip.transpose),
+                               w_scale=ctx.qscale("weight"))
+    elif v.get("int8") and not ctx.train:
         # quantized serving forward (autotune variant; TEST-phase nets
         # only — net.py refuses int8 on a TRAIN net): int8×int8 MXU
         # matmul on per-blob max-abs scales, int32 accumulation

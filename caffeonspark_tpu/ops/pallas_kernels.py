@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import functools
 import math
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -362,22 +363,38 @@ def int8_matmul(xq: jax.Array, wq: jax.Array, *,
 
 def int8_inner_product(x: jax.Array, w: jax.Array, *,
                        transpose: bool = False,
-                       interpret: bool = False) -> jax.Array:
+                       interpret: bool = False,
+                       w_scale: Optional[jax.Array] = None
+                       ) -> jax.Array:
     """Quantized InnerProduct forward: y ≈ x @ wᵀ (Caffe layout; or
     x @ w when `transpose`), both operands on per-blob max-abs int8
     scales, int32 accumulation, output in x's dtype.  Forward-only —
     the serving path; training never routes here.
 
-    The weight quantizes INSIDE the traced forward (per call, not per
-    model): an O(N·K) abs-max+round the published model pays on every
-    flush.  The autotuner's A/B measures the variant WITH this cost,
-    so a net where re-quantization eats the matmul win simply never
-    selects int8; hoisting (wq, sw) to ModelRegistry.publish is the
-    follow-on for ROADMAP item 3's full quantized-serving story."""
+    Two weight regimes:
+
+      * `w` float, `w_scale` None — the autotune-variant path: the
+        weight quantizes INSIDE the traced forward, an O(N·K)
+        abs-max+round paid on every flush.  The autotuner's A/B
+        measures the variant WITH this cost, so a net where
+        re-quantization eats the matmul win never selects int8.
+      * `w` already int8 with its publish-time `w_scale` — the
+        quantized-RESIDENT path (serving/quant.py): the model was
+        quantized ONCE at ModelRegistry.publish and the resident blob
+        IS the MXU operand, so the per-call weight quantization above
+        disappears; only the activation still quantizes per call
+        (it must — its values change per request)."""
     from ..parallel.gradsync import quantize_int8
     wn = w.T if transpose else w              # (N, K)
     xq, sx = quantize_int8(x, None)
-    wqn, sw = quantize_int8(wn, None)
+    if wn.dtype == jnp.int8:
+        if w_scale is None:
+            raise ValueError(
+                "int8_inner_product: pre-quantized int8 weight needs "
+                "its publish-time w_scale (serving/quant.py)")
+        wqn, sw = wn, w_scale
+    else:
+        wqn, sw = quantize_int8(wn, None)
     acc = int8_matmul(xq, wqn, interpret=interpret)
     return (acc.astype(jnp.float32) * (sx * sw)).astype(x.dtype)
 

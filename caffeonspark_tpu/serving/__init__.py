@@ -20,13 +20,17 @@ backoff, rolling hot-swap, restart-on-death, and AOT warm start via
 the persistent compilation cache (docs/architecture.md §fleet).
 """
 
-from .batcher import (DeadlineExceeded, MicroBatcher, PendingResult,
-                      QueueFullError, ServingStopped, bucket_for,
-                      make_buckets, serve_max_batch, serve_max_wait_ms,
-                      serve_queue_depth)
+from .batcher import (DeadlineExceeded, FlushLanes, MicroBatcher,
+                      PendingResult, QueueFullError, ServingStopped,
+                      bucket_for, make_buckets, serve_max_batch,
+                      serve_max_wait_ms, serve_queue_depth)
 from .forward import (BlobForward, build_serving_layout, fetch_rows,
-                      make_forward_fn, serve_mesh_spec)
-from .registry import ModelRegistry, ModelVersion, build_serving_net
+                      make_forward_fn, make_quant_forward_fn,
+                      serve_mesh_spec)
+from .quant import (quant_spec, serve_hbm_budget_bytes,
+                    serve_weight_dtype)
+from .registry import (DEFAULT_MODEL, ModelRegistry, ModelVersion,
+                       build_serving_net)
 from .retry import RetryPolicy, retry_call
 from .service import Client, InferenceService
 from .http_server import ServingHTTPServer
@@ -35,14 +39,16 @@ from .router import (NoReplicaAvailable, RouterRequestError,
 from .fleet import Fleet, ReplicaProcess, serve_replicas
 
 __all__ = [
-    "BlobForward", "Client", "DeadlineExceeded", "Fleet",
-    "InferenceService", "MicroBatcher", "ModelRegistry",
-    "ModelVersion", "NoReplicaAvailable", "PendingResult",
-    "QueueFullError", "ReplicaProcess", "RetryPolicy",
+    "BlobForward", "Client", "DEFAULT_MODEL", "DeadlineExceeded",
+    "Fleet", "FlushLanes", "InferenceService", "MicroBatcher",
+    "ModelRegistry", "ModelVersion", "NoReplicaAvailable",
+    "PendingResult", "QueueFullError", "ReplicaProcess", "RetryPolicy",
     "RouteRetryable", "Router", "RouterHTTPServer",
     "RouterRequestError", "ServingHTTPServer", "ServingStopped",
     "bucket_for", "build_serving_layout", "build_serving_net",
-    "fetch_rows", "make_buckets", "make_forward_fn", "retry_call",
-    "serve_max_batch", "serve_max_wait_ms", "serve_mesh_spec",
-    "serve_queue_depth", "serve_replicas",
+    "fetch_rows", "make_buckets", "make_forward_fn",
+    "make_quant_forward_fn", "quant_spec", "retry_call",
+    "serve_hbm_budget_bytes", "serve_max_batch", "serve_max_wait_ms",
+    "serve_mesh_spec", "serve_queue_depth", "serve_replicas",
+    "serve_weight_dtype",
 ]

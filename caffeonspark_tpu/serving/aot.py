@@ -44,33 +44,44 @@ def aot_cache_root() -> str:
 
 def aot_cache_key(net_param, buckets: Sequence[int],
                   blob_names: Sequence[str],
-                  mesh_sig: Optional[str] = None) -> str:
+                  mesh_sig: Optional[str] = None,
+                  weight_dtype: Optional[str] = None) -> str:
     """Digest of the serving identity that determines the compiled
     program set: net topology + bucket shapes + served blobs + mesh
     topology/sharding layout (`MeshLayout.signature()`; None =
-    single-device).  A tp=2 program and a single-device program are
-    DIFFERENT executables over the same HLO-adjacent net — without the
-    mesh term they would share a namespace and every topology change
-    would count the other topology's entries as its own.  Params and
-    model version stay excluded on purpose (see module docstring)."""
+    single-device) + quantized-residency storage dtype
+    (COS_SERVE_WEIGHT_DTYPE; None/"f32" adds nothing, so every
+    pre-quantization namespace digest is unchanged).  A bf16/int8
+    resident program traces a DIFFERENT body (dequant at entry /
+    int8 MXU kernel) over extra scale operands — sharing the f32
+    namespace would make each regime count the other's entries as its
+    own.  Params and model version stay excluded on purpose (see
+    module docstring): every VERSION of one (net, dtype) regime still
+    shares one program set — that sharing is what keeps hot-swap and
+    LRU page-in recompile-free."""
     h = hashlib.sha256()
     h.update(str(net_param).encode())
     h.update(repr(tuple(buckets)).encode())
     h.update(repr(tuple(blob_names)).encode())
     h.update(repr(mesh_sig).encode())
+    if weight_dtype not in (None, "f32"):
+        h.update(repr(weight_dtype).encode())
     return h.hexdigest()[:16]
 
 
 def resolve_cache_dir(net_param, buckets: Sequence[int],
                       blob_names: Sequence[str],
                       root: Optional[str] = None,
-                      mesh_sig: Optional[str] = None) -> Optional[str]:
+                      mesh_sig: Optional[str] = None,
+                      weight_dtype: Optional[str] = None
+                      ) -> Optional[str]:
     root = aot_cache_root() if root is None else root
     if not root:
         return None
     return os.path.join(root,
                         "aot-" + aot_cache_key(net_param, buckets,
-                                               blob_names, mesh_sig))
+                                               blob_names, mesh_sig,
+                                               weight_dtype))
 
 
 def enable_aot_cache(cache_dir: str) -> bool:

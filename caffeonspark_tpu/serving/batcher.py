@@ -179,6 +179,79 @@ class PendingResult:
         return self._req.version
 
 
+# -- per-model flush lanes ----------------------------------------------
+
+class FlushLanes:
+    """One MicroBatcher per model name: each lane has its OWN bounded
+    queue and assembler/executor thread pair, so a cold model paying
+    an HBM page-in (or a slow net) stalls only its own flushes — model
+    A's bucket cadence never waits behind model B's executor.  Lanes
+    are created lazily by `lane(name)` via the factory and started on
+    creation once `start()` has run (the default lane is installed
+    eagerly by the service so single-model behavior is unchanged)."""
+
+    def __init__(self, make_lane: Callable[[str], "MicroBatcher"]):
+        self._make = make_lane
+        self._lanes: dict = {}
+        self._lock = threading.Lock()
+        self._started = False
+
+    def install(self, name: str, batcher: "MicroBatcher") -> None:
+        with self._lock:
+            self._lanes[name] = batcher
+
+    def lane(self, name: str) -> "MicroBatcher":
+        with self._lock:
+            b = self._lanes.get(name)
+            if b is not None:
+                return b
+        # build OUTSIDE the lock (COS005: the factory may touch the
+        # registry); losers of the publish race discard their copy
+        fresh = self._make(name)
+        with self._lock:
+            b = self._lanes.setdefault(name, fresh)
+            if b is fresh and self._started:
+                b.start()
+        return b
+
+    def get(self, name: str) -> Optional["MicroBatcher"]:
+        with self._lock:
+            return self._lanes.get(name)
+
+    def remove(self, name: str) -> None:
+        """Drop (and stop) one lane — the failed-add rollback path."""
+        with self._lock:
+            b = self._lanes.pop(name, None)
+        if b is not None and b._thread is not None:
+            b.stop(drain=False)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._lanes)
+
+    def start(self) -> "FlushLanes":
+        with self._lock:
+            self._started = True
+            lanes = list(self._lanes.values())
+        for b in lanes:
+            b.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        with self._lock:
+            self._started = False
+            lanes = list(self._lanes.values())
+        for b in lanes:
+            b.stop(drain=drain)
+
+    def depth(self) -> int:
+        """Total waiting requests across every lane (the /healthz
+        queue-depth signal stays fleet-comparable)."""
+        with self._lock:
+            lanes = list(self._lanes.values())
+        return sum(b.depth() for b in lanes)
+
+
 # -- batcher ------------------------------------------------------------
 
 class MicroBatcher:

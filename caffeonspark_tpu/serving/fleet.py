@@ -205,6 +205,12 @@ class Fleet:
         self._monitor: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
         self._restarts = 0
+        # named models published fleet-wide (publish_model): specs are
+        # remembered so a replica that dies and respawns — which boots
+        # with only the DEFAULT model from its argv — gets every named
+        # model re-published by the monitor before it rejoins
+        self._published_models: Dict[str, dict] = {}
+        self._published_lock = threading.Lock()
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> "Fleet":
@@ -291,6 +297,11 @@ class Fleet:
             # OTHER dead replica down, so routing stays correct
             if rep.wait_ready(self.startup_timeout_s,
                               stop_evt=self._stop_evt):
+                # a respawn boots with only the argv default model:
+                # re-publish every fleet-wide named model BEFORE the
+                # replica rejoins rotation, or name-routed requests
+                # would 404 on it until an operator noticed
+                self._republish_models(rep)
                 # new ephemeral port: point the router at it
                 # BEFORE reopening routing
                 self.router.update_url(name, rep.url)
@@ -301,19 +312,61 @@ class Fleet:
                 _LOG.error("fleet: restarted %s failed to become "
                            "healthy", name)
 
+    def _republish_models(self, rep: ReplicaProcess) -> None:
+        with self._published_lock:
+            specs = list(self._published_models.values())
+        for spec in specs:
+            try:
+                code, body = http_json(
+                    rep.url + "/v1/models",
+                    data=json.dumps(spec).encode(), timeout=120.0)
+                if code != 200:
+                    _LOG.error("fleet: re-publishing model %r on "
+                               "restarted %s failed: %s",
+                               spec.get("name"), rep.name, body)
+            except TRANSPORT_ERRORS + (ValueError,) as e:
+                _LOG.error("fleet: re-publishing model %r on "
+                           "restarted %s failed: %s",
+                           spec.get("name"), rep.name, e)
+
     # -- operations ---------------------------------------------------
-    def rolling_reload(self, model_path: str) -> Dict[str, int]:
+    def rolling_reload(self, model_path: str,
+                       model_name: Optional[str] = None
+                       ) -> Dict[str, int]:
         # serve_args repoint PER replica as each one's reload lands:
         # a replica that dies mid-roll after ITS swap must rejoin on
         # the NEW version (fresh list assignment — the monitor reads
-        # serve_args only at spawn)
+        # serve_args only at spawn).  A NAMED model's reload instead
+        # updates the remembered publish spec (argv only carries the
+        # default model).
         def repoint(name: str) -> None:
+            if model_name is not None:
+                return
             rep = self.replicas.get(name)
             if rep is not None:
                 rep.serve_args = _args_with_model(rep.serve_args,
                                                   model_path)
+        if model_name is not None:
+            with self._published_lock:
+                spec = self._published_models.get(model_name)
+                if spec is not None:
+                    spec["model"] = model_path
         return self.router.rolling_reload(model_path,
-                                          on_reloaded=repoint)
+                                          on_reloaded=repoint,
+                                          model_name=model_name)
+
+    def publish_model(self, spec: dict) -> Dict[str, dict]:
+        """Publish a named model fleet-wide: POST the /v1/models spec
+        ({"name", "solver", "model", ...}) to every live replica and
+        REMEMBER it, so restart-on-death respawns (which boot with
+        only the argv default) get it re-published before rejoining."""
+        name = spec.get("name")
+        if not name:
+            raise ValueError("publish_model spec needs 'name'")
+        out = self.router.broadcast_post("/v1/models", spec)
+        with self._published_lock:
+            self._published_models[name] = dict(spec)
+        return out
 
     def kill_replica(self, name: str) -> None:
         self.replicas[name].kill()

@@ -40,6 +40,43 @@ def make_forward_fn(net: Net, blob_names: Tuple[str, ...]):
     return fwd
 
 
+def make_quant_forward_fn(net: Net, blob_names: Tuple[str, ...],
+                          spec: Dict[str, Dict[str, str]]):
+    """Forward body over COMPRESSED resident params (serving/quant.py
+    storage spec): bf16 blobs upcast to f32 at entry (storage-only
+    compression — compute stays the f32 program), int8 blobs
+    dequantize by their per-blob scale, and int8 InnerProduct weights
+    pass straight through to the PR 11 int8 MXU kernel (dequant-free;
+    the scale rides to the op via Net.apply's qscales side channel).
+    Signature is (params, scales, inputs) — scales are traced f32
+    scalars so every model version shares one compiled program."""
+    import jax.numpy as jnp
+    from .quant import BF16, INT8, INT8_IP
+
+    def fwd(params, scales, inputs):
+        p2 = {}
+        qscales: Dict[str, dict] = {}
+        for ln, bl in params.items():
+            sp = spec.get(ln) or {}
+            out = {}
+            for bn, arr in bl.items():
+                kind = sp.get(bn)
+                if kind == BF16:
+                    out[bn] = arr.astype(jnp.float32)
+                elif kind == INT8:
+                    out[bn] = (arr.astype(jnp.float32)
+                               * scales[ln][bn])
+                elif kind == INT8_IP:
+                    out[bn] = arr              # kernel consumes int8
+                    qscales.setdefault(ln, {})[bn] = scales[ln][bn]
+                else:
+                    out[bn] = arr
+            p2[ln] = out
+        blobs, _ = net.apply(p2, inputs, train=False, qscales=qscales)
+        return {bn: blobs[bn] for bn in blob_names}
+    return fwd
+
+
 class BlobForward:
     """Jitted predict(blobNames) closures for one Net, cached per blob
     set — chunked EXTRACT requests and per-bucket serving flushes must
@@ -55,23 +92,50 @@ class BlobForward:
     def __init__(self, net: Net, layout=None):
         self.net = net
         self.layout = layout
-        self._cache: Dict[Tuple[str, ...], Any] = {}
+        self._cache: Dict[Tuple, Any] = {}
 
-    def __call__(self, blob_names: Tuple[str, ...]):
+    def __call__(self, blob_names: Tuple[str, ...],
+                 weight_dtype: str = "f32"):
+        """The jitted closure for (blob set, resident storage dtype).
+        "f32" is the unchanged pre-quantization program —
+        fwd(params, inputs); compressed dtypes get
+        fwd(params, scales, inputs) over make_quant_forward_fn (one
+        program per dtype, shared by every version of the net)."""
         import jax
-        if blob_names not in self._cache:
-            fwd = make_forward_fn(self.net, tuple(blob_names))
+        key = (tuple(blob_names), weight_dtype)
+        if key not in self._cache:
+            if weight_dtype == "f32":
+                fwd = make_forward_fn(self.net, tuple(blob_names))
+            else:
+                from .quant import quant_spec
+                spec = quant_spec(self.net, weight_dtype)
+                fwd = make_quant_forward_fn(self.net,
+                                            tuple(blob_names), spec)
             if self.layout is None:
                 fwd = jax.jit(fwd)
             else:
                 lay = self.layout
+                if weight_dtype == "f32":
+                    shardings = (lay.param_sharding,
+                                 lay.input_shardings(self.net))
+                else:
+                    # scales are scalars: replicated everywhere; the
+                    # compressed params reuse the layout's placement
+                    # (shardings are dtype-agnostic)
+                    spec_sh = {
+                        ln: {bn: lay.repl for bn, k in bl.items()
+                             if k in ("int8", "int8_ip")}
+                        for ln, bl in spec.items()}
+                    spec_sh = {ln: bl for ln, bl in spec_sh.items()
+                               if bl}
+                    shardings = (lay.param_sharding, spec_sh,
+                                 lay.input_shardings(self.net))
                 fwd = jax.jit(
                     lay.install_flash(fwd),
-                    in_shardings=(lay.param_sharding,
-                                  lay.input_shardings(self.net)),
+                    in_shardings=shardings,
                     out_shardings={bn: lay.repl for bn in blob_names})
-            self._cache[blob_names] = fwd
-        return self._cache[blob_names]
+            self._cache[key] = fwd
+        return self._cache[key]
 
 
 def fetch_rows(out: Dict[str, Any], blob_names: Sequence[str],

@@ -8,20 +8,34 @@ into bucketed flushes exactly like in-process clients.
 Routes:
   POST /v1/predict   {"records": [{"id", "label", "data"|"image_b64"},
                       ...]} or a single record object; → {"rows": [...],
-                      "model_version": N}
-  POST /v1/reload    {"model": "<snapshot path>"} → hot-swap
-                     (clears draining — rolling-swap rejoin)
+                      "model_version": N}.  Multi-model routing: the
+                      JSON `model` field (or `?model=<name>` query)
+                      names a published model; absent = the default —
+                      single-model requests are byte-identical to the
+                      pre-plural server.
+  POST /v1/models    publish an additional named model:
+                     {"name", "solver" (solver prototxt path),
+                      "model" (weights path), "features"?, "label"?}
+  GET  /v1/models    per-model summary (residency, storage dtype,
+                     versions, lane series)
+  POST /v1/reload    {"model": "<snapshot path>", "name"?: <model>} →
+                     hot-swap one model (default when no name; clears
+                     draining — rolling-swap rejoin)
   POST /v1/drain     {"drain": true|false} → reject new predicts while
                      accepted work still flushes (the fleet router
                      takes this replica out of rotation first)
   GET  /healthz      liveness + `status`: "ok" | "draining" (200) or
                      "down" (503, no model) + batcher queue depth —
-                     the router's routability signal
+                     the router's routability signal — plus the
+                     resident vs paged-out model lists (the LRU's
+                     live state)
   GET  /metrics      serving metrics (PipelineMetrics JSON, plus
-                     queue_depth_now / per-bucket flush counters)
+                     queue_depth_now / per-bucket flush counters /
+                     per-model `models` block)
 
 Status mapping: 429 queue-full fast-reject, 504 deadline exceeded,
-400 malformed request, 503 draining or model failure.
+400 malformed request, 404 unknown model, 503 draining or model
+failure.
 """
 
 from __future__ import annotations
@@ -32,6 +46,7 @@ import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlsplit
 
 from .batcher import DeadlineExceeded, QueueFullError, ServingStopped
 
@@ -63,15 +78,26 @@ class JsonHandler(BaseHTTPRequestHandler):
         raw = self.rfile.read(n) if n else b"{}"
         return json.loads(raw.decode())
 
+    def _route(self):
+        """(path, query dict) — the model name rides as `?model=` on
+        predict, so route matching must strip the query string."""
+        parts = urlsplit(self.path)
+        q = {k: v[-1] for k, v in parse_qs(parts.query).items()}
+        return parts.path, q
+
 
 class _Handler(JsonHandler):
     # self.server is the ServingHTTPServer below
     def do_GET(self):
         svc = self.server.service
-        if self.path == "/healthz":
-            try:
-                version = svc.registry.current().version
-            except RuntimeError:
+        path, _q = self._route()
+        if path == "/healthz":
+            # version COUNTER, never current(): the health poll must
+            # not force a page-in (and LRU-touch) of the default
+            # model — a router polling /healthz every second would
+            # otherwise evict whatever the traffic actually uses
+            version = svc.registry.version
+            if version == 0:
                 self._send(503, {"ok": False, "status": "down",
                                  "error": "no model loaded"})
                 return
@@ -79,23 +105,36 @@ class _Handler(JsonHandler):
             out = {"ok": not draining,
                    "status": "draining" if draining else "ok",
                    "model_version": version,
-                   "queue_depth": svc.batcher.depth()}
+                   "queue_depth": svc.lanes.depth()
+                   if hasattr(svc, "lanes") else svc.batcher.depth()}
+            # the LRU's live state: which models sit in HBM right now
+            # vs which would pay a page-in on their next request
+            reg = svc.registry
+            if hasattr(reg, "resident_models"):
+                out["models"] = {
+                    "resident": reg.resident_models(),
+                    "paged_out": reg.paged_out_models()}
             # replica topology rides along so the router / operators
             # see sharded replicas without a /metrics round-trip
             mesh = getattr(svc, "mesh_info", lambda: None)()
             if mesh is not None:
                 out["mesh"] = mesh
             self._send(200, out)
-        elif self.path == "/metrics":
+        elif path == "/metrics":
             self._send(200, svc.metrics_summary())
+        elif path == "/v1/models":
+            self._send(200, {"models": svc.models_summary()})
         else:
-            self._send(404, {"error": f"no route {self.path}"})
+            self._send(404, {"error": f"no route {path}"})
 
     def do_POST(self):
         svc = self.server.service
-        if self.path == "/v1/predict":
-            self._predict(svc)
-        elif self.path == "/v1/drain":
+        path, q = self._route()
+        if path == "/v1/predict":
+            self._predict(svc, q)
+        elif path == "/v1/models":
+            self._add_model(svc)
+        elif path == "/v1/drain":
             try:
                 req = self._read_json()
                 flag = req.get("drain", True)
@@ -108,24 +147,68 @@ class _Handler(JsonHandler):
                 self._send(200, {"ok": True,
                                  "status": "draining" if flag
                                  else "ok"})
-        elif self.path == "/v1/reload":
+        elif path == "/v1/reload":
             try:
                 req = self._read_json()
-                version = svc.reload(req["model"])
+                name = req.get("name")
+                if name is not None and not svc.has_model(name):
+                    self._send(404, {"error": f"unknown model "
+                                              f"{name!r}"})
+                    return
+                version = svc.reload(req["model"], model=name)
             except (KeyError, ValueError, json.JSONDecodeError) as e:
                 self._send(400, {"error": str(e)})
             except Exception as e:        # noqa: BLE001 — bad snapshot
                 self._send(503, {"error": str(e)})
             else:
-                self._send(200, {"ok": True, "model_version": version})
+                out = {"ok": True, "model_version": version}
+                if name is not None:
+                    out["name"] = name
+                self._send(200, out)
         else:
-            self._send(404, {"error": f"no route {self.path}"})
+            self._send(404, {"error": f"no route {path}"})
 
-    def _predict(self, svc):
+    def _add_model(self, svc):
+        """POST /v1/models: publish an additional named model from its
+        own solver prototxt + weights.  The same loopback-by-default
+        caveat as /v1/reload applies — this loads arbitrary filesystem
+        paths, so exposure beyond the host is an operator decision."""
+        try:
+            req = self._read_json()
+            name = req["name"]
+            solver = req["solver"]
+            model = req["model"]
+            if not isinstance(name, str) or not name:
+                raise ValueError("'name' must be a non-empty string")
+            from ..config import Config
+            args = ["-conf", solver, "-model", model]
+            if req.get("features"):
+                args += ["-features", str(req["features"])]
+            if req.get("label"):
+                args += ["-label", str(req["label"])]
+            conf = Config(args)
+            version = svc.add_model(name, conf)
+        except KeyError as e:
+            self._send(400, {"error": f"missing field {e}"})
+        except (ValueError, json.JSONDecodeError, TypeError) as e:
+            self._send(400, {"error": str(e)})
+        except Exception as e:       # noqa: BLE001 — bad net/snapshot
+            self._send(503, {"error": f"{type(e).__name__}: {e}"})
+        else:
+            self._send(200, {"ok": True, "name": name,
+                             "model_version": version})
+
+    def _predict(self, svc, q):
         try:
             req = self._read_json()
             if not isinstance(req, dict):
                 raise ValueError("request body must be a JSON object")
+            # model routing: JSON field beats the query param; both
+            # absent = the default model (single-model requests are
+            # exactly the pre-plural wire format)
+            model = req.pop("model", None) or q.get("model") or None
+            if model is not None and not isinstance(model, str):
+                raise ValueError("'model' must be a string")
             records = req.get("records", [req] if ("data" in req
                                                   or "image_b64" in req)
                               else None)
@@ -141,7 +224,11 @@ class _Handler(JsonHandler):
             timeout_ms = req.get("timeout_ms")
             # all-or-nothing: queue-full must not strand an already-
             # submitted prefix that still executes after the 429
-            pending = svc.submit_many(records, timeout_ms=timeout_ms)
+            pending = svc.submit_many(records, timeout_ms=timeout_ms,
+                                      model=model)
+        except KeyError as e:
+            self._send(404, {"error": str(e)})
+            return
         except QueueFullError as e:
             self._send(429, {"error": str(e)})
             return
@@ -159,16 +246,19 @@ class _Handler(JsonHandler):
         except BaseException as e:        # noqa: BLE001 — model fault
             self._send(503, {"error": f"{type(e).__name__}: {e}"})
             return
-        self._send(200, {"rows": rows,
-                         "model_version": pending[-1].model_version})
+        out = {"rows": rows,
+               "model_version": pending[-1].model_version}
+        if model is not None:
+            out["model"] = model
+        self._send(200, out)
 
 
 class ServingHTTPServer(ThreadingHTTPServer):
     """Bind-and-go wrapper; port 0 picks an ephemeral port (read it
-    back from `.port`).  Binds loopback by DEFAULT — /v1/reload loads
-    arbitrary filesystem paths with no auth, so exposing it beyond the
-    host (`-serveHost 0.0.0.0` behind a fronting proxy) must be an
-    explicit operator decision."""
+    back from `.port`).  Binds loopback by DEFAULT — /v1/reload and
+    /v1/models load arbitrary filesystem paths with no auth, so
+    exposing them beyond the host (`-serveHost 0.0.0.0` behind a
+    fronting proxy) must be an explicit operator decision."""
 
     daemon_threads = True
 

@@ -1,0 +1,276 @@
+"""Quantized weight residency: publish-time compression + HBM paging.
+
+Production traffic multiplexes many models on shared chips, and for
+small-payload serving the throughput levers are effective-HBM capacity
+and swap latency, not math ("RPC Considered Harmful").  This module
+supplies the two primitives the plural ModelRegistry builds on:
+
+  * **Publish-time compression** — a model's float weights are
+    quantized ONCE when the version is published (int8 with per-blob
+    max-abs scales — the gradsync wire machinery from PR 6 — or bf16
+    storage), so the per-call weight quantization PR 11 documented
+    inside `int8_inner_product` disappears from the serving path: the
+    resident weights ARE the int8 operands the MXU kernel consumes.
+    InnerProduct weights run dequant-free through the PR 11 int8
+    kernels; every other compressed blob dequantizes to f32 at forward
+    entry (storage-only compression: compute stays the f32 program,
+    the COS002 precision-floor stance).
+  * **Host-side compressed cache + per-shard placement** — the same
+    compressed blobs are kept on the host as PER-SHARD numpy buffers
+    (shard bounds → buffer, the PR 9 zero-gather idiom), so an evicted
+    model pages back into HBM by streaming each shard straight to its
+    destination device (`jax.make_array_from_callback`) — never a
+    full-size dense host gather, never a file re-read.
+
+What gets compressed is decided by `quant_spec` from the NET alone
+(layer types + blob shapes, never param values), so every version of
+one net shares one forward program — the fact that keeps hot-swap and
+page-in recompile-free.
+
+Knobs: COS_SERVE_WEIGHT_DTYPE (f32 default | bf16 | int8),
+COS_SERVE_HBM_BUDGET_MB (0/unset = resident forever, no paging),
+COS_SERVE_QUANT_TOL / COS_SERVE_QUANT_CHECK (the publish-time
+accuracy-drift gate, see registry.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils.envutils import env_int, env_num
+
+_LOG = logging.getLogger(__name__)
+
+# storage kinds (per blob, from quant_spec)
+F32 = "f32"            # uncompressed
+BF16 = "bf16"          # bf16 storage, cast to f32 at forward entry
+INT8 = "int8"          # int8 + scale, dequantized at forward entry
+INT8_IP = "int8_ip"    # int8 + scale, consumed dequant-free by the
+#                        PR 11 int8 InnerProduct kernel
+
+WEIGHT_DTYPES = ("f32", "bf16", "int8")
+
+# blobs smaller than this stay f32 in every mode: biases/scales are a
+# rounding error of the resident set, and quantizing them buys bytes
+# measured in hundreds while costing accuracy headroom
+MIN_QUANT_ELEMS = 1024
+
+
+def serve_weight_dtype(default: str = "f32") -> str:
+    """COS_SERVE_WEIGHT_DTYPE: resident storage for serving weights."""
+    import os
+    v = os.environ.get("COS_SERVE_WEIGHT_DTYPE", default) or default
+    v = {"float32": "f32", "bfloat16": "bf16"}.get(v.lower(), v.lower())
+    if v not in WEIGHT_DTYPES:
+        _LOG.warning("COS_SERVE_WEIGHT_DTYPE=%r not in %s — serving "
+                     "f32", v, WEIGHT_DTYPES)
+        return "f32"
+    return v
+
+
+def serve_hbm_budget_bytes(default_mb: int = 0) -> int:
+    """COS_SERVE_HBM_BUDGET_MB → bytes; 0/unset = unlimited (models
+    stay resident forever — exactly the pre-paging behavior)."""
+    mb = env_int("COS_SERVE_HBM_BUDGET_MB", default_mb, strict=False)
+    return max(0, mb) * 2**20
+
+
+def serve_quant_tol(default: float = 0.05) -> float:
+    """COS_SERVE_QUANT_TOL: max relative output drift a quantized
+    model may show vs its f32 forward before publish falls back to
+    f32 storage."""
+    return env_num("COS_SERVE_QUANT_TOL", default, strict=False)
+
+
+# ---------------------------------------------------------------------------
+# per-net storage spec
+# ---------------------------------------------------------------------------
+
+def quant_spec(net, weight_dtype: str) -> Dict[str, Dict[str, str]]:
+    """{layer: {blob: kind}} for the blobs that leave f32 under
+    `weight_dtype`.  Derived from the net STRUCTURE only (types +
+    shapes) so all versions of one net share one spec — and therefore
+    one compiled forward program.  Rules:
+
+      * stat-blob layers (BatchNorm running stats, op.f32_stats) and
+        blobs under MIN_QUANT_ELEMS stay f32 in every mode;
+      * ndim >= 2 float blobs (the weights that dominate bytes)
+        compress; 1-D blobs (biases) stay f32;
+      * int8 mode: a TEST-phase InnerProduct "weight" is INT8_IP —
+        consumed as-is by the int8 MXU kernel (dequant-free); every
+        other eligible blob is INT8 (dequantized at forward entry);
+      * bf16 mode: eligible blobs store bf16, upcast at entry.
+    """
+    if weight_dtype == "f32":
+        return {}
+    from ..ops import layers as L
+    from ..proto import Phase
+    serving = net.state.phase != Phase.TRAIN
+    out: Dict[str, Dict[str, str]] = {}
+    types = {lp.name: lp.type for lp in net.compute_layers}
+    for lname, specs in net.param_layout.items():
+        t = types.get(lname)
+        if t is None or L.get_op(t).f32_stats:
+            continue
+        for bname, shape, _ in specs:
+            if len(shape) < 2 or int(np.prod(shape)) < MIN_QUANT_ELEMS:
+                continue
+            if weight_dtype == "bf16":
+                kind = BF16
+            elif (t == "InnerProduct" and bname == "weight"
+                  and serving and len(shape) == 2):
+                kind = INT8_IP
+            else:
+                kind = INT8
+            out.setdefault(lname, {})[bname] = kind
+    return out
+
+
+def spec_nbytes(net, spec: Dict[str, Dict[str, str]]) -> int:
+    """Logical resident bytes of one model version under `spec`
+    (storage dtype per blob; scales are noise and ignored)."""
+    total = 0
+    for lname, specs in net.param_layout.items():
+        for bname, shape, _ in specs:
+            kind = spec.get(lname, {}).get(bname, F32)
+            itemsize = 1 if kind in (INT8, INT8_IP) else \
+                2 if kind == BF16 else 4
+            total += int(np.prod(shape)) * itemsize
+    return total
+
+
+# ---------------------------------------------------------------------------
+# host-side compressed cache (per-shard, the zero-gather idiom)
+# ---------------------------------------------------------------------------
+
+def _bounds_key(idx, shape) -> Tuple[Tuple[int, int], ...]:
+    return tuple((s.start or 0, s.stop if s.stop is not None else d)
+                 for s, d in zip(idx, shape))
+
+
+def _host_shards(arr) -> Dict[Tuple, np.ndarray]:
+    """Unique addressable shards of a device array as host buffers,
+    keyed by their bounds — dp replicas of one tp shard copy once.
+    Peak host allocation per blob is its unique-shard total, never a
+    densified copy of a partitioned blob."""
+    import jax
+    shape = arr.shape
+    if isinstance(arr, np.ndarray) or not isinstance(arr, jax.Array):
+        a = np.asarray(arr)
+        return {_bounds_key(tuple(slice(0, d) for d in shape),
+                            shape): a}
+    out: Dict[Tuple, np.ndarray] = {}
+    for s in arr.addressable_shards:
+        key = _bounds_key(s.index, shape)
+        if key not in out:
+            out[key] = np.asarray(s.data)
+    return out
+
+
+class HostBlob:
+    """One blob's host-side cache entry: compressed per-shard buffers
+    plus everything needed to page it back onto its devices."""
+
+    __slots__ = ("kind", "shape", "shards", "scale", "sharding")
+
+    def __init__(self, kind: str, shape, shards: Dict[Tuple, np.ndarray],
+                 scale: Optional[float], sharding):
+        self.kind = kind
+        self.shape = tuple(shape)
+        self.shards = shards
+        self.scale = scale
+        self.sharding = sharding
+
+    def nbytes(self) -> int:
+        return sum(a.nbytes for a in self.shards.values())
+
+
+HostCache = Dict[str, Dict[str, HostBlob]]
+
+
+def _quantize_shards_int8(shards: Dict[Tuple, np.ndarray]
+                          ) -> Tuple[Dict[Tuple, np.ndarray], float]:
+    """Symmetric per-blob max-abs int8 over the shard set: the scale
+    is GLOBAL to the blob (max over every shard — gradsync's
+    quantize_int8 rule, round-to-nearest: inference wants determinism),
+    computed without ever assembling the dense blob."""
+    amax = max((float(np.max(np.abs(a))) if a.size else 0.0)
+               for a in shards.values())
+    scale = max(amax, 1e-30) / 127.0
+    q = {k: np.clip(np.round(a.astype(np.float32) / scale),
+                    -127.0, 127.0).astype(np.int8)
+         for k, a in shards.items()}
+    return q, scale
+
+
+def _to_bf16(a: np.ndarray) -> np.ndarray:
+    import ml_dtypes
+    return a.astype(ml_dtypes.bfloat16)
+
+
+def build_host_cache(net, params,
+                     spec: Dict[str, Dict[str, str]]) -> HostCache:
+    """Device params → compressed host cache (the paging source).
+    Works shard by shard; for an unpartitioned blob the 'shard' is the
+    whole array (one key), so dense and mesh layouts share one code
+    path and one cache format."""
+    cache: HostCache = {}
+    for lname, specs in net.param_layout.items():
+        blobs = params[lname]
+        entry: Dict[str, HostBlob] = {}
+        for bname, shape, _ in specs:
+            arr = blobs[bname]
+            sharding = getattr(arr, "sharding", None)
+            kind = spec.get(lname, {}).get(bname, F32)
+            shards = _host_shards(arr)
+            scale = None
+            if kind in (INT8, INT8_IP):
+                shards, scale = _quantize_shards_int8(shards)
+            elif kind == BF16:
+                shards = {k: _to_bf16(a) for k, a in shards.items()}
+            entry[bname] = HostBlob(kind, shape, shards, scale,
+                                    sharding)
+        cache[lname] = entry
+    return cache
+
+
+def cache_nbytes(cache: HostCache) -> int:
+    return sum(hb.nbytes() for bl in cache.values()
+               for hb in bl.values())
+
+
+def place_from_cache(cache: HostCache,
+                     ) -> Tuple[dict, Dict[str, dict]]:
+    """Page a cached model into device memory: every blob streams
+    shard-by-shard to the placement it was captured from
+    (`jax.make_array_from_callback` hands each device its own host
+    buffer — a view, no assembly, no gather).  Returns (params,
+    scales): params in STORAGE dtype (int8/bf16/f32), scales as f32
+    device scalars for the int8 blobs."""
+    import jax
+    import jax.numpy as jnp
+    params: dict = {}
+    scales: Dict[str, dict] = {}
+    for lname, bl in cache.items():
+        pb: dict = {}
+        for bname, hb in bl.items():
+            if hb.sharding is not None:
+                shards = hb.shards
+
+                def cb(idx, shards=shards, shape=hb.shape):
+                    return shards[_bounds_key(idx, shape)]
+
+                pb[bname] = jax.make_array_from_callback(
+                    hb.shape, hb.sharding, cb)
+            else:
+                # host-born array that never had a device placement
+                pb[bname] = jax.device_put(
+                    next(iter(hb.shards.values())))
+            if hb.scale is not None:
+                scales.setdefault(lname, {})[bname] = \
+                    jnp.asarray(hb.scale, jnp.float32)
+        params[lname] = pb
+    return params, scales

@@ -1,30 +1,64 @@
-"""Model registry: snapshot → servable model, with hot-swap.
+"""Model registry: N named models, versioned, quantized, HBM-paged.
 
-Loads learned params from a snapshot file via `checkpoint` (the same
-codec training writes), keeps them behind an immutable `ModelVersion`,
-and supports swapping to a newer snapshot without dropping in-flight
-requests: the batcher snapshots `current()` ONCE per flush, so every
-request in a flush is answered by exactly one version — old or new,
-never mixed (tests/test_serving.py pins this).
+The registry is PLURAL: it holds any number of independently
+published/hot-swapped models (A/B arms, tenants, zoo variants), each
+with its own net, forward-program cache, and version history, routed
+by name.  The single-model surface (`load`/`publish`/`current`/
+`forward` with no name) is the DEFAULT model's view, byte-identical
+to the pre-plural registry — tests/test_serving.py runs unmodified.
+
+Hot-swap semantics are unchanged: the batcher snapshots `current()`
+ONCE per flush, so every request in a flush is answered by exactly one
+immutable `ModelVersion` — old or new, never mixed.
+
+Memory management (the multi-model tentpole):
+
+  * **Quantized residency** (COS_SERVE_WEIGHT_DTYPE=bf16|int8,
+    serving/quant.py): weights compress ONCE at publish — int8 blobs
+    with per-blob max-abs scales feed the PR 11 MXU kernels
+    dequant-free (InnerProduct) or dequantize at forward entry, bf16
+    blobs store half and upcast to f32 compute.  Each model is gated
+    by measured output drift vs its own f32 forward
+    (COS_SERVE_QUANT_TOL); a model that drifts past tolerance falls
+    back to f32 storage with a log line, per model.
+  * **LRU paging** (COS_SERVE_HBM_BUDGET_MB): resident sets are
+    tracked per model; when publishing or paging a model in would
+    exceed the budget, the least-recently-used OTHER models are
+    evicted — the registry drops its device references (in-flight
+    flushes keep theirs, so answers already being computed stay
+    correct) and keeps only the host-side compressed cache.  A request
+    for an evicted model pages it back in by streaming each compressed
+    shard straight to its destination device (the PR 9 zero-gather
+    idiom — never a dense host gather, never a file re-read).
+    Programs are cached per net digest and are params-agnostic, so
+    page-in never compiles (RecompileGuard-verifiable).
 
 The registry is constructible without a training run: it builds the
 TEST-phase net directly from the NetParameter (no Solver, no feed
-pipeline) and shares one `BlobForward` across versions, so a swap
-costs a param load — never a recompile.
+pipeline) and shares one `BlobForward` per model across versions, so
+a swap or a page-in costs a param placement — never a recompile.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import threading
-from typing import NamedTuple, Optional
+import time
+from typing import Dict, List, NamedTuple, Optional
+
+import numpy as np
 
 from .. import checkpoint
+from ..metrics import PipelineMetrics
 from ..net import Net, Params
 from ..proto import NetParameter, NetState, Phase, SolverParameter
+from . import quant
 from .forward import BlobForward, build_serving_layout
 
 _LOG = logging.getLogger(__name__)
+
+DEFAULT_MODEL = "default"
 
 
 def build_serving_net(net_param: NetParameter,
@@ -53,81 +87,488 @@ def build_serving_net(net_param: NetParameter,
 
 class ModelVersion(NamedTuple):
     """One immutable servable model.  Requests hold the version they
-    were answered by; the registry never mutates a published tuple."""
+    were answered by; the registry never mutates a published tuple.
+    `params` are in STORAGE dtype (f32, or bf16/int8 under quantized
+    residency — `scales` then carries the int8 blobs' dequant
+    scalars); an EVICTED entry's pointer is replaced with a
+    params=None tuple, but any flush that already captured the
+    resident tuple keeps serving from it."""
     version: int
     path: str
-    params: Params
+    params: Optional[Params]
+    scales: Optional[Dict] = None
+    weight_dtype: str = "f32"
+    nbytes: int = 0
 
 
-class ModelRegistry:
-    """Versioned param store + shared forward-program cache.
+class _ModelEntry:
+    """Registry-internal state for one named model."""
 
-    `layout` (a parallel.mesh.MeshLayout) turns the registry
-    mesh-parallel: the shared BlobForward jits under the mesh, `load`
-    streams checkpoint shards straight to their destination devices
-    (zero-gather — checkpoint.load_serving_params' mesh path), and
-    `publish` places in-memory params onto the layout before they
-    become current, so every version a flush can snapshot is already
-    on the mesh."""
-
-    def __init__(self, net: Net, layout=None):
+    def __init__(self, name: str, net: Net, layout=None):
+        self.name = name
         self.net = net
         self.layout = layout
         self.forward = BlobForward(net, layout=layout)
+        self.current: Optional[ModelVersion] = None
+        self.host_cache: Optional[quant.HostCache] = None
+        self.resident = False
+        self.last_used = 0          # LRU clock tick
+        self.version = 0
+        self.evictions = 0
+        self.page_ins = 0
+        self.quant_fallback: Optional[str] = None
+        # serializes the (device-side) page-in per model so two
+        # concurrent requests for the same cold model place it once;
+        # NEVER held while the table lock is wanted by eviction math
+        self.page_lock = threading.Lock()
+
+
+class ModelRegistry:
+    """Versioned named-model store + per-model forward-program caches.
+
+    `layout` (a parallel.mesh.MeshLayout) turns the DEFAULT model
+    mesh-parallel: its BlobForward jits under the mesh, `load` streams
+    checkpoint shards straight to their destination devices
+    (zero-gather — checkpoint.load_serving_params' mesh path), and
+    `publish` places in-memory params onto the layout before they
+    become current.  Models added via `add_model` take their own
+    layout (None = single-device)."""
+
+    def __init__(self, net: Net, layout=None, *,
+                 weight_dtype: Optional[str] = None,
+                 hbm_budget_bytes: Optional[int] = None,
+                 metrics: Optional[PipelineMetrics] = None):
         self._lock = threading.Lock()
-        self._current: Optional[ModelVersion] = None
-        self._version = 0
+        self._entries: Dict[str, _ModelEntry] = {}
+        self._clock = 0
+        self.metrics = metrics
+        # resolved ONCE at construction (COS003 discipline): the knobs
+        # must never be read per flush
+        self.weight_dtype = (weight_dtype if weight_dtype is not None
+                             else quant.serve_weight_dtype())
+        self.hbm_budget_bytes = (
+            hbm_budget_bytes if hbm_budget_bytes is not None
+            else quant.serve_hbm_budget_bytes())
+        self.quant_tol = quant.serve_quant_tol()
+        self._quant_check = os.environ.get(
+            "COS_SERVE_QUANT_CHECK", "1") != "0"
+        default = _ModelEntry(DEFAULT_MODEL, net, layout)
+        self._entries[DEFAULT_MODEL] = default
+        # single-model compatibility surface (the pre-plural API)
+        self.net = net
+        self.layout = layout
+        self.forward = default.forward
 
     @classmethod
-    def from_conf(cls, conf) -> "ModelRegistry":
+    def from_conf(cls, conf,
+                  metrics: Optional[PipelineMetrics] = None
+                  ) -> "ModelRegistry":
         if conf.netParam is None:
             raise ValueError("serving needs -conf (solver prototxt "
                              "resolving a net)")
         net = build_serving_net(conf.netParam, conf.solverParameter)
-        return cls(net, layout=build_serving_layout(net, conf))
+        return cls(net, layout=build_serving_layout(net, conf),
+                   metrics=metrics)
 
-    # ------------------------------------------------------------------
-    def load(self, model_path: str) -> ModelVersion:
-        """Load a snapshot (.caffemodel[.h5] or .solverstate[.h5] whose
-        learned_net pointer resolves) and publish it as the current
-        version.  In-flight flushes keep serving the version they
-        snapshotted; new flushes pick this one up.  Under a layout the
-        load STREAMS: shard-by-shard device placement, no host-RAM
-        gather of the full parameter set."""
-        params = checkpoint.load_serving_params(self.net, model_path,
-                                                layout=self.layout)
+    # -- model table ----------------------------------------------------
+    def _entry(self, model: Optional[str]) -> _ModelEntry:
+        name = model or DEFAULT_MODEL
         with self._lock:
-            self._version += 1
-            mv = ModelVersion(self._version, model_path, params)
-            self._current = mv
-        _LOG.info("model registry: version %d <- %s",
-                  mv.version, model_path)
-        return mv
+            e = self._entries.get(name)
+            known = sorted(self._entries) if e is None else None
+        if e is None:
+            # `known` snapshotted under the lock: formatting from the
+            # live dict here could race a concurrent add_model into
+            # RuntimeError instead of the 404-mapped KeyError
+            raise KeyError(f"unknown model {name!r} (published: "
+                           f"{known})")
+        return e
 
-    def publish(self, params: Params, path: str = "<in-memory>"
-                ) -> ModelVersion:
+    def add_model(self, name: str, net: Net, layout=None
+                  ) -> "_ModelEntry":
+        """Register a new named model (its versions publish/load like
+        the default's).  Each model keeps its own net + program cache,
+        namespaced per net digest, so adding a model never perturbs
+        another's compiled programs."""
+        if not name or "/" in name:
+            raise ValueError(f"bad model name {name!r}")
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(f"model {name!r} already registered")
+            e = _ModelEntry(name, net, layout)
+            self._entries[name] = e
+        return e
+
+    def remove_model(self, name: str) -> None:
+        """Unregister a named model (the failed-publish rollback path
+        — a half-added entry must not block a corrected re-publish).
+        The default model is permanent."""
+        if name == DEFAULT_MODEL:
+            raise ValueError("cannot remove the default model")
+        with self._lock:
+            self._entries.pop(name, None)
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def forward_for(self, model: Optional[str] = None) -> BlobForward:
+        return self._entry(model).forward
+
+    def net_for(self, model: Optional[str] = None) -> Net:
+        return self._entry(model).net
+
+    def has_model(self, name: str) -> bool:
+        with self._lock:
+            return name in self._entries
+
+    # -- publish / load -------------------------------------------------
+    def load(self, model_path: str,
+             model: Optional[str] = None) -> ModelVersion:
+        """Load a snapshot (.caffemodel[.h5] or .solverstate[.h5] whose
+        learned_net pointer resolves) and publish it as `model`'s
+        current version.  In-flight flushes keep serving the version
+        they snapshotted; new flushes pick this one up.  Under a
+        layout the load STREAMS: shard-by-shard device placement, no
+        host-RAM gather.  With quantized residency, a matching
+        `<path>.quant` sidecar (checkpoint.save_quant_sidecar) is
+        loaded DIRECTLY into the compressed cache — the f32 load,
+        publish-time quantization, and drift gate are all skipped
+        (they ran when the sidecar was written)."""
+        entry = self._entry(model)
+        if self.weight_dtype != "f32" and entry.layout is None:
+            sidecar = model_path + checkpoint.QUANT_SIDECAR_SUFFIX
+            if os.path.exists(sidecar):
+                return self._publish_sidecar(entry, sidecar,
+                                             model_path)
+        params = checkpoint.load_serving_params(entry.net, model_path,
+                                                layout=entry.layout)
+        return self._publish(entry, params, model_path)
+
+    def publish(self, params: Params, path: str = "<in-memory>",
+                model: Optional[str] = None) -> ModelVersion:
         """Install already-materialized params (tests, co-located
         trainers handing over fresh weights without a file round-trip).
         Under a layout the params are placed onto the mesh first, so
         hot-swap and load agree on where every shard lives."""
-        if self.layout is not None:
-            params = self.layout.place_params(params)
+        entry = self._entry(model)
+        if entry.layout is not None:
+            params = entry.layout.place_params(params)
+        return self._publish(entry, params, path)
+
+    def _publish(self, entry: _ModelEntry, params: Params, path: str
+                 ) -> ModelVersion:
+        """The one publish body: quantize (drift-gated), build the
+        compressed host cache (only when a budget makes paging
+        possible), make room under the budget, install."""
+        wd = self.weight_dtype
+        scales: Optional[Dict] = None
+        spec = quant.quant_spec(entry.net, wd) if wd != "f32" else {}
+        if spec:
+            cache = quant.build_host_cache(entry.net, params, spec)
+            qparams, scales = quant.place_from_cache(cache)
+            drift = (self._drift(entry, params, qparams, scales, wd)
+                     if self._quant_check else None)
+            if drift is not None and drift > self.quant_tol:
+                _LOG.warning(
+                    "model %s: %s residency drifts %.4f > tol %.4f "
+                    "vs f32 — falling back to f32 storage for this "
+                    "model", entry.name, wd, drift, self.quant_tol)
+                entry.quant_fallback = (
+                    f"drift {drift:.4f} > tol {self.quant_tol}")
+                wd, spec, scales, cache = "f32", {}, None, None
+            else:
+                entry.quant_fallback = None
+                params = qparams
+                if drift is not None:
+                    _LOG.info("model %s: %s residency drift %.4f "
+                              "(tol %.4f)", entry.name, wd, drift,
+                              self.quant_tol)
+        else:
+            cache = None
+        if not spec:
+            wd = "f32"
+        nbytes = quant.spec_nbytes(entry.net, spec)
+        if self.hbm_budget_bytes and cache is None:
+            # paging needs a host-side source; f32 mode caches the
+            # uncompressed shards (still per-shard, never dense)
+            cache = quant.build_host_cache(entry.net, params, spec)
         with self._lock:
-            self._version += 1
-            mv = ModelVersion(self._version, path, params)
-            self._current = mv
+            entry.version += 1
+            mv = ModelVersion(entry.version, path, params, scales,
+                              wd, nbytes)
+            self._make_room_locked(entry, nbytes)
+            entry.current = mv
+            entry.host_cache = cache
+            entry.resident = True
+            self._touch_locked(entry)
+            self._gauge_resident_locked()
+        _LOG.info("model registry: %s version %d <- %s (%s, %.1f MB "
+                  "resident)", entry.name, mv.version, path, wd,
+                  nbytes / 2**20)
         return mv
 
-    def current(self) -> ModelVersion:
+    def _publish_sidecar(self, entry: _ModelEntry, sidecar: str,
+                         path: str) -> ModelVersion:
+        blobs, host_scales, wd = checkpoint.load_quant_sidecar(sidecar)
+        if wd != self.weight_dtype:
+            _LOG.warning("%s: sidecar weight_dtype %s != requested %s "
+                         "— ignoring sidecar", sidecar, wd,
+                         self.weight_dtype)
+            params = checkpoint.load_serving_params(
+                entry.net, path, layout=entry.layout)
+            return self._publish(entry, params, path)
+        spec = quant.quant_spec(entry.net, wd)
+        cache: quant.HostCache = {}
+        for lname, specs in entry.net.param_layout.items():
+            centry: Dict[str, quant.HostBlob] = {}
+            for bname, shape, _ in specs:
+                arr = blobs[lname][bname]
+                kind = spec.get(lname, {}).get(bname, quant.F32)
+                key = tuple((0, d) for d in shape)
+                centry[bname] = quant.HostBlob(
+                    kind, shape, {key: arr},
+                    host_scales.get(lname, {}).get(bname), None)
+            cache[lname] = centry
+        params, scales = quant.place_from_cache(cache)
+        nbytes = quant.spec_nbytes(entry.net, spec)
         with self._lock:
-            mv = self._current
-        if mv is None:
-            raise RuntimeError("model registry is empty — load a "
-                               "snapshot (-model/-weights) before "
-                               "serving")
+            entry.version += 1
+            mv = ModelVersion(entry.version, path, params, scales,
+                              wd, nbytes)
+            self._make_room_locked(entry, nbytes)
+            entry.current = mv
+            entry.host_cache = cache if self.hbm_budget_bytes else None
+            entry.resident = True
+            self._touch_locked(entry)
+            self._gauge_resident_locked()
+        _LOG.info("model registry: %s version %d <- %s (quant "
+                  "sidecar, %s)", entry.name, mv.version, sidecar, wd)
         return mv
+
+    def _drift(self, entry: _ModelEntry, params_f32: Params,
+               qparams: Params, scales, wd: str) -> Optional[float]:
+        """Publish-time accuracy gate: max relative drift of the
+        quantized forward vs the f32 forward on seeded random inputs
+        over the net's float output blobs.  Both programs are
+        params-agnostic and cached on the entry's BlobForward, so
+        repeat publishes never recompile."""
+        import jax
+        import jax.numpy as jnp
+        net = entry.net
+        outs = tuple(bn for bn in net.output_blobs
+                     if bn in net.blob_shapes)
+        if not outs:
+            return None
+        rng = np.random.RandomState(0)
+        inputs = {}
+        for name, shape, kind in net.input_specs:
+            if kind.startswith("label"):
+                inputs[name] = jnp.zeros(shape, jnp.float32)
+            else:
+                inputs[name] = jnp.asarray(
+                    rng.rand(*shape).astype(np.float32))
+        try:
+            ref = entry.forward(outs)(params_f32, inputs)
+            got = entry.forward(outs, weight_dtype=wd)(
+                qparams, scales or {}, inputs)
+        except Exception as e:   # noqa: BLE001 — gate must fail SAFE
+            _LOG.warning("model %s: drift gate could not run (%s) — "
+                         "keeping f32 storage", entry.name, e)
+            return float("inf")
+        worst = 0.0
+        for bn in outs:
+            r = np.asarray(jax.device_get(ref[bn]), np.float32)
+            g = np.asarray(jax.device_get(got[bn]), np.float32)
+            denom = float(np.max(np.abs(r))) + 1e-9
+            worst = max(worst,
+                        float(np.max(np.abs(g - r))) / denom)
+        return worst
+
+    # -- LRU paging -----------------------------------------------------
+    def _touch_locked(self, entry: _ModelEntry) -> None:
+        self._clock += 1
+        entry.last_used = self._clock
+
+    def _resident_bytes_locked(self) -> int:
+        return sum(e.current.nbytes for e in self._entries.values()
+                   if e.resident and e.current is not None)
+
+    def _gauge_resident_locked(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge("resident_bytes",
+                               self._resident_bytes_locked())
+
+    def _make_room_locked(self, keep: _ModelEntry, need: int) -> None:
+        """Evict least-recently-used models (never `keep`) until
+        `need` more bytes fit the budget.  Eviction only drops the
+        REGISTRY's device references — a flush that captured the
+        version keeps its arrays alive until it completes, so answers
+        in flight stay correct; HBM frees when the last holder lets
+        go.  A model with no host cache cannot be evicted (nothing to
+        page back from)."""
+        budget = self.hbm_budget_bytes
+        if not budget:
+            return
+        while self._resident_bytes_locked() + need > budget:
+            victims = [e for e in self._entries.values()
+                       if e.resident and e is not keep
+                       and e.host_cache is not None]
+            if not victims:
+                if self._resident_bytes_locked() + need > budget:
+                    _LOG.warning(
+                        "HBM budget %.1f MB cannot hold %s "
+                        "(%.1f MB) even after evicting every other "
+                        "model — serving it anyway over budget",
+                        budget / 2**20, keep.name, need / 2**20)
+                return
+            victim = min(victims, key=lambda e: e.last_used)
+            self._evict_locked(victim)
+
+    def _evict_locked(self, victim: _ModelEntry) -> None:
+        assert victim.current is not None
+        _LOG.info("model registry: paging OUT %s (%.1f MB, LRU)",
+                  victim.name, victim.current.nbytes / 2**20)
+        victim.current = victim.current._replace(params=None,
+                                                 scales=None)
+        victim.resident = False
+        victim.evictions += 1
+        if self.metrics is not None:
+            self.metrics.incr("evictions")
+            self.metrics.incr(f"evictions_{victim.name}")
+
+    def _ensure_resident(self, entry: _ModelEntry) -> ModelVersion:
+        """Return a RESIDENT version tuple for `entry`, paging it in
+        from the compressed host cache if it was evicted.  The
+        returned tuple is captured under the table lock, so even an
+        eviction racing in right after cannot hand a caller
+        params=None — the capture keeps the device arrays alive."""
+        with self._lock:
+            mv = entry.current
+            if mv is None:
+                raise RuntimeError(
+                    f"model registry: {entry.name!r} is empty — load "
+                    "a snapshot (-model/-weights) before serving")
+            if entry.resident:
+                self._touch_locked(entry)
+                return mv
+        # page-in: device work OUTSIDE the table lock (COS005 — the
+        # lock must never be held over a blocking device transfer);
+        # the per-entry lock collapses concurrent cold requests for
+        # the same model into one placement
+        with entry.page_lock:
+            with self._lock:
+                if entry.resident and entry.current is not None:
+                    self._touch_locked(entry)
+                    return entry.current
+                cache = entry.host_cache
+                need = entry.current.nbytes
+                self._make_room_locked(entry, need)
+            if cache is None:
+                raise RuntimeError(
+                    f"model {entry.name!r} was evicted with no host "
+                    "cache — cannot page back in")
+            t0 = time.monotonic()
+            params, scales = quant.place_from_cache(cache)
+            import jax
+            jax.block_until_ready(
+                [a for bl in params.values() for a in bl.values()])
+            wall = time.monotonic() - t0
+            with self._lock:
+                mv = entry.current._replace(
+                    params=params, scales=scales or None)
+                entry.current = mv
+                entry.resident = True
+                entry.page_ins += 1
+                self._touch_locked(entry)
+                self._gauge_resident_locked()
+            if self.metrics is not None:
+                self.metrics.add("page_in", wall)
+                self.metrics.add(f"page_in_{entry.name}", wall)
+            _LOG.info("model registry: paged IN %s (%.1f MB, "
+                      "%.1f ms)", entry.name, mv.nbytes / 2**20,
+                      wall * 1e3)
+            return mv
+
+    # -- read side ------------------------------------------------------
+    def current(self, model: Optional[str] = None) -> ModelVersion:
+        """The model's current resident version (paging it in when
+        evicted).  Raises RuntimeError when nothing was ever
+        published."""
+        return self._ensure_resident(self._entry(model))
 
     @property
     def version(self) -> int:
         with self._lock:
-            return self._version
+            return self._entries[DEFAULT_MODEL].version
+
+    def version_of(self, model: Optional[str] = None) -> int:
+        entry = self._entry(model)
+        with self._lock:
+            return entry.version
+
+    def resident_models(self) -> List[str]:
+        with self._lock:
+            return sorted(n for n, e in self._entries.items()
+                          if e.resident)
+
+    def paged_out_models(self) -> List[str]:
+        with self._lock:
+            return sorted(n for n, e in self._entries.items()
+                          if not e.resident and e.current is not None)
+
+    def model_stats(self) -> Dict[str, dict]:
+        """Per-model registry view for /metrics and /healthz: resident
+        state, storage dtype, bytes, eviction/page-in counts."""
+        with self._lock:
+            out = {}
+            for n, e in self._entries.items():
+                mv = e.current
+                out[n] = {
+                    "version": e.version,
+                    "resident": e.resident,
+                    "resident_bytes": (mv.nbytes if e.resident
+                                       and mv is not None else 0),
+                    "weight_dtype": (mv.weight_dtype if mv is not None
+                                     else self.weight_dtype),
+                    "evictions": e.evictions,
+                    "page_ins": e.page_ins,
+                    "path": mv.path if mv is not None else None,
+                }
+                if e.quant_fallback:
+                    out[n]["quant_fallback"] = e.quant_fallback
+            return out
+
+    # -- quant sidecar export -------------------------------------------
+    def export_quant_sidecar(self, model_path: str,
+                             model: Optional[str] = None) -> str:
+        """Write `<model_path>.quant` — the current version's
+        compressed blobs + scales (checkpoint.save_quant_sidecar), so
+        the NEXT load of `model_path` under the same
+        COS_SERVE_WEIGHT_DTYPE skips the f32 load, the publish-time
+        quantization, AND the drift gate.  Dense models only (a
+        sharded layout's sidecar would need the per-shard slab format;
+        use the f32 sharded sidecars + publish-time quantization
+        there)."""
+        entry = self._entry(model)
+        if entry.layout is not None:
+            raise ValueError("quant sidecar export is dense-only "
+                             "(mesh layouts stream the f32 shard "
+                             "sidecars and quantize at publish)")
+        mv = self._ensure_resident(entry)
+        if mv.weight_dtype == "f32":
+            raise ValueError(
+                f"model {entry.name!r} is resident f32 — nothing to "
+                "export (set COS_SERVE_WEIGHT_DTYPE and republish)")
+        import jax
+        blobs: Dict[str, Dict[str, np.ndarray]] = {}
+        scales: Dict[str, Dict[str, float]] = {}
+        for lname, bl in mv.params.items():
+            blobs[lname] = {bn: np.asarray(jax.device_get(a))
+                            for bn, a in bl.items()}
+        for lname, bl in (mv.scales or {}).items():
+            scales[lname] = {bn: float(jax.device_get(a))
+                             for bn, a in bl.items()}
+        return checkpoint.save_quant_sidecar(
+            model_path + checkpoint.QUANT_SIDECAR_SUFFIX,
+            blobs, scales, mv.weight_dtype)

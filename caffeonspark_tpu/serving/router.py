@@ -46,6 +46,7 @@ import time
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
 
 from ..metrics import PipelineMetrics
 from .retry import RetryPolicy, retry_call
@@ -244,19 +245,24 @@ class Router:
 
     # -- request path -------------------------------------------------
     def predict(self, payload,
-                timeout_s: Optional[float] = None) -> dict:
+                timeout_s: Optional[float] = None,
+                query: str = "") -> dict:
         """Route one /v1/predict body; returns the replica's parsed
         response.  `payload` is a dict (programmatic callers) or
         pre-encoded JSON bytes — the HTTP front door passes the raw
         client body through untouched, since the replica parses and
         validates it anyway and the router is the fleet's one shared
-        chokepoint.  Retryable failures re-pick (usually a different
-        replica — the failed one is marked down or has higher
-        outstanding); non-retryable replica errors surface as
+        chokepoint.  `query` is the client's raw query string
+        (`model=<name>` multi-model routing rides there as well as in
+        the JSON body) — forwarded verbatim so name routing survives
+        the proxy hop.  Retryable failures re-pick (usually a
+        different replica — the failed one is marked down or has
+        higher outstanding); non-retryable replica errors surface as
         RouterRequestError with the original status."""
         data = (payload if isinstance(payload, (bytes, bytearray))
                 else json.dumps(payload).encode())
         timeout = timeout_s or self.http_timeout_s
+        route_path = "/v1/predict" + (f"?{query}" if query else "")
         t0 = time.monotonic()
         last_failed: List[Optional[str]] = [None]
 
@@ -266,7 +272,7 @@ class Router:
             failed = True
             try:
                 try:
-                    code, body = http_json(rep.url + "/v1/predict",
+                    code, body = http_json(rep.url + route_path,
                                             data=data, timeout=timeout)
                 except TRANSPORT_ERRORS + (ValueError,) as e:
                     # ValueError: a 200 whose body does not parse — a
@@ -437,7 +443,9 @@ class Router:
 
     def rolling_reload(self, model_path: str,
                        wait_idle_s: float = 60.0,
-                       on_reloaded=None) -> Dict[str, int]:
+                       on_reloaded=None,
+                       model_name: Optional[str] = None
+                       ) -> Dict[str, int]:
         """Publish `model_path` fleet-wide, one replica at a time:
         drain → wait idle → reload → back in rotation.  At every
         instant each replica serves entirely old or entirely new
@@ -445,14 +453,19 @@ class Router:
         two (the old-xor-new invariant the fleet tests pin).
         `on_reloaded(name)` fires after EACH replica's successful
         swap — the fleet uses it to repoint that replica's respawn
-        args mid-roll, not only at the end."""
+        args mid-roll, not only at the end.  `model_name` targets a
+        NAMED model on every replica (multi-model serving); None =
+        each replica's default model, the pre-plural behavior."""
         versions: Dict[str, int] = {}
+        body_req: Dict[str, str] = {"model": model_path}
+        if model_name is not None:
+            body_req["name"] = model_name
         for name in self.names():
             self.drain_replica(name, wait_idle_s=wait_idle_s)
             url = self.replica_url(name)
             code, body = http_json(
                 url + "/v1/reload",
-                data=json.dumps({"model": model_path}).encode(),
+                data=json.dumps(body_req).encode(),
                 timeout=max(self.http_timeout_s, 60.0))
             if code != 200:
                 # leave the replica draining (it still serves nothing)
@@ -465,6 +478,71 @@ class Router:
             self.metrics.incr("replica_reloads")
         self.metrics.incr("rolling_reloads")   # one per OPERATION
         return versions
+
+    # -- multi-model fan-out ------------------------------------------
+    def broadcast_post(self, path: str, body: dict,
+                       timeout_s: Optional[float] = None
+                       ) -> Dict[str, dict]:
+        """POST `body` to every non-down replica (publishing a new
+        named model fleet-wide — unlike a reload this needs no drain:
+        adding a model never disturbs the models already serving).
+        Returns {replica: parsed response}; a replica that fails gets
+        {"error": ...} and the rest still receive the post — the
+        caller (Fleet.publish_model) records the spec so a restarted
+        or lagging replica is re-published by the monitor."""
+        with self._lock:
+            targets = [(r.name, r.url) for r in self._replicas.values()
+                       if r.state != DOWN]
+        data = json.dumps(body).encode()
+        out: Dict[str, dict] = {}
+        for name, url in targets:
+            try:
+                code, resp = http_json(
+                    url + path, data=data,
+                    timeout=timeout_s or max(self.http_timeout_s,
+                                             60.0))
+                out[name] = resp if code == 200 else \
+                    {"error": resp.get("error", f"HTTP {code}"),
+                     "code": code}
+            except TRANSPORT_ERRORS + (ValueError,) as e:
+                out[name] = {"error": str(e)}
+        return out
+
+    def models_summary(self) -> Dict[str, dict]:
+        """Aggregate the per-model serving series across the fleet,
+        BY MODEL NAME: requests/rows/evictions/page-ins sum, p99 is
+        the fleet-worst, residency lists which replicas hold the model
+        in HBM right now.  Polls each routable replica's /metrics —
+        operator/bench cadence, never the request path (and never
+        under the router lock: COS005)."""
+        with self._lock:
+            targets = [(r.name, r.url) for r in self._replicas.values()
+                       if r.state in (OK, DRAINING)]
+        agg: Dict[str, dict] = {}
+        for rname, url in targets:
+            try:
+                code, body = http_json(url + "/metrics",
+                                       timeout=self.health_timeout_s)
+            except TRANSPORT_ERRORS + (ValueError,):
+                continue
+            if code != 200:
+                continue
+            for mname, st in (body.get("models") or {}).items():
+                a = agg.setdefault(mname, {
+                    "requests": 0, "rows": 0, "evictions": 0,
+                    "page_ins": 0, "p99_ms": None,
+                    "resident_on": [], "replicas": 0,
+                    "weight_dtype": st.get("weight_dtype")})
+                a["replicas"] += 1
+                for k in ("requests", "rows", "evictions",
+                          "page_ins"):
+                    a[k] += int(st.get(k) or 0)
+                p99 = st.get("p99_ms")
+                if p99 is not None:
+                    a["p99_ms"] = max(a["p99_ms"] or 0.0, p99)
+                if st.get("resident"):
+                    a["resident_on"].append(rname)
+        return agg
 
     # -- reporting ----------------------------------------------------
     def metrics_summary(self) -> dict:
@@ -497,7 +575,8 @@ def _make_handler():
 
         def do_GET(self):
             router: Router = self.server.router
-            if self.path == "/healthz":
+            path, _q = self._route()
+            if path == "/healthz":
                 states = router.states()
                 n_ok = sum(1 for s in states.values() if s == OK)
                 status = (OK if n_ok == len(states) and states
@@ -505,21 +584,48 @@ def _make_handler():
                 self._send(200 if n_ok else 503,
                            {"ok": bool(n_ok), "status": status,
                             "replicas": states})
-            elif self.path == "/metrics":
+            elif path == "/metrics":
                 self._send(200, router.metrics_summary())
+            elif path == "/v1/models":
+                # fleet-wide per-model aggregation (name-keyed sums +
+                # worst p99 + residency map) — operator cadence, so
+                # the replica round-trips live here, NOT on /metrics
+                self._send(200, {"models": router.models_summary()})
             else:
-                self._send(404, {"error": f"no route {self.path}"})
+                self._send(404, {"error": f"no route {path}"})
 
         def do_POST(self):
             router: Router = self.server.router
-            if self.path == "/v1/predict":
+            if self.path.split("?", 1)[0] == "/v1/models":
+                # fleet-wide named-model publish: fan out to every
+                # live replica (no drain needed); the fleet layer
+                # records the spec for respawn re-publish
+                try:
+                    publish_fn = (getattr(self.server, "publish_fn",
+                                          None)
+                                  or (lambda body:
+                                      router.broadcast_post(
+                                          "/v1/models", body)))
+                    out = publish_fn(self._read_json())
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 — publish fault
+                    self._send(503, {"error": str(e)})
+                else:
+                    ok = all("error" not in r for r in out.values())
+                    self._send(200 if ok else 503,
+                               {"ok": ok, "replicas": out})
+                return
+            if self.path.split("?", 1)[0] == "/v1/predict":
                 try:
                     # raw pass-through: the replica parses/validates
                     # the body; decoding + re-encoding thousands of
-                    # pixel floats here would double router CPU
+                    # pixel floats here would double router CPU — the
+                    # query string (?model=) forwards verbatim too
                     n = int(self.headers.get("Content-Length", 0))
-                    out = router.predict(self.rfile.read(n)
-                                         if n else b"{}")
+                    out = router.predict(
+                        self.rfile.read(n) if n else b"{}",
+                        query=urlsplit(self.path).query)
                 except RouterRequestError as e:
                     self._send(e.code, e.body)
                 except (RouteRetryable, NoReplicaAvailable) as e:
@@ -533,12 +639,17 @@ def _make_handler():
             elif self.path == "/v1/reload":
                 try:
                     # the fleet's reload_fn (when fronting a Fleet)
-                    # also repoints restart-on-death at the new model
+                    # also repoints restart-on-death at the new model;
+                    # "name" targets a named model on every replica
                     reload_fn = (getattr(self.server, "reload_fn",
                                          None)
                                  or router.rolling_reload)
-                    versions = reload_fn(self._read_json()["model"])
-                except (KeyError, ValueError,
+                    req = self._read_json()
+                    kw = {}
+                    if req.get("name") is not None:
+                        kw["model_name"] = req["name"]
+                    versions = reload_fn(req["model"], **kw)
+                except (KeyError, ValueError, TypeError,
                         json.JSONDecodeError) as e:
                     self._send(400, {"error": str(e)})
                 except Exception as e:    # noqa: BLE001 — swap fault
@@ -558,13 +669,16 @@ class RouterHTTPServer:
     loopback-by-default stance as the replica server."""
 
     def __init__(self, router: Router, host: str = "127.0.0.1",
-                 port: int = 0, reload_fn=None):
+                 port: int = 0, reload_fn=None, publish_fn=None):
         from http.server import ThreadingHTTPServer
         self.router = router
         self._httpd = ThreadingHTTPServer((host, port), _make_handler())
         self._httpd.daemon_threads = True
         self._httpd.router = router
         self._httpd.reload_fn = reload_fn
+        # fleet-aware /v1/models publish (records the spec for respawn
+        # re-publish); bare routers broadcast without remembering
+        self._httpd.publish_fn = publish_fn
         self._thread: Optional[threading.Thread] = None
 
     @property

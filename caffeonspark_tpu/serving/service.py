@@ -1,13 +1,23 @@
-"""InferenceService: registry + micro-batcher + pack/forward glue.
+"""InferenceService: registry + per-model micro-batcher lanes + glue.
 
-One service owns: a ModelRegistry (which net, which params), a
-test-phase DataSource used ONLY as the record decoder/transformer
-(its backing store is never read — requests carry their own
-payloads), and a MicroBatcher whose flush hook packs the coalesced
-records exactly the way `extract_features` packs them.  That shared
-path (DataSource.next_batch + BlobForward + fetch_rows) is what makes
+One service owns: a plural ModelRegistry (which nets, which params,
+who is HBM-resident), per-model test-phase DataSources used ONLY as
+record decoders/transformers (their backing stores are never read —
+requests carry their own payloads), and one MicroBatcher flush lane
+PER MODEL (batcher.FlushLanes) whose hook packs the coalesced records
+exactly the way `extract_features` packs them.  That shared path
+(DataSource.next_batch + BlobForward + fetch_rows) is what makes
 serving output byte-equal to the batch extract path for the same
 records at the same batch shape.
+
+Multi-model serving: `add_model(name, conf)` publishes additional
+independently hot-swappable models; `submit(..., model=name)` and the
+HTTP `model` field route by name.  Each model flushes on its own lane
+so a cold model paying an HBM page-in never stalls another model's
+buckets, and the registry's LRU (COS_SERVE_HBM_BUDGET_MB) plus
+quantized residency (COS_SERVE_WEIGHT_DTYPE) decide who stays in HBM
+— see serving/registry.py.  Single-model deployments (no `model`
+anywhere) run the default lane with byte-identical behavior.
 
 `Client` is the in-process front end (tests, co-located apps);
 `http_server.ServingHTTPServer` speaks JSON over stdlib http.server
@@ -24,11 +34,11 @@ import numpy as np
 
 from ..data.source import DataSource, ImageRecord, get_source
 from ..metrics import PipelineMetrics
-from .batcher import (MicroBatcher, PendingResult, QueueFullError,
-                      ServingStopped)
+from .batcher import (FlushLanes, MicroBatcher, PendingResult,
+                      QueueFullError, ServingStopped)
 from .retry import RetryPolicy, retry_call
 from .forward import fetch_rows
-from .registry import ModelRegistry
+from .registry import DEFAULT_MODEL, ModelRegistry
 
 _LOG = logging.getLogger(__name__)
 
@@ -58,10 +68,37 @@ def coerce_record(rec, dims: Tuple[int, int, int]) -> ImageRecord:
     return (rid, label, c, h, w, False, arr)
 
 
+class _ServedModel:
+    """Service-side state for one named model: its decoder source,
+    served blob set, lane metrics, and lazy record geometry."""
+
+    __slots__ = ("name", "blob_names", "source", "metrics", "_dims")
+
+    def __init__(self, name: str, blob_names: Tuple[str, ...],
+                 source: DataSource, metrics: PipelineMetrics):
+        self.name = name
+        self.blob_names = blob_names
+        self.source = source
+        self.metrics = metrics
+        self._dims: Optional[Tuple[int, int, int]] = None
+
+    def record_dims(self) -> Tuple[int, int, int]:
+        if self._dims is None:
+            try:
+                self._dims = self.source.image_dims()
+            except Exception as e:    # noqa: BLE001 — geometry-less
+                raise ValueError(
+                    "dict records need the data layer's static "
+                    "(C,H,W) geometry, which this source does not "
+                    f"expose: {e}") from None
+        return self._dims
+
+
 class InferenceService:
     """Online serving facade over a Config (same -conf the trainer
     uses): builds the net + registry, loads the snapshot named by
-    -model/-weights, and answers coalesced requests."""
+    -model/-weights, and answers coalesced requests — for the default
+    model and any number of `add_model`ed ones."""
 
     http_wait_s = 120.0       # front-end result wait (HTTP layer tunes)
 
@@ -72,34 +109,34 @@ class InferenceService:
                  default_timeout_ms: Optional[float] = None,
                  metrics: Optional[PipelineMetrics] = None):
         self.conf = conf
-        self.registry = ModelRegistry.from_conf(conf)
+        self.metrics = metrics or PipelineMetrics()
+        self.registry = ModelRegistry.from_conf(conf,
+                                                metrics=self.metrics)
         model = (getattr(conf, "snapshotModelFile", "")
                  or getattr(conf, "modelPath", ""))
         if model:
             self.registry.load(model)
-        self.source = self._build_source(conf)
-        if blob_names is None:
-            # -features picks the served blobs exactly like the batch
-            # extract path; default is the net's outputs (+ -label)
-            feats = getattr(conf, "features", "")
-            names = [b.strip() for b in feats.split(",")
-                     if b.strip()] if feats else \
-                list(self.registry.net.output_blobs)
-            label = getattr(conf, "label", "")
-            if label and label not in names:
-                names.append(label)
-            blob_names = names
-        self.blob_names: Tuple[str, ...] = tuple(blob_names)
-        self.metrics = metrics or PipelineMetrics()
+        source = self._build_source(conf)
+        blob_names = self._resolve_blob_names(conf, self.registry.net,
+                                              blob_names)
+        self.blob_names: Tuple[str, ...] = blob_names
+        # lane knobs shared by every model's MicroBatcher
+        self._lane_kw = dict(max_batch=max_batch,
+                             max_wait_ms=max_wait_ms,
+                             queue_depth=queue_depth,
+                             default_timeout_ms=default_timeout_ms)
         # mesh-aware micro-batching: bucket shapes stay divisible by
         # the serving mesh's dp extent so every flush splits evenly
         layout = self.registry.layout
         self.batcher = MicroBatcher(
-            self._run_batch, max_batch=max_batch,
-            max_wait_ms=max_wait_ms, queue_depth=queue_depth,
-            default_timeout_ms=default_timeout_ms,
+            self._run_batch,
             batch_multiple=layout.dp if layout is not None else 1,
-            metrics=self.metrics)
+            metrics=self.metrics, **self._lane_kw)
+        self._models: Dict[str, _ServedModel] = {
+            DEFAULT_MODEL: _ServedModel(DEFAULT_MODEL, blob_names,
+                                        source, self.metrics)}
+        self.lanes = FlushLanes(self._make_lane)
+        self.lanes.install(DEFAULT_MODEL, self.batcher)
         if layout is not None:
             # self-describing replica topology: the router, /metrics
             # scrapers, and bench artifacts read it from the same
@@ -111,11 +148,12 @@ class InferenceService:
         # /metrics and warmup artifacts are self-describing
         self.metrics.set_info("autotune",
                               self.registry.net.autotune_info())
+        self._publish_models_info()
         self._started = False
+        self._guard_steady = False
         self._draining = False   # rolling-swap state: reject new work
         self._warmup_wall_s: Optional[float] = None
         self._aot_cache_dir: Optional[str] = None
-        self._dims = None        # lazy (C,H,W) for dict-record coercion
         # COS_RECOMPILE_GUARD=1: after warmup pre-compiles every bucket
         # program, a steady-state recompile means a request slipped
         # past the buckets (shape drift) — fail the flush loudly
@@ -135,67 +173,130 @@ class InferenceService:
         return get_source(layer, phase_train=False, rank=0, num_ranks=1,
                           resize=getattr(conf, "resize", False))
 
+    @staticmethod
+    def _resolve_blob_names(conf, net, blob_names) -> Tuple[str, ...]:
+        """-features picks the served blobs exactly like the batch
+        extract path; default is the net's outputs (+ -label)."""
+        if blob_names is not None:
+            return tuple(blob_names)
+        feats = getattr(conf, "features", "")
+        names = [b.strip() for b in feats.split(",")
+                 if b.strip()] if feats else list(net.output_blobs)
+        label = getattr(conf, "label", "")
+        if label and label not in names:
+            names.append(label)
+        return tuple(names)
+
+    def _publish_models_info(self) -> None:
+        """info.models: the static multi-model facts every metrics
+        artifact should carry (the info.comm idiom)."""
+        self.metrics.set_info("models", {
+            "names": self.registry.models(),
+            "weight_dtype": self.registry.weight_dtype,
+            "hbm_budget_mb": round(
+                self.registry.hbm_budget_bytes / 2**20, 3),
+        })
+
     # -- lifecycle ----------------------------------------------------
     def start(self, warmup: bool = True) -> "InferenceService":
         """Warm every bucket's program BEFORE traffic (eager XLA
         pre-compile: without it the first request of each batch shape
         pays whole-program compilation in its latency), then start the
-        dispatcher.  With COS_AOT_CACHE_DIR set, warmup runs against
-        the persistent compilation cache — a replica whose programs an
-        earlier replica already compiled warms on cache hits (AOT warm
-        start, serving/aot.py)."""
+        dispatcher lanes.  With COS_AOT_CACHE_DIR set, warmup runs
+        against the persistent compilation cache — a replica whose
+        programs an earlier replica already compiled warms on cache
+        hits (AOT warm start, serving/aot.py)."""
         assert not self._started, "service already started"
         from . import aot
         layout = self.registry.layout
         cache_dir = aot.resolve_cache_dir(
             self.conf.netParam, self.batcher.buckets, self.blob_names,
-            mesh_sig=layout.signature() if layout is not None else None)
+            mesh_sig=layout.signature() if layout is not None else None,
+            weight_dtype=self.registry.weight_dtype)
         if cache_dir and aot.enable_aot_cache(cache_dir):
             self._aot_cache_dir = cache_dir
         t0 = time.monotonic()
         warmed = self.warmup() if warmup else False
         self._warmup_wall_s = time.monotonic() - t0 if warmed else None
+        # models added BEFORE start warm here too (after start,
+        # add_model warms inline); a named model's failed warmup must
+        # not unarm the default's guard — track them separately
+        all_warmed = warmed
+        for name in self._models:
+            if name != DEFAULT_MODEL and warmup:
+                all_warmed = self.warmup(name) and all_warmed
+        self._guard_steady = all_warmed
         if self._recompile_guard is not None:
-            self._recompile_guard.watch(
-                "serving.forward",
-                self.registry.forward(self.blob_names))
+            for name in self._models:
+                self._watch_model(name)
             # steady only when every bucket actually pre-compiled: a
             # skipped warmup (geometry-less source, warmup=False)
             # leaves the guard unarmed rather than counting the lazy
             # first compile per bucket as a violation
-            if warmed:
+            if all_warmed:
                 self._recompile_guard.mark_steady()
-        self.batcher.start()
+        self.lanes.start()
         self._started = True
         return self
 
-    def warmup(self) -> bool:
-        """Pre-compile every bucket program; True iff all compiled."""
-        model = self.registry.current()
+    def _watch_model(self, name: str) -> None:
+        if self._recompile_guard is None:
+            return
+        sm = self._models[name]
+        wd = self._weight_dtype_of(name)
+        # the default model keeps the historical watch name (pinned by
+        # the PR 7 zero-steady-recompile tests); named models suffix it
+        watch = ("serving.forward" if name == DEFAULT_MODEL
+                 else f"serving.forward.{name}")
+        self._recompile_guard.watch(
+            watch,
+            self.registry.forward_for(name)(sm.blob_names,
+                                            weight_dtype=wd))
+
+    def _weight_dtype_of(self, name: str) -> str:
         try:
-            c, h, w = self.source.image_dims()
+            entry = self.registry._entry(name)
+            mv = entry.current
+            return mv.weight_dtype if mv is not None \
+                else self.registry.weight_dtype
+        except KeyError:
+            return self.registry.weight_dtype
+
+    def warmup(self, model: Optional[str] = None) -> bool:
+        """Pre-compile every bucket program for `model` (default
+        model when None); True iff all compiled."""
+        name = model or DEFAULT_MODEL
+        sm = self._models[name]
+        mv = self.registry.current(name)
+        try:
+            c, h, w = sm.source.image_dims()
         except Exception as e:       # noqa: BLE001 — geometry-less
             _LOG.warning("serving warmup skipped (no static record "
                          "geometry): %s", e)
             return False
         dummy: ImageRecord = ("_warmup", 0.0, c, h, w, False,
                               np.zeros((c, h, w), np.float32))
-        fwd = self.registry.forward(self.blob_names)
-        for bucket in self.batcher.buckets:
+        fwd = self.registry.forward_for(name)(
+            sm.blob_names, weight_dtype=mv.weight_dtype)
+        lane = self.lanes.lane(name)
+        for bucket in lane.buckets:
             t0 = time.monotonic()
-            batch = self.source.next_batch([dummy] * bucket)
-            batch = self.source.apply_device_stage(batch)
-            out = fwd(model.params, batch)
-            fetch_rows(out, self.blob_names, ["_warmup"] * bucket,
+            batch = sm.source.next_batch([dummy] * bucket)
+            batch = sm.source.apply_device_stage(batch)
+            if mv.weight_dtype == "f32":
+                out = fwd(mv.params, batch)
+            else:
+                out = fwd(mv.params, mv.scales or {}, batch)
+            fetch_rows(out, sm.blob_names, ["_warmup"] * bucket,
                        real=1, bs=bucket)
-            self.metrics.add("warmup_compile", time.monotonic() - t0)
-        _LOG.info("serving warmup: %d bucket programs compiled %s",
-                  len(self.batcher.buckets), list(self.batcher.buckets))
+            sm.metrics.add("warmup_compile", time.monotonic() - t0)
+        _LOG.info("serving warmup[%s]: %d bucket programs compiled %s",
+                  name, len(lane.buckets), list(lane.buckets))
         return True
 
     def stop(self, drain: bool = True):
         if self._started:
-            self.batcher.stop(drain=drain)
+            self.lanes.stop(drain=drain)
             self._started = False
 
     # -- draining (rolling hot-swap) ----------------------------------
@@ -210,60 +311,144 @@ class InferenceService:
         dispatcher stays up and undraining is instant."""
         self._draining = bool(flag)
 
+    # -- multi-model management ---------------------------------------
+    def _make_lane(self, name: str) -> MicroBatcher:
+        """FlushLanes factory: each non-default model gets its own
+        MicroBatcher (own queue + threads — a page-in stalls one lane)
+        with its own PipelineMetrics, so per-model latency/served_rows
+        series come for free in the /metrics models block."""
+        sm = self._models[name]
+        layout = self.registry._entry(name).layout
+        return MicroBatcher(
+            lambda records, bucket, _n=name:
+                self._run_batch(records, bucket, _n),
+            batch_multiple=layout.dp if layout is not None else 1,
+            metrics=sm.metrics, **self._lane_kw)
+
+    def add_model(self, name: str, conf, *,
+                  blob_names: Optional[Sequence[str]] = None,
+                  layout=None, warmup: bool = True) -> int:
+        """Publish an additional named model from its own Config (the
+        same -conf/-model pair the single-model service boots from).
+        Returns the published version.  The model gets its own net,
+        decoder, flush lane, and AOT/program namespace (per net
+        digest); it hot-swaps via reload(model=name) and pages in/out
+        under the registry's LRU like any other.  A failed publish
+        (bad weights path, broken prototxt) rolls the registration
+        back completely, so the corrected spec can simply be
+        re-POSTed."""
+        from .registry import build_serving_net
+        if conf.netParam is None:
+            raise ValueError(f"model {name!r}: conf resolves no net")
+        model_path = (getattr(conf, "snapshotModelFile", "")
+                      or getattr(conf, "modelPath", ""))
+        if not model_path:
+            raise ValueError(f"model {name!r}: conf names no weights "
+                             "(-model/-weights)")
+        net = build_serving_net(conf.netParam, conf.solverParameter)
+        self.registry.add_model(name, net, layout=layout)
+        try:
+            sm = _ServedModel(
+                name, self._resolve_blob_names(conf, net, blob_names),
+                self._build_source(conf), PipelineMetrics())
+            self._models[name] = sm
+            version = self.registry.load(model_path,
+                                         model=name).version
+            lane = self.lanes.lane(name)  # create (+ start) the lane
+        except BaseException:
+            # half-added models must not squat the name: the next
+            # add_model for it would hit "already registered" while
+            # predicts hit an empty registry entry
+            self.lanes.remove(name)
+            self._models.pop(name, None)
+            self.registry.remove_model(name)
+            raise
+        if warmup and self._started:
+            t0 = time.monotonic()
+            if self.warmup(name):
+                sm.metrics.add("warmup", time.monotonic() - t0)
+                self._watch_model(name)
+                # re-snapshot steady ONLY if start() already armed
+                # the guard: a deliberately-unarmed default (skipped
+                # warmup) must not be frozen mid-lazy-compile by a
+                # later add_model's global mark_steady
+                if (self._recompile_guard is not None
+                        and self._guard_steady):
+                    self._recompile_guard.mark_steady()
+        elif self._recompile_guard is not None:
+            self._watch_model(name)
+        _LOG.info("serving: model %r published (v%d, buckets %s)",
+                  name, version, list(lane.buckets))
+        self._publish_models_info()
+        return version
+
+    def models(self) -> List[str]:
+        return self.registry.models()
+
+    def has_model(self, name: str) -> bool:
+        return self.registry.has_model(name)
+
     # -- model hook ---------------------------------------------------
-    def _run_batch(self, records: List[Any], bucket: int
+    def _run_batch(self, records: List[Any], bucket: int,
+                   model: str = DEFAULT_MODEL
                    ) -> Tuple[List[Dict[str, Any]], int]:
         """One flush: pad to the bucket (repeat-last, the same rule as
         extract_rows' ragged tail), pack through the test-phase
         transformer, one jitted forward, per-request rows.  The model
         is snapshotted ONCE here — every row of this flush comes from
-        one version."""
-        model = self.registry.current()
-        m = self.metrics
+        one version (paged in first if the LRU evicted it; the page-in
+        stalls only THIS model's lane)."""
+        sm = self._models[model]
+        mv = self.registry.current(model)
+        m = sm.metrics
         buf: List[ImageRecord] = list(records)  # coerced at submit()
         ids = [str(r[0]) if r[0] != "" else str(i)
                for i, r in enumerate(buf)]
         real = len(buf)
         buf = buf + [buf[-1]] * (bucket - real)
         t0 = time.monotonic()
-        batch = self.source.next_batch(buf)
+        batch = sm.source.next_batch(buf)
         m.add("pack", time.monotonic() - t0)
-        batch = self.source.apply_device_stage(batch)
-        fwd = self.registry.forward(self.blob_names)
+        batch = sm.source.apply_device_stage(batch)
+        fwd = self.registry.forward_for(model)(
+            sm.blob_names, weight_dtype=mv.weight_dtype)
         t0 = time.monotonic()
-        out = fwd(model.params, batch)
-        rows = fetch_rows(out, self.blob_names, ids, real=real,
+        if mv.weight_dtype == "f32":
+            out = fwd(mv.params, batch)
+        else:
+            out = fwd(mv.params, mv.scales or {}, batch)
+        rows = fetch_rows(out, sm.blob_names, ids, real=real,
                           bs=bucket)
         m.add("fwd", time.monotonic() - t0)
         if self._recompile_guard is not None:
             self._recompile_guard.check()
-        return rows, model.version
+        return rows, mv.version
 
     # -- request API --------------------------------------------------
-    def _record_dims(self) -> Tuple[int, int, int]:
-        if self._dims is None:
-            try:
-                self._dims = self.source.image_dims()
-            except Exception as e:    # noqa: BLE001 — geometry-less
-                raise ValueError(
-                    "dict records need the data layer's static (C,H,W) "
-                    f"geometry, which this source does not expose: {e}"
-                    ) from None
-        return self._dims
+    def _served(self, model: Optional[str]) -> _ServedModel:
+        sm = self._models.get(model or DEFAULT_MODEL)
+        if sm is None:
+            raise KeyError(f"unknown model {model!r} (published: "
+                           f"{sorted(self._models)})")
+        return sm
 
-    def submit(self, record, timeout_ms: Optional[float] = None
-               ) -> PendingResult:
+    def submit(self, record, timeout_ms: Optional[float] = None,
+               model: Optional[str] = None) -> PendingResult:
         """Coercion/validation happens HERE, per request — a malformed
         record must be the submitter's error (HTTP 400), never a flush
         failure that poisons every co-batched request."""
         if self._draining:
             raise ServingStopped("replica is draining")
+        sm = self._served(model)
         if not isinstance(record, tuple):
-            record = coerce_record(record, self._record_dims())
-        return self.batcher.submit(record, timeout_ms=timeout_ms)
+            record = coerce_record(record, sm.record_dims())
+        sm.metrics.incr("requests")
+        return self.lanes.lane(sm.name).submit(record,
+                                               timeout_ms=timeout_ms)
 
     def submit_many(self, records: Sequence[Any],
-                    timeout_ms: Optional[float] = None
+                    timeout_ms: Optional[float] = None,
+                    model: Optional[str] = None
                     ) -> List[PendingResult]:
         """Coerce EVERY record first (a malformed one rejects the list
         before anything is enqueued), then enqueue all-or-nothing — a
@@ -271,16 +456,21 @@ class InferenceService:
         caller was told to retry."""
         if self._draining:
             raise ServingStopped("replica is draining")
+        sm = self._served(model)
         coerced = [r if isinstance(r, tuple)
-                   else coerce_record(r, self._record_dims())
+                   else coerce_record(r, sm.record_dims())
                    for r in records]
-        return self.batcher.submit_many(coerced, timeout_ms=timeout_ms)
+        sm.metrics.incr("requests", len(coerced))
+        return self.lanes.lane(sm.name).submit_many(
+            coerced, timeout_ms=timeout_ms)
 
-    def reload(self, model_path: str) -> int:
-        """Hot-swap to a newer snapshot; in-flight flushes finish on
-        the version they started with.  Clears draining: a reload is
-        how a drained replica rejoins the rotation (rolling swap)."""
-        version = self.registry.load(model_path).version
+    def reload(self, model_path: str,
+               model: Optional[str] = None) -> int:
+        """Hot-swap `model` (default when None) to a newer snapshot;
+        in-flight flushes finish on the version they started with.
+        Clears draining: a reload is how a drained replica rejoins the
+        rotation (rolling swap)."""
+        version = self.registry.load(model_path, model=model).version
         self._draining = False
         return version
 
@@ -291,18 +481,51 @@ class InferenceService:
         layout = self.registry.layout
         return layout.describe() if layout is not None else None
 
+    # -- reporting ----------------------------------------------------
+    def models_summary(self) -> Dict[str, dict]:
+        """Per-model block for /metrics and /v1/models: registry state
+        (residency, storage, evictions, page-ins) + the model's lane
+        series (requests, rows, p99, queue depth)."""
+        out = self.registry.model_stats()
+        # page-in series land in the SERVICE metrics (the registry
+        # records them there, keyed page_in_<name>), not in the
+        # per-model lane metrics — read them from the right object
+        main_stages = self.metrics.summary()["stages"]
+        for name, stats in out.items():
+            sm = self._models.get(name)
+            if sm is None:
+                continue
+            lane = self.lanes.get(name)
+            ms = sm.metrics.summary()
+            lat = ms["stages"].get("latency", {})
+            page = main_stages.get(f"page_in_{name}", {})
+            stats.update({
+                "requests": ms["counters"].get("requests", 0),
+                "rows": ms["counters"].get("served_rows", 0),
+                "p99_ms": lat.get("p99_ms"),
+                "queue_depth_now": lane.depth() if lane else 0,
+                "page_in_ms": page.get("mean_ms"),
+                "blob_names": list(sm.blob_names),
+            })
+        return out
+
     def metrics_summary(self) -> dict:
         out = self.metrics.summary()
         out["model_version"] = self.registry.version
         out["buckets"] = list(self.batcher.buckets)
         # live depth + status: what the fleet router polls to spot a
-        # backed-up replica and to confirm a drain went idle
-        out["queue_depth_now"] = self.batcher.depth()
+        # backed-up replica and to confirm a drain went idle (ALL
+        # lanes — a backed-up named model counts)
+        out["queue_depth_now"] = self.lanes.depth()
         out["status"] = "draining" if self._draining else "ok"
         if self._warmup_wall_s is not None:
             out["warmup_s"] = round(self._warmup_wall_s, 4)
         if self._aot_cache_dir:
             out["aot_cache_dir"] = self._aot_cache_dir
+        out["models"] = self.models_summary()
+        if self.registry.hbm_budget_bytes:
+            out["hbm_budget_mb"] = round(
+                self.registry.hbm_budget_bytes / 2**20, 3)
         return out
 
 
@@ -314,20 +537,29 @@ class Client:
     router uses over HTTP — instead of surfacing on the first bounce:
     a co-located caller that fails fast and retries hot is the herd
     the fast-reject is shedding.  `retry=False` (or
-    COS_SERVE_RETRY_MAX=1) restores surface-immediately."""
+    COS_SERVE_RETRY_MAX=1) restores surface-immediately.  `model`
+    routes to a named model (None = the default)."""
 
     def __init__(self, service: InferenceService,
                  policy: Optional[RetryPolicy] = None,
-                 retry: bool = True):
+                 retry: bool = True, model: Optional[str] = None):
         self.service = service
         self.policy = policy or RetryPolicy()
         self.retry = retry
+        self.model = model
 
     def _submit(self, record, timeout_ms):
+        # the model kwarg only rides when a name was given: a default
+        # client works against any submit(record, timeout_ms) duck
+        # (tests stub the service), and the default path stays the
+        # exact pre-plural call
+        kw = {} if self.model is None else {"model": self.model}
         if not self.retry:
-            return self.service.submit(record, timeout_ms=timeout_ms)
+            return self.service.submit(record, timeout_ms=timeout_ms,
+                                       **kw)
         return retry_call(
-            lambda: self.service.submit(record, timeout_ms=timeout_ms),
+            lambda: self.service.submit(record, timeout_ms=timeout_ms,
+                                        **kw),
             retry_on=(QueueFullError,), policy=self.policy)
 
     def predict_one(self, record, timeout_ms: Optional[float] = None,

@@ -1,0 +1,505 @@
+"""Multi-model serving: plural registry, quantized weight residency,
+LRU HBM paging, per-model flush lanes, name routing (in-process and
+HTTP), and the eviction-correctness gate — concurrent predicts against
+two models under a budget that fits only one must both answer
+correctly with zero steady-state recompiles."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from caffeonspark_tpu import checkpoint
+from caffeonspark_tpu.config import Config
+from caffeonspark_tpu.proto import NetParameter, SolverParameter
+from caffeonspark_tpu.serving import (Client, InferenceService,
+                                      ModelRegistry, ServingHTTPServer,
+                                      build_serving_net, quant_spec)
+from caffeonspark_tpu.serving import aot, quant
+from caffeonspark_tpu.solver import Solver
+
+# ip is BIG on purpose (8*10*10 x 1024 = 819200 params = 3.1 MB f32):
+# COS_SERVE_HBM_BUDGET_MB has MB granularity, so a 4 MB budget fits
+# exactly one f32 model — the fits-only-one eviction regime
+NET_TMPL = """
+name: "mm"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param {{ source: "{root}/unused_lmdb" batch_size: 4
+    channels: 1 height: 12 width: 12 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param {{ num_output: 8 kernel_size: 3
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "relu" type: "ReLU" bottom: "conv1" top: "conv1" }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "conv1" top: "ip"
+  inner_product_param {{ num_output: 1024
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}
+"""
+
+SOLVER_TMPL = """
+net: "{net}"
+base_lr: 0.01
+lr_policy: "fixed"
+max_iter: 5
+random_seed: 3
+"""
+
+
+def _records(n, seed=0):
+    return [(f"{i:06d}", 0.0, 1, 12, 12, False,
+             np.random.RandomState(seed + i)
+             .rand(1, 12, 12).astype(np.float32) * 255.0)
+            for i in range(n)]
+
+
+@pytest.fixture()
+def mm_model(tmp_path):
+    net_path = tmp_path / "net.prototxt"
+    net_path.write_text(NET_TMPL.format(root=tmp_path))
+    solver_path = tmp_path / "solver.prototxt"
+    solver_path.write_text(SOLVER_TMPL.format(net=net_path))
+    s = Solver(SolverParameter.from_text(
+        SOLVER_TMPL.format(net=net_path)),
+        NetParameter.from_text(NET_TMPL.format(root=tmp_path)))
+    params, _ = s.init()
+    model = str(tmp_path / "m.caffemodel")
+    checkpoint.save_caffemodel(model, s.train_net, params)
+    return str(solver_path), model
+
+
+def _conf(mm_model):
+    solver_path, model = mm_model
+    return Config(["-conf", solver_path, "-model", model])
+
+
+def _constant_params(net, bias):
+    """Zero conv + zero ip weight → ip output == its bias exactly
+    (even under int8 residency: zero quantizes to zero), so every
+    answer names the model that produced it."""
+    import jax
+    import jax.numpy as jnp
+    p = net.init(jax.random.key(0))
+    out = {ln: {bn: jnp.zeros_like(a) for bn, a in bl.items()}
+           for ln, bl in p.items()}
+    out["ip"]["bias"] = jnp.full_like(p["ip"]["bias"], bias)
+    return out
+
+
+# ---------------------------------------------------------------- units
+
+def test_quant_spec_rules(mm_model):
+    net = build_serving_net(_conf(mm_model).netParam)
+    assert quant_spec(net, "f32") == {}
+    s8 = quant_spec(net, "int8")
+    # conv1 weight (72 elems) and every bias stay f32; the TEST-phase
+    # InnerProduct weight is the dequant-free kernel operand
+    assert s8 == {"ip": {"weight": "int8_ip"}}
+    sb = quant_spec(net, "bf16")
+    assert sb == {"ip": {"weight": "bf16"}}
+    f32_b = quant.spec_nbytes(net, {})
+    assert quant.spec_nbytes(net, s8) < f32_b * 0.35
+    assert quant.spec_nbytes(net, sb) < f32_b * 0.6
+
+
+def test_aot_namespace_per_weight_dtype(mm_model):
+    np_ = _conf(mm_model).netParam
+    base = aot.aot_cache_key(np_, (1, 2), ("ip",))
+    # f32 / None leave every pre-quantization digest unchanged
+    assert aot.aot_cache_key(np_, (1, 2), ("ip",),
+                             weight_dtype="f32") == base
+    assert aot.aot_cache_key(np_, (1, 2), ("ip",),
+                             weight_dtype="int8") != base
+    assert aot.aot_cache_key(np_, (1, 2), ("ip",),
+                             weight_dtype="bf16") not in (
+        base, aot.aot_cache_key(np_, (1, 2), ("ip",),
+                                weight_dtype="int8"))
+
+
+def test_publish_time_quantization_once(mm_model, monkeypatch):
+    """The int8 residency quantizes at PUBLISH, not per flush: the
+    resident weight IS int8, and the host-side quantization pass runs
+    exactly once per publish — predicts never re-enter it."""
+    calls = []
+    orig = quant._quantize_shards_int8
+
+    def counting(shards):
+        calls.append(1)
+        return orig(shards)
+
+    monkeypatch.setattr(quant, "_quantize_shards_int8", counting)
+    conf = _conf(mm_model)
+    net = build_serving_net(conf.netParam)
+    reg = ModelRegistry(net, weight_dtype="int8", hbm_budget_bytes=0)
+    import jax
+    mv = reg.publish(net.init(jax.random.key(0)), "A")
+    assert mv.weight_dtype == "int8"
+    import jax.numpy as jnp
+    assert mv.params["ip"]["weight"].dtype == jnp.int8
+    assert float(mv.scales["ip"]["weight"]) > 0
+    n_publish = len(calls)
+    assert n_publish >= 1
+    # flushes run the forward without touching the quantization pass
+    fwd = reg.forward(("ip",), weight_dtype="int8")
+    inputs = {"data": jnp.zeros((4, 1, 12, 12), jnp.float32),
+              "label": jnp.zeros((4,), jnp.float32)}
+    for _ in range(3):
+        fwd(mv.params, mv.scales, inputs)
+    assert len(calls) == n_publish
+
+
+@pytest.mark.parametrize("wd", ["bf16", "int8"])
+def test_quant_residency_parity(mm_model, wd):
+    """Quantized serving output stays within the drift tolerance of
+    the f32 forward on real (trained-shape) weights."""
+    import jax
+    import jax.numpy as jnp
+    conf = _conf(mm_model)
+    net = build_serving_net(conf.netParam)
+    params = checkpoint.load_serving_params(net, conf.modelPath)
+    regf = ModelRegistry(net, weight_dtype="f32", hbm_budget_bytes=0)
+    regq = ModelRegistry(net, weight_dtype=wd, hbm_budget_bytes=0)
+    mvf = regf.publish(params, "f32")
+    mvq = regq.publish(params, wd)
+    assert mvq.weight_dtype == wd          # drift gate did NOT trip
+    inputs = {"data": jnp.asarray(np.random.RandomState(1)
+                                  .rand(4, 1, 12, 12)
+                                  .astype(np.float32)),
+              "label": jnp.zeros((4,), jnp.float32)}
+    ref = regf.forward(("ip",))(mvf.params, inputs)["ip"]
+    got = regq.forward(("ip",), weight_dtype=wd)(
+        mvq.params, mvq.scales or {}, inputs)["ip"]
+    rel = float(jnp.max(jnp.abs(got - ref))
+                / (jnp.max(jnp.abs(ref)) + 1e-9))
+    assert rel < quant.serve_quant_tol(), (wd, rel)
+
+
+def test_drift_gate_falls_back_to_f32(mm_model, monkeypatch):
+    """A model whose quantized output drifts past COS_SERVE_QUANT_TOL
+    is published in f32 storage (per model), with the reason
+    recorded."""
+    monkeypatch.setenv("COS_SERVE_QUANT_TOL", "1e-12")
+    import jax
+    conf = _conf(mm_model)
+    net = build_serving_net(conf.netParam)
+    reg = ModelRegistry(net, weight_dtype="int8", hbm_budget_bytes=0)
+    params = checkpoint.load_serving_params(net, conf.modelPath)
+    mv = reg.publish(params, "A")
+    assert mv.weight_dtype == "f32"
+    assert mv.scales is None
+    assert "drift" in reg.model_stats()["default"]["quant_fallback"]
+
+
+def test_quant_sidecar_roundtrip(mm_model, tmp_path, monkeypatch):
+    """export_quant_sidecar → load: the next registry.load pages the
+    compressed blobs straight in — the f32 parse path is never
+    touched."""
+    monkeypatch.setenv("COS_SERVE_WEIGHT_DTYPE", "int8")
+    conf = _conf(mm_model)
+    net = build_serving_net(conf.netParam)
+    reg = ModelRegistry(net, weight_dtype="int8", hbm_budget_bytes=0)
+    mv = reg.load(conf.modelPath)
+    side = reg.export_quant_sidecar(conf.modelPath)
+    assert side == conf.modelPath + checkpoint.QUANT_SIDECAR_SUFFIX
+    blobs, scales, wd = checkpoint.load_quant_sidecar(side)
+    assert wd == "int8"
+    np.testing.assert_array_equal(
+        blobs["ip"]["weight"], np.asarray(mv.params["ip"]["weight"]))
+    assert scales["ip"]["weight"] == pytest.approx(
+        float(mv.scales["ip"]["weight"]))
+    # a fresh registry must take the sidecar path, never the f32 load
+    net2 = build_serving_net(conf.netParam)
+    reg2 = ModelRegistry(net2, weight_dtype="int8",
+                         hbm_budget_bytes=0)
+
+    def boom(*a, **k):
+        raise AssertionError("f32 load path touched despite sidecar")
+
+    monkeypatch.setattr(checkpoint, "load_serving_params", boom)
+    mv2 = reg2.load(conf.modelPath)
+    assert mv2.weight_dtype == "int8"
+    np.testing.assert_array_equal(
+        np.asarray(mv2.params["ip"]["weight"]),
+        np.asarray(mv.params["ip"]["weight"]))
+
+
+def test_lru_eviction_and_page_in(mm_model):
+    """Budget fits one model: publishing B evicts A; touching A pages
+    it back (evicting B); the paged-in version answers exactly."""
+    import jax.numpy as jnp
+    conf = _conf(mm_model)
+    net_a = build_serving_net(conf.netParam)
+    net_b = build_serving_net(conf.netParam)
+    budget = 4 * 2**20          # one 3.1 MB f32 model, not two
+    reg = ModelRegistry(net_a, weight_dtype="f32",
+                        hbm_budget_bytes=budget)
+    reg.add_model("b", net_b)
+    reg.publish(_constant_params(net_a, 1.0), "A")
+    reg.publish(_constant_params(net_b, 2.0), "B", model="b")
+    assert reg.resident_models() == ["b"]
+    assert reg.paged_out_models() == ["default"]
+    mva = reg.current()                     # pages A in, evicts B
+    assert reg.resident_models() == ["default"]
+    inputs = {"data": jnp.zeros((4, 1, 12, 12), jnp.float32),
+              "label": jnp.zeros((4,), jnp.float32)}
+    out = reg.forward(("ip",))(mva.params, inputs)["ip"]
+    assert float(out[0, 0]) == 1.0
+    st = reg.model_stats()
+    assert st["default"]["page_ins"] == 1
+    assert st["default"]["evictions"] == 1
+    assert st["b"]["evictions"] == 1
+
+
+# ------------------------------------------------------ service level
+
+def test_service_multimodel_routing_no_bleed(mm_model):
+    """Two named models with distinguishable constant weights: every
+    answer matches the model it was addressed to, interleaved."""
+    conf = _conf(mm_model)
+    svc = InferenceService(conf, blob_names=("ip",), max_batch=4,
+                           max_wait_ms=1, queue_depth=64)
+    svc.registry.publish(
+        _constant_params(svc.registry.net, 1.0), "A")
+    svc.add_model("b", _conf(mm_model), blob_names=("ip",))
+    svc.registry.publish(
+        _constant_params(svc.registry.net_for("b"), 2.0), "B",
+        model="b")
+    svc.start(warmup=False)
+    try:
+        assert sorted(svc.models()) == ["b", "default"]
+        recs = _records(6)
+        for i, rec in enumerate(recs):
+            want = 1.0 if i % 2 == 0 else 2.0
+            model = None if i % 2 == 0 else "b"
+            row = svc.submit(rec, model=model).wait(60.0)
+            assert row["ip"] == [want] * 1024, (i, row["ip"][:3])
+        with pytest.raises(KeyError):
+            svc.submit(recs[0], model="nope")
+        ms = svc.metrics_summary()["models"]
+        assert ms["default"]["rows"] == 3 and ms["b"]["rows"] == 3
+        # per-model lanes are distinct batchers
+        assert svc.lanes.get("b") is not svc.lanes.get("default")
+    finally:
+        svc.stop()
+
+
+def test_concurrent_eviction_correctness(mm_model, monkeypatch):
+    """THE eviction gate: concurrent predicts against models A and B
+    under a budget that fits only one.  Both must answer correctly
+    (no cross-model weight bleed), the loser pages back in, and the
+    RecompileGuard stays quiet — programs are cached per net digest,
+    so paging never compiles."""
+    monkeypatch.setenv("COS_SERVE_HBM_BUDGET_MB", "4")
+    monkeypatch.setenv("COS_SERVE_WEIGHT_DTYPE", "f32")
+    monkeypatch.setenv("COS_RECOMPILE_GUARD", "1")
+    conf = _conf(mm_model)
+    svc = InferenceService(conf, blob_names=("ip",), max_batch=4,
+                           max_wait_ms=1, queue_depth=64)
+    svc.registry.publish(
+        _constant_params(svc.registry.net, 1.0), "A")
+    svc.add_model("b", _conf(mm_model), blob_names=("ip",))
+    svc.registry.publish(
+        _constant_params(svc.registry.net_for("b"), 2.0), "B",
+        model="b")
+    assert svc._recompile_guard is not None
+    svc.start(warmup=True)      # warms both models → guard steady
+    try:
+        errors = []
+        done = [0, 0]
+
+        def worker(i, model, want):
+            try:
+                c = Client(svc, model=model)
+                for rec in _records(12, seed=100 * i):
+                    row = c.predict_one(rec, wait_s=60.0)
+                    assert row["ip"] == [want] * 1024, row["ip"][:3]
+                    done[i] += 1
+            except BaseException as e:   # noqa: BLE001 — reported
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker,
+                                    args=(0, None, 1.0)),
+                   threading.Thread(target=worker, args=(1, "b", 2.0))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors[:1]
+        assert done == [12, 12]
+        st = svc.registry.model_stats()
+        # the budget fits one: the interleaved load MUST have paged
+        assert st["default"]["page_ins"] + st["b"]["page_ins"] > 0
+        assert st["default"]["evictions"] + st["b"]["evictions"] > 0
+        svc._recompile_guard.check()     # zero steady recompiles
+    finally:
+        svc.stop()
+
+
+def test_flush_lanes_isolation(mm_model):
+    """A stalled lane (cold model paying a slow page-in) must not
+    stall another model's flushes: lanes are independent
+    queue+thread pairs."""
+    import time as _t
+    conf = _conf(mm_model)
+    svc = InferenceService(conf, blob_names=("ip",), max_batch=2,
+                           max_wait_ms=1, queue_depth=16)
+    svc.registry.publish(_constant_params(svc.registry.net, 1.0), "A")
+    svc.add_model("b", _conf(mm_model), blob_names=("ip",))
+    svc.registry.publish(
+        _constant_params(svc.registry.net_for("b"), 2.0), "B",
+        model="b")
+    svc.start(warmup=False)
+    try:
+        orig = svc.registry.current
+
+        def slow_current(model=None):
+            if model == "b":
+                _t.sleep(1.0)           # a slow page-in on lane b
+            return orig(model)
+
+        svc.registry.current = slow_current
+        t0 = _t.monotonic()
+        pb = svc.submit(_records(1)[0], model="b")
+        pa = svc.submit(_records(1)[0])
+        row_a = pa.wait(30.0)
+        wall_a = _t.monotonic() - t0
+        assert row_a["ip"] == [1.0] * 1024
+        assert wall_a < 0.9, ("default lane stalled behind model b's "
+                              f"slow flush: {wall_a:.2f}s")
+        assert pb.wait(30.0)["ip"] == [2.0] * 1024
+    finally:
+        svc.registry.current = orig
+        svc.stop()
+
+
+def test_add_model_failure_rolls_back(mm_model):
+    """A failed publish (bad weights path) must not squat the name:
+    the corrected spec re-publishes cleanly."""
+    solver_path, model = mm_model
+    svc = InferenceService(_conf(mm_model), blob_names=("ip",),
+                           max_batch=2, max_wait_ms=1)
+    try:
+        with pytest.raises(Exception):
+            svc.add_model("b", Config(["-conf", solver_path,
+                                       "-model",
+                                       "/nope/missing.caffemodel"]),
+                          blob_names=("ip",))
+        assert not svc.has_model("b")
+        assert svc.lanes.get("b") is None
+        version = svc.add_model("b", _conf(mm_model),
+                                blob_names=("ip",))
+        assert version == 1 and svc.has_model("b")
+    finally:
+        svc.stop()
+
+
+def test_healthz_does_not_page_in(mm_model):
+    """/healthz must report residency without touching it: a health
+    poll that paged the default model in would evict whatever the
+    traffic actually uses (LRU thrash by monitoring)."""
+    conf = _conf(mm_model)
+    net_a = build_serving_net(conf.netParam)
+    net_b = build_serving_net(conf.netParam)
+    reg = ModelRegistry(net_a, weight_dtype="f32",
+                        hbm_budget_bytes=4 * 2**20)
+    reg.add_model("b", net_b)
+    reg.publish(_constant_params(net_a, 1.0), "A")
+    reg.publish(_constant_params(net_b, 2.0), "B", model="b")
+    assert reg.paged_out_models() == ["default"]
+    svc = InferenceService.__new__(InferenceService)  # handler's view
+    svc.registry = reg
+    svc._draining = False
+
+    class _Lanes:
+        def depth(self):
+            return 0
+    svc.lanes = _Lanes()
+    # the exact reads the /healthz handler performs
+    assert reg.version >= 1
+    assert reg.resident_models() == ["b"]
+    assert reg.paged_out_models() == ["default"]
+    st = reg.model_stats()
+    assert st["default"]["page_ins"] == 0, \
+        "health reads paged the default model in"
+
+
+# ------------------------------------------------------------- http
+
+def test_http_multimodel(mm_model, tmp_path):
+    """HTTP name routing: JSON `model` field and ?model= query,
+    /v1/models publish + summary, /healthz resident/paged_out, named
+    /v1/reload."""
+    solver_path, model = mm_model
+    conf = _conf(mm_model)
+    svc = InferenceService(conf, blob_names=("ip",), max_batch=4,
+                           max_wait_ms=1)
+    svc.registry.publish(_constant_params(svc.registry.net, 1.0), "A")
+    svc.start(warmup=False)
+    httpd = ServingHTTPServer(svc, port=0).start_background()
+    base = f"http://127.0.0.1:{httpd.port}"
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return r.status, json.loads(r.read())
+
+    try:
+        rec = {"id": "r", "label": 0,
+               "data": np.zeros((1, 12, 12), np.float32).tolist()}
+        # publish model "b" over HTTP (from its own solver + weights)
+        code, body = post("/v1/models", {"name": "b",
+                                         "solver": solver_path,
+                                         "model": model,
+                                         "features": "ip"})
+        assert code == 200 and body["name"] == "b"
+        svc.registry.publish(
+            _constant_params(svc.registry.net_for("b"), 2.0), "B",
+            model="b")
+        # route by JSON field
+        code, body = post("/v1/predict", {"records": [rec],
+                                          "model": "b"})
+        assert code == 200 and body["model"] == "b"
+        assert body["rows"][0]["ip"] == [2.0] * 1024
+        # route by query param
+        code, body = post("/v1/predict?model=b", {"records": [rec]})
+        assert code == 200 and body["rows"][0]["ip"] == [2.0] * 1024
+        # default stays the default
+        code, body = post("/v1/predict", {"records": [rec]})
+        assert code == 200 and body["rows"][0]["ip"] == [1.0] * 1024
+        assert "model" not in body
+        # unknown model → 404
+        code, body = post("/v1/predict", {"records": [rec],
+                                          "model": "zzz"})
+        assert code == 404
+        # summaries
+        code, body = get("/v1/models")
+        assert code == 200 and set(body["models"]) == {"default", "b"}
+        code, body = get("/healthz")
+        assert code == 200
+        assert set(body["models"]["resident"]) == {"default", "b"}
+        assert body["models"]["paged_out"] == []
+        # named reload swaps only model b
+        v_def = svc.registry.version
+        code, body = post("/v1/reload", {"model": model, "name": "b"})
+        assert code == 200 and body["name"] == "b"
+        assert svc.registry.version == v_def
+        assert svc.registry.version_of("b") == 3
+        code, body = post("/v1/reload", {"model": model,
+                                         "name": "zzz"})
+        assert code == 404
+    finally:
+        httpd.stop()
+        svc.stop()
