@@ -447,6 +447,37 @@ def serve_fleet_main(conf: Config, replicas: int) -> int:
     return 0
 
 
+def deploy_main(conf: Config) -> int:
+    """-deploy mode: the continuous-deployment loop (deploy/).  Runs
+    `-deployRounds` (or COS_DEPLOY_ROUNDS) rounds of stream-follow →
+    fine-tune → canary → fleet roll/rollback, printing one JSON line
+    per round verdict, then dumps the fleet+deploy metrics (info.deploy
+    included) to COS_SERVE_METRICS when set."""
+    from .deploy import DeployController, deploy_rounds
+    _serve_sigterm_drains()
+    ctl = DeployController(conf)
+    ctl.start()
+    try:
+        print(json.dumps({"deploying": True,
+                          "incumbent": ctl.incumbent,
+                          "replicas": ctl.replicas,
+                          "stream": ctl.source.describe()}),
+              flush=True)
+        for r in range(conf.deployRounds or deploy_rounds()):
+            rec = ctl.run_round()
+            print(json.dumps({"deploy_round": rec["round"],
+                              "verdict": rec["verdict"],
+                              "reason": rec.get("reason"),
+                              "incumbent": rec["incumbent"]}),
+                  flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ctl.stop()
+        _dump_serve_metrics(ctl.metrics_summary())
+    return 0
+
+
 def serve_main(conf: Config) -> int:
     """-serve mode: online inference over the serving subsystem.  Runs
     until interrupted; drains in-flight requests on shutdown and dumps
@@ -484,6 +515,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     conf.validate()
     if getattr(conf, "serve", False):
         return serve_main(conf)
+    if getattr(conf, "deploy", False):
+        return deploy_main(conf)
     cos = CaffeOnSpark(_cli_spark_context(conf))
 
     if conf.isTraining:

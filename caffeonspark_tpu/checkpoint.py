@@ -898,7 +898,17 @@ def snapshot(net: Net, params: Params, opt_state: OptState, prefix: str,
     non-rank-0 multi-host call — ONLY the sidecar is written (rank 0
     owns the model + solverstate).  `force_shards` routes every state
     blob through the sidecar even when fully addressable (tests the
-    multi-host format on one process)."""
+    multi-host format on one process).
+
+    Atomicity contract (the deploy canary and `pick_snapshot` depend
+    on it): every file lands via tmp + fsync + `os.replace`
+    (fsutils.atomic_write_local / write_bytes), and the write ORDER
+    makes the .solverstate the commit point — model first, then shard
+    sidecars, then state — so `find_snapshots` (which requires the
+    state/model PAIR) can never discover a pair whose model or
+    sidecars are missing or truncated.  A writer killed mid-snapshot
+    leaves at worst an orphaned `.tmp.<pid>` file and a paired-less
+    model; the previous pair stays intact and resumable."""
     it = int(jax.device_get(opt_state.iter))
     h5 = fmt == SnapshotFormat.HDF5
     remote = fsutils.is_remote(prefix)

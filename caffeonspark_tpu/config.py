@@ -101,6 +101,18 @@ def build_argparser() -> argparse.ArgumentParser:
            "(0/unset → COS_SERVE_REPLICAS, default 1 = single "
            "process; COS_AOT_CACHE_DIR shares compiled programs so "
            "replicas warm-start)")
+    # continuous deployment (deploy/ subsystem, not in the reference)
+    a("-deploy", dest="deploy", action="store_true",
+      help="canary-gated continuous deployment: follow a growing "
+           "stream directory (the TRAIN data layer, source_class "
+           "StreamingDir), fine-tune from the newest snapshot each "
+           "round, canary-gate the candidate against the incumbent "
+           "on the held-out TEST data layer, and publish accepted "
+           "rounds to the serving fleet via rolling reload with "
+           "auto-rollback (knobs COS_DEPLOY_*)")
+    a("-deployRounds", dest="deployRounds", type=int, default=0,
+      help="rounds the -deploy loop runs (0/unset → "
+           "COS_DEPLOY_ROUNDS, default 3)")
     # mesh extensions (not in the reference)
     a("-mesh", dest="mesh", default="",
       help="mesh spec dp[,tp[,sp[,ep]]] per process")
@@ -193,3 +205,16 @@ class Config:
                     or self.snapshotStateFile):
                 raise ValueError("-serve needs trained weights: "
                                  "-model, -weights, or -snapshot")
+        if getattr(self, "deploy", False):
+            if self.netParam is None:
+                raise ValueError("-deploy needs -conf (solver "
+                                 "prototxt resolving a net)")
+            if not self.outputPath:
+                raise ValueError("-deploy needs -output (snapshot "
+                                 "lineage directory)")
+            if self.train_data_layer_id < 0:
+                raise ValueError("-deploy needs a TRAIN-phase data "
+                                 "layer (the stream to follow)")
+            if not self.features:
+                raise ValueError("-deploy needs -features naming the "
+                                 "logits blob the canary gate scores")

@@ -7,4 +7,8 @@ from .sequencefile import SequenceFileReader, SequenceFileWriter
 from .source import (LMDB, DataSource, ImageDataFrame, SeqImageDataSource,
                      STOP_MARK, datum_to_record, get_source,
                      register_source)
+# StreamingDirSource (data/streaming.py) is deliberately NOT
+# re-exported here: get_source dispatches source_class "StreamingDir"
+# lazily, keeping the common sources free of the deploy machinery —
+# import caffeonspark_tpu.data.streaming directly where needed.
 from .transformer import AugDraw, Transformer, load_mean_file

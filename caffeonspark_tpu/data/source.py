@@ -550,6 +550,12 @@ def get_source(layer: LayerParameter, **kw) -> DataSource:
             or cls_name.endswith("DataFrameSource"):
         from .dataframe import DataFrameSource
         return DataFrameSource(layer, **kw)
+    if cls_name in ("StreamingDir", "com.yahoo.ml.caffe.StreamingDir"):
+        # growing part-directory stream (continuous deployment,
+        # data/streaming.py) — lazy import keeps the common sources
+        # free of the deploy machinery
+        from .streaming import StreamingDirSource
+        return StreamingDirSource(layer, **kw)
     # user-provided "module:Class" extension point
     if ":" in cls_name:
         import importlib

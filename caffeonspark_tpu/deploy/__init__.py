@@ -1,0 +1,33 @@
+"""Continuous deployment: streaming ingest → incremental fine-tune →
+canary-gated fleet rollout with auto-rollback.
+
+Closes the train→serve loop on one box (ROADMAP item 2, the
+CaffeOnSpark incremental-learning heritage made continuous):
+
+  * `data/streaming.StreamingDirSource` follows a growing part
+    directory — epoch = data seen so far, bounded re-poll with
+    backoff on flaky storage;
+  * `finetune.FineTuner` resumes each round from the newest GOOD
+    snapshot (`tools/supervisor.pick_snapshot` bad-pair fallback,
+    applied in-process) and trains K steps on the stream;
+  * `canary.CanaryGate` spins ONE warm replica on the candidate
+    snapshot (seconds via the PR 8 AOT cache), mirrors the held-out
+    eval through it, and answers accept / reject / aborted against
+    the incumbent's accuracy and p99;
+  * `controller.DeployController` runs the loop: only an accepted
+    candidate reaches the fleet (`Fleet.rolling_reload`), a rejected
+    or aborted one is reaped with the incumbent untouched, and a roll
+    that fails mid-way is rolled BACK (`Fleet.rollback`) so the fleet
+    never serves a version the gate did not bless.  Verdict history
+    and counters publish as `info.deploy` beside `info.comm` /
+    `info.sync` / `info.autotune`.
+
+Chaos drills (`make chaos-deploy`) prove the loop degrades — skips a
+round, rejects, rolls back — instead of breaking: see the
+COS_FAULT_CANARY_KILL / COS_FAULT_SNAPSHOT_TRUNCATE /
+COS_FAULT_RELOAD_FAIL_RANK knobs in `tools/chaos.py`.
+"""
+
+from .canary import CanaryGate, CanaryVerdict, decide_verdict
+from .controller import DeployController, deploy_rounds
+from .finetune import FinetuneRound, FineTuner

@@ -1,0 +1,206 @@
+"""Canary gate: one warm replica judges a candidate snapshot.
+
+The gate spins a SINGLE serving replica (the unchanged `-serve`
+stack, spawned exactly like a fleet member) on the candidate
+snapshot, mirrors the held-out eval through its HTTP surface, and
+answers one of three verdicts:
+
+  accept    the candidate matches/beats the incumbent on accuracy
+            (within COS_DEPLOY_ACC_TOL) AND on p99 (within
+            COS_DEPLOY_P99_RATIO × incumbent + COS_DEPLOY_P99_SLACK_MS)
+            — only then may the controller roll the fleet;
+  reject    the canary answered everything but the numbers regressed
+            (e.g. a fine-tune on bad data) — candidate reaped,
+            incumbent untouched;
+  aborted   the canary never became healthy (truncated/corrupt
+            snapshot refuses to load) or died mid-eval (crash, OOM,
+            or an injected COS_FAULT_CANARY_KILL) — candidate reaped,
+            incumbent untouched.  An aborted canary is a CANARY
+            failure, never a client-visible one: the live fleet keeps
+            serving throughout.
+
+With COS_AOT_CACHE_DIR shared with the fleet, the canary's warmup is
+cache hits — it serves in seconds, which is what makes gating every
+round affordable.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..serving.fleet import ReplicaProcess, _args_with_model
+from ..serving.router import TRANSPORT_ERRORS, http_json
+from ..utils.envutils import env_num
+
+_LOG = logging.getLogger(__name__)
+
+ACCEPT = "accept"
+REJECT = "reject"
+ABORTED = "aborted"
+
+# eval record: (JSON predict payload, integer label)
+EvalRecord = Tuple[dict, int]
+
+
+class CanaryVerdict(NamedTuple):
+    verdict: str                      # accept | reject | aborted
+    reason: str
+    model_path: str
+    accuracy: Optional[float]         # candidate, None when aborted
+    p99_ms: Optional[float]
+    incumbent_accuracy: Optional[float]
+    incumbent_p99_ms: Optional[float]
+    requests: int                     # eval requests the canary answered
+    warm_s: Optional[float]           # spawn → healthy wall time
+    wall_s: float
+
+    def describe(self) -> dict:
+        d = self._asdict()
+        for k in ("accuracy", "p99_ms", "incumbent_accuracy",
+                  "incumbent_p99_ms", "warm_s", "wall_s"):
+            if d[k] is not None:
+                d[k] = round(d[k], 4)
+        return d
+
+
+def decide_verdict(accuracy: float, p99_ms: Optional[float],
+                   incumbent_accuracy: Optional[float],
+                   incumbent_p99_ms: Optional[float], *,
+                   acc_tol: float, p99_ratio: float,
+                   p99_slack_ms: float) -> Tuple[str, str]:
+    """(verdict, reason) for a canary that ANSWERED the whole eval.
+    No incumbent numbers (bootstrap) = accept.  Pure — unit-testable
+    without a process tree."""
+    if incumbent_accuracy is not None \
+            and accuracy < incumbent_accuracy - acc_tol:
+        return REJECT, (f"accuracy {accuracy:.4f} < incumbent "
+                        f"{incumbent_accuracy:.4f} - tol {acc_tol}")
+    if (incumbent_p99_ms is not None and p99_ms is not None
+            and p99_ms > incumbent_p99_ms * p99_ratio + p99_slack_ms):
+        return REJECT, (f"p99 {p99_ms:.1f}ms > incumbent "
+                        f"{incumbent_p99_ms:.1f}ms x {p99_ratio} + "
+                        f"{p99_slack_ms}ms")
+    return ACCEPT, "matches/beats incumbent on accuracy and p99"
+
+
+def eval_outcome(rows_blob: Sequence[Sequence[float]],
+                 labels: Sequence[int]) -> float:
+    """Accuracy of argmax(blob) vs labels."""
+    preds = [int(np.argmax(np.asarray(r))) for r in rows_blob]
+    return float(np.mean([p == int(l) for p, l in zip(preds, labels)]))
+
+
+def _p99(lat_ms: List[float]) -> Optional[float]:
+    if not lat_ms:
+        return None
+    s = sorted(lat_ms)
+    return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+
+class CanaryGate:
+    """Builds/tears one canary replica per evaluate() call."""
+
+    def __init__(self, serve_args: List[str], blob: str, *,
+                 env: Optional[Dict[str, str]] = None,
+                 acc_tol: Optional[float] = None,
+                 p99_ratio: Optional[float] = None,
+                 p99_slack_ms: Optional[float] = None,
+                 startup_timeout_s: Optional[float] = None,
+                 request_timeout_s: float = 30.0):
+        self.serve_args = list(serve_args)
+        self.blob = blob
+        self.env = dict(env) if env else None
+        self.acc_tol = (acc_tol if acc_tol is not None
+                        else env_num("COS_DEPLOY_ACC_TOL", 0.02))
+        self.p99_ratio = (p99_ratio if p99_ratio is not None
+                          else env_num("COS_DEPLOY_P99_RATIO", 3.0))
+        self.p99_slack_ms = (
+            p99_slack_ms if p99_slack_ms is not None
+            else env_num("COS_DEPLOY_P99_SLACK_MS", 250.0))
+        self.startup_timeout_s = (
+            startup_timeout_s if startup_timeout_s is not None
+            else env_num("COS_DEPLOY_CANARY_TIMEOUT_S", 180.0))
+        self.request_timeout_s = request_timeout_s
+
+    def evaluate(self, model_path: str,
+                 eval_records: Sequence[EvalRecord],
+                 incumbent: Tuple[Optional[float], Optional[float]]
+                 = (None, None),
+                 injector=None) -> CanaryVerdict:
+        """Spin the canary on `model_path`, mirror `eval_records`
+        through it, compare against the incumbent's (accuracy, p99).
+        The replica is ALWAYS reaped before this returns — an accepted
+        candidate reaches the fleet via rolling_reload, never via the
+        canary process itself."""
+        t0 = time.monotonic()
+        inc_acc, inc_p99 = incumbent
+        args = _args_with_model(self.serve_args, model_path)
+        rep = ReplicaProcess("canary", args, env=self.env)
+        rep.spawn()
+        try:
+            if not rep.wait_ready(self.startup_timeout_s):
+                return CanaryVerdict(
+                    ABORTED, "canary never became healthy (bad "
+                    "snapshot or startup failure)", model_path,
+                    None, None, inc_acc, inc_p99, 0, None,
+                    time.monotonic() - t0)
+            warm_s = ((rep.t_ready - rep.t_spawn)
+                      if rep.t_ready and rep.t_spawn else None)
+            lat_ms: List[float] = []
+            blob_rows: List[List[float]] = []
+            labels: List[int] = []
+            sent = 0
+            for payload, label in eval_records:
+                if injector is not None \
+                        and injector.canary_kill_due(sent):
+                    rep.kill()
+                try:
+                    tq = time.monotonic()
+                    code, body = http_json(
+                        rep.url + "/v1/predict",
+                        data=json.dumps(payload).encode(),
+                        timeout=self.request_timeout_s)
+                except TRANSPORT_ERRORS + (ValueError, OSError):
+                    return CanaryVerdict(
+                        ABORTED, f"canary died mid-eval after {sent} "
+                        "requests", model_path, None, None, inc_acc,
+                        inc_p99, sent, warm_s, time.monotonic() - t0)
+                if code != 200:
+                    return CanaryVerdict(
+                        ABORTED, f"canary answered HTTP {code}: "
+                        f"{body.get('error', body)}", model_path,
+                        None, None, inc_acc, inc_p99, sent, warm_s,
+                        time.monotonic() - t0)
+                lat_ms.append((time.monotonic() - tq) * 1e3)
+                row = body["rows"][0]
+                if self.blob not in row:
+                    return CanaryVerdict(
+                        ABORTED, f"canary rows carry no blob "
+                        f"{self.blob!r} (served: {sorted(row)})",
+                        model_path, None, None, inc_acc, inc_p99,
+                        sent, warm_s, time.monotonic() - t0)
+                blob_rows.append(row[self.blob])
+                labels.append(int(label))
+                sent += 1
+            acc = eval_outcome(blob_rows, labels)
+            p99 = _p99(lat_ms)
+            verdict, reason = decide_verdict(
+                acc, p99, inc_acc, inc_p99, acc_tol=self.acc_tol,
+                p99_ratio=self.p99_ratio,
+                p99_slack_ms=self.p99_slack_ms)
+            return CanaryVerdict(verdict, reason, model_path, acc,
+                                 p99, inc_acc, inc_p99, sent, warm_s,
+                                 time.monotonic() - t0)
+        finally:
+            # reap unconditionally: the canary process must never
+            # outlive its verdict (accepted weights reach the fleet
+            # through rolling_reload, not through this replica)
+            try:
+                rep.kill()
+            except Exception:   # noqa: BLE001 — already-dead is fine
+                pass

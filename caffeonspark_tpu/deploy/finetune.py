@@ -1,0 +1,183 @@
+"""Incremental fine-tune rounds for the continuous-deployment loop.
+
+Each round resumes from the NEWEST snapshot pair in the output
+directory that is not known-bad (`tools/supervisor.pick_snapshot`'s
+fallback, applied in-process: a pair that fails to restore — e.g. a
+truncated object on flaky storage, or an injected
+COS_FAULT_SNAPSHOT_TRUNCATE — is marked bad on the spot and the
+previous pair is tried, so one corrupt snapshot can never wedge the
+loop), trains K steps on the stream's data-seen-so-far, and writes a
+new candidate snapshot pair for the canary gate to judge.
+
+The Solver (and its jitted step) is built ONCE and reused across
+rounds — a resume only replaces the params/opt-state pytrees, so no
+round pays a recompile.  Rejected candidates are handed back via
+`mark_bad()` so the next round resumes from the incumbent lineage
+instead of compounding a regression.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Iterator, NamedTuple, Optional
+
+import numpy as np
+
+from .. import checkpoint
+from ..data.source import DataSource
+from ..solver import Solver
+from ..tools.supervisor import find_snapshots, pick_snapshot
+from ..utils.envutils import env_int
+
+_LOG = logging.getLogger(__name__)
+
+
+class FinetuneRound(NamedTuple):
+    """One fine-tune round's facts (embedded in verdict history and
+    the bench artifact)."""
+    start_iter: int
+    end_iter: int
+    model_path: str
+    state_path: str
+    resumed_from: Optional[str]     # state path, None = from scratch
+    skipped_pairs: int              # bad pairs fallen past this round
+    mean_loss: float
+    label_shuffled: bool
+    truncated: bool                 # COS_FAULT_SNAPSHOT_TRUNCATE fired
+    wall_s: float
+
+
+class FineTuner:
+    """Round-based incremental training over a (streaming) source."""
+
+    def __init__(self, conf, source: DataSource, outdir: str, *,
+                 steps: Optional[int] = None):
+        if conf.solverParameter is None or conf.netParam is None:
+            raise ValueError("fine-tune needs -conf resolving a "
+                             "solver + net prototxt")
+        self.conf = conf
+        self.source = source
+        self.outdir = outdir
+        self.prefix = conf.solverParameter.snapshot_prefix or "model"
+        self.steps = steps or env_int("COS_DEPLOY_STEPS", 20)
+        self.solver = Solver(conf.solverParameter, conf.netParam)
+        self.bad: set = set()          # state paths proven bad
+        # monotonic iteration floor: a round that resumes from an
+        # OLDER pair (because the newest was rejected/corrupt) fast-
+        # forwards its clock past every iteration already written —
+        # the syncmode re-admission idiom — so no round ever re-writes
+        # an existing `<prefix>_iter_N` pair (which would overwrite
+        # the published incumbent's file on disk with an unjudged
+        # candidate and wedge the iteration counter).  Seeded from the
+        # newest pair ON DISK so a restarted controller cannot
+        # overwrite either.
+        import re
+        self._iter_floor = 0
+        for state_path, _ in find_snapshots(outdir, self.prefix):
+            m = re.search(r"_iter_(\d+)\.solverstate",
+                          os.path.basename(state_path))
+            if m:
+                self._iter_floor = max(self._iter_floor,
+                                       int(m.group(1)))
+        self._batch_gen: Optional[Iterator] = None
+
+    # -- snapshot lineage ---------------------------------------------
+    def mark_bad(self, state_path: str) -> None:
+        """A rejected/aborted candidate must not seed the next round —
+        the same fallback set pick_snapshot consults for corrupt
+        pairs."""
+        self.bad.add(state_path)
+
+    def _resume(self):
+        """(params, opt_state, resumed_from, skipped): newest restorable
+        non-bad pair wins; a pair that fails to load is marked bad and
+        the previous one is tried (pick_snapshot fallback, in-process)."""
+        params, opt = self.solver.init()
+        skipped = 0
+        while True:
+            pair = pick_snapshot(self.outdir, self.prefix,
+                                 frozenset(self.bad))
+            if pair is None:
+                return params, opt, None, skipped
+            state_path, model_path = pair
+            try:
+                p, o = checkpoint.restore(self.solver.train_net,
+                                          params, opt, state_path,
+                                          weights_path=model_path)
+                return p, o, state_path, skipped
+            except Exception as e:   # noqa: BLE001 — corrupt pair
+                _LOG.warning("fine-tune: snapshot %s failed to "
+                             "restore (%s) — marking bad, falling "
+                             "back", state_path, e)
+                self.bad.add(state_path)
+                skipped += 1
+
+    # -- data ---------------------------------------------------------
+    def _next_batch(self) -> dict:
+        """Next packed batch off the shared `DataSource.batches` loop
+        (endless per-epoch-reshuffled passes, tail buffer carried
+        across passes; epoch = data seen so far, so each pass covers
+        whatever the latest poll absorbed).  The generator ONLY ends
+        when the stream is empty at a pass start — surface that as
+        the actionable error and drop the generator so a later round
+        (after data arrived) rebuilds it."""
+        if self._batch_gen is None:
+            self._batch_gen = self.source.batches(loop=True,
+                                                  shuffle=True)
+        try:
+            return next(self._batch_gen)
+        except (StopIteration, ValueError):
+            self._batch_gen = None
+            raise ValueError(
+                "fine-tune: stream has no records yet") from None
+
+    # -- the round ----------------------------------------------------
+    def round(self, *, label_shuffle: bool = False,
+              steps: Optional[int] = None,
+              injector=None) -> FinetuneRound:
+        """Resume → K steps → snapshot.  `label_shuffle` is the
+        injected-regression lever (bench/drills): the candidate trains
+        on permuted labels, so the canary gate MUST reject it.
+        `injector` applies post-write faults (snapshot truncation)."""
+        t0 = time.monotonic()
+        k = steps or self.steps
+        params, opt, resumed, skipped = self._resume()
+        start_iter = int(np.asarray(opt.iter))
+        if start_iter < self._iter_floor:
+            # resumed from an older pair: jump to the global clock so
+            # this round's snapshot lands on a FRESH iter path (the LR
+            # schedule follows the clock, like a syncmode re-admit)
+            import jax.numpy as jnp
+            start_iter = self._iter_floor
+            opt = opt._replace(iter=jnp.asarray(start_iter, jnp.int32))
+        step = self.solver.jit_train_step()
+        rng_shuf = np.random.RandomState(1000 + start_iter)
+        losses = []
+        for i in range(k):
+            inputs = self._next_batch()
+            if label_shuffle and "label" in inputs:
+                inputs = dict(inputs)
+                inputs["label"] = rng_shuf.permutation(
+                    np.asarray(inputs["label"]))
+            rng = self.solver.step_rng(start_iter + i)
+            params, opt, outputs = step(params, opt, inputs, rng)
+            if "loss" in outputs:
+                losses.append(float(np.asarray(outputs["loss"])))
+        end_iter = start_iter + k
+        self._iter_floor = end_iter
+        model_path, state_path = checkpoint.snapshot(
+            self.solver.train_net, params, opt,
+            os.path.join(self.outdir, self.prefix),
+            solver_type=self.solver.solver_type)
+        truncated = bool(injector is not None
+                         and injector.truncate_snapshot(model_path,
+                                                        state_path))
+        return FinetuneRound(
+            start_iter=start_iter, end_iter=end_iter,
+            model_path=model_path, state_path=state_path,
+            resumed_from=resumed, skipped_pairs=skipped,
+            mean_loss=(float(np.mean(losses)) if losses else float("nan")),
+            label_shuffled=label_shuffle, truncated=truncated,
+            wall_s=time.monotonic() - t0)

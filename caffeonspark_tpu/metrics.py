@@ -44,7 +44,10 @@ and bench artifact is self-describing), and the sync-mode policy +
 final exchange counts as `info.sync` (COS_SYNC_MODE, K/staleness,
 exchanges / skipped / adopted / timeouts / max_gap).  The relaxed
 sync modes also record a `sync_exchange` stage series (host-side
-round-average / global-merge wall time).
+round-average / global-merge wall time).  The continuous-deployment
+controller publishes `info.deploy` the same way (incumbent, verdict
+history, per-state counts, knobs) plus a `deploy_round` wall series
+and `deploy_<verdict>` counters.
 
 Stages are NOT disjoint when staging (and, on the inline path, packing)
 runs synchronously inside next(gen): there queue_wait SUBSUMES the pack

@@ -43,7 +43,7 @@ from ..tools.supervisor import terminate_processes
 from .batcher import _env_int
 from .retry import RetryPolicy
 from .router import (DOWN, OK, STARTING, TRANSPORT_ERRORS, Router,
-                     http_json)
+                     RouterRequestError, http_json)
 
 _LOG = logging.getLogger(__name__)
 
@@ -69,6 +69,23 @@ def _args_with_model(args: List[str], model_path: str) -> List[str]:
     return out + ["-model", model_path]
 
 
+def _model_from_args(args: List[str]) -> Optional[str]:
+    """The default-model weights source named by serve args (`-model`
+    wins, then `-weights`, then `-snapshot` — a .solverstate is a
+    valid reload target too, its learned_net pointer resolves the
+    model) — the fleet's initial 'incumbent' for pre-roll
+    bookkeeping.  Every validly-launched serve fleet names one of the
+    three, so the abandoned-roll repoint and rollback() always have a
+    lineage to return to."""
+    found: Dict[str, str] = {}
+    for i, a in enumerate(args):
+        if a in ("-model", "-weights", "-snapshot") \
+                and i + 1 < len(args):
+            found[a] = args[i + 1]
+    return (found.get("-model") or found.get("-weights")
+            or found.get("-snapshot"))
+
+
 class ReplicaProcess:
     """One `-serve` subprocess: spawn, discover the ephemeral port
     from the startup JSON line, wait until /healthz answers."""
@@ -86,6 +103,10 @@ class ReplicaProcess:
         self.t_spawn: Optional[float] = None
         self.t_ready: Optional[float] = None
         self.restart_count = 0      # lifetime restarts of THIS replica
+        # what the RUNNING process actually booted with (captured at
+        # spawn — serve_args may be repointed after the fork, e.g. by
+        # an abandoned roll's verdict repoint racing a respawn)
+        self.booted_model: Optional[str] = None
 
     @property
     def url(self) -> str:
@@ -114,6 +135,7 @@ class ReplicaProcess:
         self.port = None
         self.t_spawn = time.monotonic()
         self.t_ready = None
+        self.booted_model = _model_from_args(self.serve_args)
         self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                      env=env, text=True)
         threading.Thread(target=self._read_stdout,
@@ -211,6 +233,15 @@ class Fleet:
         # model re-published by the monitor before it rejoins
         self._published_models: Dict[str, dict] = {}
         self._published_lock = threading.Lock()
+        # default-model lineage for rolling reloads: the LAST model the
+        # fleet committed to (argv at start; advanced only when a roll
+        # COMPLETES).  A roll that fails mid-way leaves this at the
+        # incumbent — rollback() re-rolls survivors to it, and respawn
+        # args follow the roll's final verdict, not its high-water mark
+        self._default_model: Optional[str] = _model_from_args(
+            self.serve_args)
+        self.pre_roll_model: Optional[str] = None
+        self._roll_active = False
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> "Fleet":
@@ -302,6 +333,13 @@ class Fleet:
                 # replica rejoins rotation, or name-routed requests
                 # would 404 on it until an operator noticed
                 self._republish_models(rep)
+                # heal a respawn that BOOTED on a model the fleet has
+                # since moved away from — e.g. it was spawned with an
+                # abandoned roll's candidate argv in the instant
+                # before the abandonment repoint landed.  Outside a
+                # live roll the committed default is the only version
+                # a rejoining replica may serve.
+                self._heal_respawn_model(rep)
                 # new ephemeral port: point the router at it
                 # BEFORE reopening routing
                 self.router.update_url(name, rep.url)
@@ -311,6 +349,35 @@ class Fleet:
             else:
                 _LOG.error("fleet: restarted %s failed to become "
                            "healthy", name)
+
+    def _heal_respawn_model(self, rep: ReplicaProcess) -> None:
+        """Reload a freshly-respawned replica onto the fleet's
+        committed default model when what it BOOTED with differs —
+        before it rejoins rotation.  No-op during a live roll (the
+        per-replica repoint semantics govern there) and when the
+        lineage is unknown."""
+        desired = self._default_model
+        if (self._roll_active or desired is None
+                or rep.booted_model == desired):
+            return
+        try:
+            code, body = http_json(
+                rep.url + "/v1/reload",
+                data=json.dumps({"model": desired}).encode(),
+                timeout=120.0)
+            if code != 200:
+                _LOG.error("fleet: healing respawned %s onto %s "
+                           "failed: %s", rep.name, desired, body)
+                return
+            _LOG.warning("fleet: respawned %s booted on %s — "
+                         "reloaded onto the committed default %s "
+                         "before rejoining", rep.name,
+                         rep.booted_model, desired)
+            rep.booted_model = desired
+            rep.serve_args = _args_with_model(rep.serve_args, desired)
+        except TRANSPORT_ERRORS + (OSError, ValueError) as e:
+            _LOG.error("fleet: healing respawned %s onto %s "
+                       "failed: %s", rep.name, desired, e)
 
     def _republish_models(self, rep: ReplicaProcess) -> None:
         with self._published_lock:
@@ -331,17 +398,30 @@ class Fleet:
 
     # -- operations ---------------------------------------------------
     def rolling_reload(self, model_path: str,
-                       model_name: Optional[str] = None
+                       model_name: Optional[str] = None,
+                       before_reload=None
                        ) -> Dict[str, int]:
+        """Fleet-wide rolling swap.  Records the pre-roll default
+        model (`pre_roll_model`) so an abandoned roll can be undone
+        with `rollback()`.  Respawn args follow the roll's FINAL
+        verdict: while the roll is live, a replica that dies after
+        its own swap rejoins on the new version (repoint fires per
+        replica), but if the roll fails mid-way the already-swapped
+        replicas' respawn args are pointed BACK at the incumbent —
+        the abandoned version must never be reintroduced by a
+        restart-on-death respawn."""
         # serve_args repoint PER replica as each one's reload lands:
         # a replica that dies mid-roll after ITS swap must rejoin on
         # the NEW version (fresh list assignment — the monitor reads
         # serve_args only at spawn).  A NAMED model's reload instead
         # updates the remembered publish spec (argv only carries the
         # default model).
+        swapped: List[str] = []
+
         def repoint(name: str) -> None:
             if model_name is not None:
                 return
+            swapped.append(name)
             rep = self.replicas.get(name)
             if rep is not None:
                 rep.serve_args = _args_with_model(rep.serve_args,
@@ -351,9 +431,93 @@ class Fleet:
                 spec = self._published_models.get(model_name)
                 if spec is not None:
                     spec["model"] = model_path
-        return self.router.rolling_reload(model_path,
-                                          on_reloaded=repoint,
-                                          model_name=model_name)
+        else:
+            self.pre_roll_model = self._default_model
+        self._roll_active = True
+        try:
+            out = self.router.rolling_reload(
+                model_path, on_reloaded=repoint,
+                model_name=model_name, before_reload=before_reload)
+        except BaseException:
+            if model_name is None:
+                # roll abandoned: the verdict is the INCUMBENT.  Any
+                # replica already repointed at the new model (swapped,
+                # or swapped-then-died) must respawn on the incumbent;
+                # rollback() re-rolls the live survivors.
+                old = self.pre_roll_model
+                if old is not None:
+                    for name in swapped:
+                        rep = self.replicas.get(name)
+                        if rep is not None:
+                            rep.serve_args = _args_with_model(
+                                rep.serve_args, old)
+            self._roll_active = False
+            raise
+        self._roll_active = False
+        if model_name is None:
+            self._default_model = model_path
+        return out
+
+    def rollback(self, wait_idle_s: float = 60.0) -> Dict[str, int]:
+        """Re-roll every live replica back to the pre-roll default
+        model (the incumbent a failed rolling_reload left recorded).
+        Dead/unreachable replicas are skipped — their respawn args
+        already point at the incumbent, so the monitor brings them
+        back on the right version.  Returns {replica: version} for
+        the replicas actually re-rolled."""
+        target = self._default_model
+        if target is None:
+            raise RuntimeError(
+                "rollback: no recorded default model (fleet launched "
+                "without -model/-weights and never rolled)")
+        versions: Dict[str, int] = {}
+        fail_kinds = TRANSPORT_ERRORS + (RouterRequestError,
+                                         TimeoutError, OSError,
+                                         ValueError)
+        for name in self.router.names():
+            rep = self.replicas.get(name)
+            if rep is not None:
+                rep.serve_args = _args_with_model(rep.serve_args,
+                                                  target)
+            try:
+                self.router.drain_replica(name,
+                                          wait_idle_s=wait_idle_s)
+            except fail_kinds as e:
+                # unreachable for the drain: if it is dead, the
+                # monitor respawns it on `target` (argv above, plus
+                # the respawn heal); if it is alive-but-wedged the
+                # health poller re-admits it once it answers — and
+                # the heal path cannot cover that, so say so loudly
+                _LOG.error("fleet rollback: %s unreachable for "
+                           "drain (%s) — skipped; a dead replica "
+                           "respawns on the incumbent, a wedged "
+                           "live one needs operator attention",
+                           name, e)
+                continue
+            try:
+                code, body = http_json(
+                    self.router.replica_url(name) + "/v1/reload",
+                    data=json.dumps({"model": target}).encode(),
+                    timeout=120.0)
+                if code != 200:
+                    _LOG.error("fleet rollback: replica %s refused "
+                               "the reload: %s — leaving it DRAINED "
+                               "(serves nothing) rather than "
+                               "re-admitting the abandoned version",
+                               name, body)
+                    continue
+                self.router.undrain_replica(name)
+                versions[name] = body.get("model_version", -1)
+            except fail_kinds as e:
+                # drained but the reload/undrain failed: keep it
+                # DRAINED — capacity loss an operator can see beats
+                # silently serving the abandoned version
+                _LOG.error("fleet rollback: %s drained but its "
+                           "reload failed (%s) — left drained",
+                           name, e)
+                continue
+        self.metrics.incr("rollbacks")
+        return versions
 
     def publish_model(self, spec: dict) -> Dict[str, dict]:
         """Publish a named model fleet-wide: POST the /v1/models spec

@@ -444,7 +444,8 @@ class Router:
     def rolling_reload(self, model_path: str,
                        wait_idle_s: float = 60.0,
                        on_reloaded=None,
-                       model_name: Optional[str] = None
+                       model_name: Optional[str] = None,
+                       before_reload=None
                        ) -> Dict[str, int]:
         """Publish `model_path` fleet-wide, one replica at a time:
         drain → wait idle → reload → back in rotation.  At every
@@ -455,13 +456,18 @@ class Router:
         swap — the fleet uses it to repoint that replica's respawn
         args mid-roll, not only at the end.  `model_name` targets a
         NAMED model on every replica (multi-model serving); None =
-        each replica's default model, the pre-plural behavior."""
+        each replica's default model, the pre-plural behavior.
+        `before_reload(name, index)` fires after a replica drained but
+        before its swap — the deploy chaos layer injects mid-roll
+        failures there (COS_FAULT_RELOAD_FAIL_RANK)."""
         versions: Dict[str, int] = {}
         body_req: Dict[str, str] = {"model": model_path}
         if model_name is not None:
             body_req["name"] = model_name
-        for name in self.names():
+        for idx, name in enumerate(self.names()):
             self.drain_replica(name, wait_idle_s=wait_idle_s)
+            if before_reload is not None:
+                before_reload(name, idx)
             url = self.replica_url(name)
             code, body = http_json(
                 url + "/v1/reload",

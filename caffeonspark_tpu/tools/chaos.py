@@ -45,6 +45,33 @@ Knobs (all default off; see docs/tuning.md for the full table):
   COS_FAULT_COMM_LAT_US        floor for the gradsync bench — see
   COS_FAULT_COMM_LOCAL         `GradSyncPlan.exposed_wire_bytes` and
   COS_FAULT_COMM_HIDE_BYTES    scripts/bench_gradsync.py
+
+Serving/deploy faults (the continuous-deployment drills,
+caffeonspark_tpu/deploy/ — all one-shot via a marker file, the
+COS_FAULT_DIE_ONCE idiom, so a drill injects exactly one fault and a
+relaunch does not re-fire it):
+
+  COS_FAULT_CANARY_KILL        "n:marker" — SIGKILL the canary replica
+                               after n mirrored eval requests, once
+                               (the canary gate must answer `aborted`
+                               and the incumbent fleet must not see a
+                               single failed client request)
+  COS_FAULT_SNAPSHOT_TRUNCATE  "marker" — truncate the NEXT snapshot
+                               pair right after it lands, once
+                               (simulates a corrupt object on flaky
+                               storage: the canary must refuse it and
+                               the fine-tune resume must mark the pair
+                               bad and fall back, pick_snapshot style)
+  COS_FAULT_RELOAD_FAIL_RANK   "k:marker" — kill the k-th replica of a
+                               rolling reload right before ITS swap,
+                               once (the roll must abort and the fleet
+                               must roll survivors BACK to the
+                               incumbent — deploy auto-rollback)
+
+The deploy stream tail reuses COS_FAULT_FLAKY_STORAGE: the streaming
+source's directory re-poll (data/streaming.py) absorbs injected
+OSErrors with bounded re-poll + backoff, the same retry posture as
+the sync-mode ParamStore.
 """
 
 from __future__ import annotations
@@ -89,12 +116,18 @@ class FaultPlan(NamedTuple):
     flaky_storage: float
     seed: int
     comm: CommFloor
+    # serving/deploy faults (all one-shot via their marker file)
+    canary_kill: Optional[Tuple[int, str]] = None    # (n_reqs, marker)
+    snapshot_truncate: Optional[str] = None          # marker
+    reload_fail_rank: Optional[Tuple[int, str]] = None  # (k, marker)
 
     @property
     def active(self) -> bool:
         return bool(self.step_delay_s or self.die_once
                     or self.slow_rank or self.flaky_exchange
-                    or self.flaky_storage or self.comm.active)
+                    or self.flaky_storage or self.comm.active
+                    or self.canary_kill or self.snapshot_truncate
+                    or self.reload_fail_rank)
 
     @property
     def slow_factor(self) -> float:
@@ -126,6 +159,12 @@ class FaultPlan(NamedTuple):
                 "local": self.comm.local,
                 "hide_bytes": self.comm.hide_bytes,
             }
+        if self.canary_kill:
+            out["canary_kill"] = {"after_requests": self.canary_kill[0]}
+        if self.snapshot_truncate:
+            out["snapshot_truncate"] = True
+        if self.reload_fail_rank:
+            out["reload_fail_rank"] = self.reload_fail_rank[0]
         return out
 
 
@@ -152,6 +191,18 @@ def resolve(rank: int = 0) -> FaultPlan:
             raise ValueError(
                 f"COS_FAULT_SLOW_RANK factor {factor}: must be >= 1")
         slow_rank = (int(r_), factor)
+    def _count_marker(name: str) -> Optional[Tuple[int, str]]:
+        """Parse an "n:marker" one-shot knob (count, marker path)."""
+        v = os.environ.get(name, "")
+        if not v:
+            return None
+        n_, marker = v.split(":", 1)
+        n = int(n_)
+        if n < 0 or not marker:
+            raise ValueError(f"{name}={v!r}: expected 'n:marker' with "
+                             "n >= 0 and a marker path")
+        return (n, marker)
+
     hide = os.environ.get("COS_FAULT_COMM_HIDE_BYTES", "")
     comm = CommFloor(
         ns_per_byte=_env_float("COS_FAULT_COMM_NS_PER_BYTE", 0.0),
@@ -166,7 +217,11 @@ def resolve(rank: int = 0) -> FaultPlan:
         flaky_exchange=_parse_prob("COS_FAULT_FLAKY_EXCHANGE"),
         flaky_storage=_parse_prob("COS_FAULT_FLAKY_STORAGE"),
         seed=int(_env_float("COS_FAULT_SEED", 1000 + rank)),
-        comm=comm)
+        comm=comm,
+        canary_kill=_count_marker("COS_FAULT_CANARY_KILL"),
+        snapshot_truncate=(
+            os.environ.get("COS_FAULT_SNAPSHOT_TRUNCATE", "") or None),
+        reload_fail_rank=_count_marker("COS_FAULT_RELOAD_FAIL_RANK"))
 
 
 class ChaosInjector:
@@ -178,7 +233,18 @@ class ChaosInjector:
     def __init__(self, plan: FaultPlan):
         self.plan = plan
         self._rng = random.Random(plan.seed)
-        self.injected = {"exchange_faults": 0, "storage_faults": 0}
+        self.injected = {"exchange_faults": 0, "storage_faults": 0,
+                         "canary_kills": 0, "snapshot_truncations": 0,
+                         "reload_failures": 0}
+
+    @staticmethod
+    def _fire_once(marker: str) -> bool:
+        """One-shot latch: True exactly once per marker file (the
+        DIE_ONCE idiom — a relaunch or a later round never re-fires)."""
+        if os.path.exists(marker):
+            return False
+        open(marker, "w").close()
+        return True
 
     # -- step-loop injectors -------------------------------------------
     def step_delay(self) -> None:
@@ -224,12 +290,63 @@ class ChaosInjector:
 
     def storage_fault(self) -> None:
         """Raise OSError with probability flaky_storage (called inside
-        ParamStore I/O; the store's retry loop absorbs it)."""
+        ParamStore I/O and the streaming-directory re-poll; the
+        caller's retry loop absorbs it)."""
         if (self.plan.flaky_storage
                 and self._rng.random() < self.plan.flaky_storage):
             self.injected["storage_faults"] += 1
             raise OSError("injected flaky-storage fault "
                           "(COS_FAULT_FLAKY_STORAGE)")
+
+    # -- deploy injectors ----------------------------------------------
+    def canary_kill_due(self, requests_sent: int) -> bool:
+        """COS_FAULT_CANARY_KILL: True (once) when the canary has
+        answered `n` mirrored eval requests — the gate SIGKILLs its
+        replica and must turn the resulting transport failure into an
+        `aborted` verdict, never into a fleet-visible error."""
+        ck = self.plan.canary_kill
+        if ck is None or requests_sent < ck[0]:
+            return False
+        if self._fire_once(ck[1]):
+            self.injected["canary_kills"] += 1
+            print(f"FAULT INJECTION: killing canary after "
+                  f"{requests_sent} eval requests", flush=True)
+            return True
+        return False
+
+    def truncate_snapshot(self, *paths: str) -> bool:
+        """COS_FAULT_SNAPSHOT_TRUNCATE: truncate each of `paths` (a
+        just-written model/state pair) to a third of its size, once —
+        the corrupt-object-on-flaky-storage drill.  Returns True when
+        the fault fired (callers record it in the round info)."""
+        marker = self.plan.snapshot_truncate
+        if not marker or not self._fire_once(marker):
+            return False
+        self.injected["snapshot_truncations"] += 1
+        for p in paths:
+            if not os.path.exists(p):
+                continue
+            size = os.path.getsize(p)
+            with open(p, "r+b") as f:
+                f.truncate(max(1, size // 3))
+            print(f"FAULT INJECTION: truncated snapshot {p} "
+                  f"({size} -> {max(1, size // 3)} bytes)", flush=True)
+        return True
+
+    def reload_fail_due(self, replica_index: int) -> bool:
+        """COS_FAULT_RELOAD_FAIL_RANK: True (once) when a rolling
+        reload reaches replica `k` — the fleet kills that replica just
+        before its swap, so the roll aborts mid-way and auto-rollback
+        must re-roll the already-swapped survivors."""
+        rf = self.plan.reload_fail_rank
+        if rf is None or replica_index != rf[0]:
+            return False
+        if self._fire_once(rf[1]):
+            self.injected["reload_failures"] += 1
+            print(f"FAULT INJECTION: failing rolling reload at "
+                  f"replica index {replica_index}", flush=True)
+            return True
+        return False
 
 
 def make_injector(rank: int = 0) -> ChaosInjector:

@@ -137,13 +137,35 @@ def write_bytes(path: str, data: bytes) -> None:
 
 def atomic_write_local(path: str, write_fn) -> None:
     """Run write_fn(tmp_path) then os.replace into place — readers (and
-    the elastic-recovery supervisor) only ever see complete files."""
+    the elastic-recovery supervisor, and the deploy canary loading a
+    just-written candidate snapshot) only ever see complete files.
+
+    Crash posture: a writer killed mid-write leaves only the orphaned
+    `.tmp.<pid>` file — the target keeps its previous complete content
+    (every snapshot-discovery pattern excludes `.tmp.`).  The tmp is
+    fsynced BEFORE the rename so a host crash cannot reorder the
+    rename ahead of the data and expose a zero-length "complete" file;
+    the directory entry is fsynced after, so the rename itself is
+    durable (tests/test_checkpoint.py kill-mid-save drill)."""
     path = os.path.abspath(path)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         write_fn(tmp)
+        fd = os.open(tmp, os.O_RDWR)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
         os.replace(tmp, path)
+        try:
+            dfd = os.open(os.path.dirname(path), os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass     # platforms without directory fsync
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)

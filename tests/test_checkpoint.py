@@ -558,3 +558,114 @@ random_seed: 7
         cwd=REPO)
     assert r2.returncode == 0, r2.stderr[-2000:]
     assert "resumed from iter 10" in r2.stdout
+
+
+# ---------------------------------------------------------------------------
+# atomic snapshot writes (deploy canary / pick_snapshot safety)
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_local_crash_keeps_previous(tmp_path):
+    """A write that dies mid-tmp leaves the previous complete file in
+    place and no target mutation — the local-snapshot atomicity the
+    canary and pick_snapshot lean on."""
+    from caffeonspark_tpu.utils import fsutils
+    target = tmp_path / "m.caffemodel"
+    fsutils.write_bytes(str(target), b"v1" * 100)
+
+    def crash_mid_write(tmp):
+        with open(tmp, "wb") as f:
+            f.write(b"v2")                # partial
+        raise KeyboardInterrupt("writer died mid-save")
+
+    with pytest.raises(KeyboardInterrupt):
+        fsutils.atomic_write_local(str(target), crash_mid_write)
+    assert target.read_bytes() == b"v1" * 100
+    # the failed tmp is cleaned up, and snapshot discovery would have
+    # ignored it anyway (`.tmp.` never matches the pair patterns)
+    assert [p.name for p in tmp_path.iterdir()] == ["m.caffemodel"]
+
+
+_KILL_WRITER = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax.numpy as jnp
+from caffeonspark_tpu import checkpoint
+from caffeonspark_tpu.proto import NetParameter, SolverParameter
+from caffeonspark_tpu.solver import Solver
+
+# a deliberately fat ip blob so each snapshot write has a real kill
+# window (~8 MB model + same-size momentum state)
+net = NetParameter.from_text({net!r}.replace(
+    "num_output: 10", "num_output: 4096", 1))
+s = Solver(SolverParameter.from_text({solver!r}), net)
+params, st = s.init()
+out = {out!r}
+print("WRITER READY", flush=True)
+for i in range(200):
+    st = st._replace(iter=jnp.asarray(i + 1, jnp.int32))
+    checkpoint.snapshot(s.train_net, params, st, out + "/model")
+    print("WROTE", i + 1, flush=True)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_snapshot_kill_mid_save_previous_pair_survives(tmp_path):
+    """SIGKILL a snapshot writer while a pair write is in flight: no
+    discovered pair may ever be truncated — pick_snapshot's newest
+    pair must restore cleanly (the deploy fine-tune/canary contract).
+    The kill is aimed at the tmp-file window (the only window that
+    exists now that every file lands via tmp+rename)."""
+    import re
+    import signal
+    import time
+    from caffeonspark_tpu.tools.supervisor import (find_snapshots,
+                                                   pick_snapshot)
+    out = tmp_path / "snaps"
+    out.mkdir()
+    script = tmp_path / "writer.py"
+    script.write_text(_KILL_WRITER.format(
+        repo=REPO, net=NET, solver=SOLVER, out=str(out)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+           "PALLAS_AXON_POOL_IPS": ""}
+    p = subprocess.Popen([sys.executable, str(script)],
+                         stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        # wait until at least one complete pair landed, then kill the
+        # instant a NEW in-flight tmp file appears (mid-write window)
+        deadline = time.time() + 240
+        killed = False
+        while time.time() < deadline and p.poll() is None:
+            names = os.listdir(out)
+            pairs = find_snapshots(str(out), "model")
+            tmps = [n for n in names if ".tmp." in n]
+            if len(pairs) >= 1 and tmps:
+                p.send_signal(signal.SIGKILL)
+                killed = True
+                break
+            time.sleep(0.001)
+        assert killed, "never caught an in-flight tmp write"
+        p.wait(timeout=30)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    pairs = find_snapshots(str(out), "model")
+    assert pairs, "no complete pair survived"
+    # every DISCOVERED pair parses and restores end to end — a
+    # truncated file may exist only under a .tmp. name
+    s = Solver(SolverParameter.from_text(SOLVER),
+               NetParameter.from_text(NET.replace(
+                   "num_output: 10", "num_output: 4096", 1)))
+    params, st = s.init()
+    for state_path, model_path in pairs:
+        checkpoint.load_caffemodel_blobs(model_path)
+        checkpoint.restore(s.train_net, params, st, state_path,
+                           weights_path=model_path)
+    picked = pick_snapshot(str(out), "model")
+    assert picked == pairs[0]
+    leftovers = [n for n in os.listdir(out) if ".tmp." in n]
+    # the killed write's orphan tmp (if any) is invisible to discovery
+    assert all(not re.match(r"model_iter_\d+\.(caffemodel|solverstate)$",
+                            n) for n in leftovers)

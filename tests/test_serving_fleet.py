@@ -893,3 +893,174 @@ def test_fleet_rolling_hot_swap_old_xor_new_fleet_wide(
             assert rep.serve_args[i + 1] == model_b
     finally:
         fleet.stop()
+
+
+# ------------------------------------------- rollback / roll verdict
+
+class _FakeRollRouter:
+    """Router stub for roll-verdict bookkeeping tests: replays a
+    rolling reload that optionally fails at replica index
+    `fail_at` (after the earlier replicas already swapped)."""
+
+    def __init__(self, names, fail_at=None):
+        self._names = list(names)
+        self.fail_at = fail_at
+        self.reloads = []
+        self.drained = []
+        self.undrained = []
+
+    def names(self):
+        return list(self._names)
+
+    def replica_url(self, name):
+        return f"http://fake/{name}"
+
+    def drain_replica(self, name, wait_idle_s=60.0):
+        self.drained.append(name)
+
+    def undrain_replica(self, name):
+        self.undrained.append(name)
+
+    def rolling_reload(self, model_path, on_reloaded=None,
+                       model_name=None, before_reload=None):
+        out = {}
+        for i, n in enumerate(self._names):
+            if before_reload is not None:
+                before_reload(n, i)
+            if self.fail_at is not None and i == self.fail_at:
+                raise ConnectionRefusedError(
+                    "injected mid-roll failure")
+            if on_reloaded is not None:
+                on_reloaded(n)
+            out[n] = i + 1
+        return out
+
+
+def _stub_fleet(n, fail_at=None, model="/snap/v1.caffemodel"):
+    from caffeonspark_tpu.serving.fleet import Fleet, ReplicaProcess
+    fleet = Fleet(["-conf", "s.prototxt", "-model", model],
+                  replicas=n)
+    fleet.router = _FakeRollRouter(
+        [f"replica{i}" for i in range(n)], fail_at=fail_at)
+    for i in range(n):
+        fleet.replicas[f"replica{i}"] = ReplicaProcess(
+            f"replica{i}", list(fleet.serve_args))
+    return fleet
+
+
+def _model_arg(rep):
+    i = rep.serve_args.index("-model")
+    return rep.serve_args[i + 1]
+
+
+def test_fleet_model_from_args():
+    from caffeonspark_tpu.serving.fleet import _model_from_args
+    assert _model_from_args(["-conf", "s", "-model", "m1"]) == "m1"
+    assert _model_from_args(["-weights", "w1", "-conf", "s"]) == "w1"
+    assert _model_from_args(["-model", "m1", "-weights", "w1"]) == "m1"
+    # a -snapshot launch still has a lineage (.solverstate is a valid
+    # reload target — learned_net resolves the model)
+    assert _model_from_args(["-snapshot", "s1", "-conf", "s"]) == "s1"
+    assert _model_from_args(["-weights", "w1",
+                             "-snapshot", "s1"]) == "w1"
+    assert _model_from_args(["-conf", "s"]) is None
+
+
+def test_fleet_heals_respawn_booted_on_abandoned_model(monkeypatch):
+    """A respawn that BOOTED on an abandoned roll's candidate (spawned
+    in the instant before the abandonment repoint landed) is reloaded
+    onto the committed default before it rejoins rotation."""
+    from caffeonspark_tpu.serving import fleet as fleet_mod
+    fleet = _stub_fleet(1)
+    rep = fleet.replicas["replica0"]
+    rep.port = 1
+    rep.serve_args = ["-conf", "s.prototxt", "-model", "/snap/cand"]
+    rep.booted_model = "/snap/cand"
+    calls = []
+
+    def fake_http_json(url, *, data=None, timeout=30.0, method=None):
+        calls.append((url, data))
+        return 200, {"model_version": 5}
+
+    monkeypatch.setattr(fleet_mod, "http_json", fake_http_json)
+    fleet._heal_respawn_model(rep)
+    assert calls and b"/snap/v1.caffemodel" in calls[0][1]
+    assert rep.booted_model == "/snap/v1.caffemodel"
+    assert _model_arg(rep) == "/snap/v1.caffemodel"
+    # no-op cases: already on the default, or a roll is live
+    calls.clear()
+    fleet._heal_respawn_model(rep)
+    assert calls == []
+    rep.booted_model = "/snap/cand"
+    fleet._roll_active = True
+    fleet._heal_respawn_model(rep)
+    assert calls == []
+
+
+def test_fleet_rolling_reload_records_pre_roll_and_advances():
+    fleet = _stub_fleet(2)
+    assert fleet._default_model == "/snap/v1.caffemodel"
+    versions = fleet.rolling_reload("/snap/v2.caffemodel")
+    assert versions == {"replica0": 1, "replica1": 2}
+    assert fleet.pre_roll_model == "/snap/v1.caffemodel"
+    assert fleet._default_model == "/snap/v2.caffemodel"
+    for rep in fleet.replicas.values():
+        assert _model_arg(rep) == "/snap/v2.caffemodel"
+
+
+def test_fleet_abandoned_roll_respawn_follows_final_verdict():
+    """Replica 0 swaps, the roll dies at replica 1: respawn args must
+    point every replica at the INCUMBENT — the pre-fix behavior left
+    replica 0's argv on the abandoned candidate, so a death-respawn
+    reintroduced a version the fleet had rolled back."""
+    fleet = _stub_fleet(3, fail_at=1)
+    with pytest.raises(ConnectionRefusedError):
+        fleet.rolling_reload("/snap/v2.caffemodel")
+    assert fleet._default_model == "/snap/v1.caffemodel"  # not advanced
+    for rep in fleet.replicas.values():
+        assert _model_arg(rep) == "/snap/v1.caffemodel"
+
+
+def test_fleet_rollback_rerolls_live_skips_dead(monkeypatch):
+    from caffeonspark_tpu.serving import fleet as fleet_mod
+    fleet = _stub_fleet(3, fail_at=2)
+    with pytest.raises(ConnectionRefusedError):
+        fleet.rolling_reload("/snap/v2.caffemodel")
+
+    calls = []
+
+    def fake_http_json(url, *, data=None, timeout=30.0, method=None):
+        calls.append(url)
+        if "replica1" in url:
+            raise ConnectionRefusedError("replica1 is dead")
+        return 200, {"model_version": 9}
+
+    monkeypatch.setattr(fleet_mod, "http_json", fake_http_json)
+    versions = fleet.rollback()
+    # live replicas re-rolled to the incumbent; the dead one skipped
+    # (its respawn argv already points at the incumbent)
+    assert versions == {"replica0": 9, "replica2": 9}
+    assert all("/v1/reload" in c for c in calls)
+    for rep in fleet.replicas.values():
+        assert _model_arg(rep) == "/snap/v1.caffemodel"
+    assert fleet.metrics.get_counter("rollbacks") == 1
+
+
+def test_fleet_rollback_without_lineage_raises():
+    from caffeonspark_tpu.serving.fleet import Fleet
+    fleet = Fleet(["-conf", "s.prototxt"], replicas=1)
+    with pytest.raises(RuntimeError, match="no recorded default"):
+        fleet.rollback()
+
+
+def test_fleet_named_model_roll_keeps_default_lineage():
+    """A NAMED model's roll must not disturb the default model's
+    pre-roll bookkeeping (argv only carries the default)."""
+    fleet = _stub_fleet(2)
+    fleet._published_models["aux"] = {"name": "aux", "model": "old"}
+    fleet.rolling_reload("/snap/aux2.caffemodel", model_name="aux")
+    assert fleet._default_model == "/snap/v1.caffemodel"
+    assert fleet._published_models["aux"]["model"] == \
+        "/snap/aux2.caffemodel"
+    for rep in fleet.replicas.values():
+        assert _model_arg(rep) == "/snap/v1.caffemodel"
