@@ -9,8 +9,8 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 .PHONY: build native install lint test test-slow spark-test bench \
   smoke tpu-tests bench-evidence bench-ingest bench-steploop \
   bench-serving bench-serving-sharded bench-serving-multimodel \
-  bench-gradsync bench-syncmode bench-autotune bench-deploy chaos \
-  chaos-deploy onchip-artifacts docs clean
+  bench-gradsync bench-syncmode bench-autotune bench-deploy \
+  bench-obs chaos chaos-deploy onchip-artifacts docs clean
 
 build: native install
 
@@ -121,6 +121,17 @@ bench-deploy:
 	$(CPU_ENV) $(PY) scripts/bench_deploy.py \
 	  --out bench_evidence/bench_deploy.json
 
+# observability overhead: tracing at sample 1.0 + JSONL spool +
+# armed flight recorder + periodic metrics flush vs the off-config,
+# measured as adjacent alternating windows on ONE warm stack (median
+# of per-pair ratios — this box's CPU share swings would swamp an
+# off-then-on sequence); gate <3% on serving rows/s AND training
+# steps/s; ALWAYS exits 0 with one JSON document on stdout
+bench-obs:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_obs.py \
+	  --out bench_evidence/bench_obs.json
+
 # online serving: dynamic micro-batching vs batch=1 dispatch across
 # offered loads; JSON artifact with p50/p99 latency + rows/s per cell
 bench-serving:
@@ -181,6 +192,8 @@ bench-evidence:
 	  --out bench_evidence/bench_serving_multimodel.json
 	-$(CPU_ENV) $(PY) scripts/bench_deploy.py \
 	  --out bench_evidence/bench_deploy.json
+	-$(CPU_ENV) $(PY) scripts/bench_obs.py \
+	  --out bench_evidence/bench_obs.json
 
 # everything the judge wants from ONE healthy tunnel window, in
 # priority order: headline number + evidence, on-chip test artifact,

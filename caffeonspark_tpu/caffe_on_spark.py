@@ -369,8 +369,13 @@ def _serve_sigterm_drains() -> None:
     teardown (tools/supervisor.terminate_processes) sends SIGTERM with
     a grace window precisely so accepted serving work can flush;
     without a handler Python's default disposition kills the process
-    instantly and the drain never runs."""
+    instantly and the drain never runs.  The flight recorder dumps
+    FIRST — if the grace window closes and SIGKILL lands mid-drain,
+    the event timeline is already on disk (COS_RECORDER_DUMP)."""
     def handler(signum, frame):
+        from .obs.recorder import maybe_dump, record
+        record("serve", "signal", signal="SIGTERM")
+        maybe_dump("sigterm")
         raise KeyboardInterrupt
     try:
         signal.signal(signal.SIGTERM, handler)
@@ -380,12 +385,18 @@ def _serve_sigterm_drains() -> None:
 
 def _dump_serve_metrics(summary: dict) -> None:
     """COS_SERVE_METRICS=path: one JSON document at shutdown (same
-    shape for single-process and fleet mode)."""
+    shape for single-process and fleet mode).  The flight-recorder
+    artifact (COS_RECORDER_DUMP) and the trace spool flush land here
+    too — the clean-shutdown counterpart of the SIGTERM dump."""
     path = os.environ.get("COS_SERVE_METRICS")
     if path:
         with open(path, "w") as f:
             json.dump(summary, f, indent=2, sort_keys=True)
             f.write("\n")
+    from .obs.recorder import maybe_dump
+    from .obs.trace import get_tracer
+    maybe_dump("shutdown")
+    get_tracer().flush_spool()
 
 
 def serve_fleet_main(conf: Config, replicas: int) -> int:

@@ -343,6 +343,11 @@ class DeployController:
     def _finish_round(self, rec: dict, t0: float) -> dict:
         rec["wall_s"] = round(time.monotonic() - t0, 3)
         rec["incumbent"] = self.incumbent
+        from ..obs.recorder import record as record_event
+        record_event("deploy", "round", round=rec["round"],
+                     verdict=rec["verdict"],
+                     reason=rec.get("reason"),
+                     incumbent=self.incumbent)
         self.counts[rec["verdict"]] = \
             self.counts.get(rec["verdict"], 0) + 1
         self.metrics.incr("deploy_rounds")

@@ -58,6 +58,13 @@ measures pure starvation.
 
 Counters (dropped batches, ragged-tail records) and gauges (queue
 depths, sampled each step) ride along in the same summary.
+
+The observability layer (caffeonspark_tpu/obs) builds on this format
+without a second bookkeeping path: `obs/prom.py` renders the same
+summary dict as Prometheus exposition (`/metrics?format=prom`), and
+`MetricsFlusher` (COS_METRICS_FLUSH_S) background-flushes it to
+`<output>/metrics.json` through the fsync'd atomic-write path so a
+SIGKILLed run keeps telemetry no older than one interval.
 """
 
 from __future__ import annotations
@@ -262,3 +269,83 @@ class PipelineMetrics:
             json.dump(self.summary(), f, indent=2, sort_keys=True)
             f.write("\n")
         return path
+
+    def dump_atomic(self, path: str) -> str:
+        """Summary via the fsync'd atomic-write path — readers (and a
+        post-mortem after SIGKILL) only ever see a complete document."""
+        from .utils.fsutils import atomic_write_local
+        summary = self.summary()
+
+        def _write(tmp):
+            with open(tmp, "w") as f:
+                json.dump(summary, f, indent=2, sort_keys=True)
+                f.write("\n")
+
+        atomic_write_local(path, _write)
+        return path
+
+
+def metrics_flush_s() -> float:
+    """COS_METRICS_FLUSH_S: background-flush interval for the summary
+    artifact; 0/unset = the historical dump-only-at-stop behavior."""
+    from .utils.envutils import env_num
+    return max(0.0, env_num("COS_METRICS_FLUSH_S", 0.0, strict=False))
+
+
+class MetricsFlusher:
+    """Background thread flushing a PipelineMetrics summary to disk
+    every `interval_s` (the atomic-write path), so a SIGKILLed run
+    leaves telemetry no older than one interval instead of nothing.
+    `stop()` lands one final flush."""
+
+    def __init__(self, metrics: PipelineMetrics, path: str,
+                 interval_s: float):
+        self.metrics = metrics
+        self.path = path
+        self.interval_s = max(0.05, float(interval_s))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.flushes = 0
+        self.errors = 0
+
+    def _flush_once(self) -> None:
+        try:
+            self.metrics.dump_atomic(self.path)
+            self.flushes += 1
+        except OSError:
+            # a bad path/full disk must never take the run down; the
+            # final stop() flush surfaces persistent failure via count
+            self.errors += 1
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._flush_once()
+
+    def start(self) -> "MetricsFlusher":
+        assert self._thread is None, "flusher already started"
+        self._thread = threading.Thread(target=self._loop,
+                                        name="cos-metrics-flush",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._flush_once()
+
+
+def maybe_start_flusher(metrics: PipelineMetrics,
+                        output_dir: Optional[str],
+                        filename: str = "metrics.json"
+                        ) -> Optional[MetricsFlusher]:
+    """Start the periodic flusher when COS_METRICS_FLUSH_S > 0 and an
+    output directory exists to land `<output>/metrics.json` in."""
+    interval = metrics_flush_s()
+    if interval <= 0 or not output_dir:
+        return None
+    import os
+    path = os.path.join(output_dir, filename)
+    return MetricsFlusher(metrics, path, interval).start()

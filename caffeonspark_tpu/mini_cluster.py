@@ -176,20 +176,28 @@ class MiniCluster:
 
     # ------------------------------------------------------------------
     def _install_signals(self):
+        from .obs.recorder import maybe_dump, record
+
         def on_int(sig, frame):
             print("\nSIGINT → stop (snapshot + exit)", file=sys.stderr)
+            record("trainer", "signal", signal="SIGINT")
             self._stop = True
 
         def on_hup(sig, frame):
             print("SIGHUP → snapshot", file=sys.stderr)
+            record("trainer", "signal", signal="SIGHUP")
             self._want_snapshot = True
 
         def on_term(sig, frame):
             # supervisor teardown sends SIGTERM first (drain window
             # before SIGKILL): exit the step loop cleanly so atexit
-            # drains any in-flight async snapshot upload
+            # drains any in-flight async snapshot upload.  The flight
+            # recorder dumps HERE — if the grace window closes and
+            # SIGKILL lands, the timeline is already on disk.
             print("SIGTERM → teardown (drain snapshots + exit)",
                   file=sys.stderr)
+            record("trainer", "signal", signal="SIGTERM")
+            maybe_dump("sigterm")
             self._stop = True
 
         signal.signal(signal.SIGINT, on_int)
@@ -334,6 +342,18 @@ class MiniCluster:
         # the step loop; COS_TRANSFORM_THREADS=0 restores the inline
         # generator path
         pmetrics = PipelineMetrics()
+        # observability (caffeonspark_tpu/obs): COS_METRICS_FLUSH_S
+        # background-flushes the summary to <output>/metrics.json via
+        # the atomic-write path (a SIGKILLed run keeps telemetry no
+        # older than one interval), and COS_METRICS_PORT exposes the
+        # live summary + prom exposition + /v1/profile over HTTP
+        from .metrics import maybe_start_flusher
+        from .obs.http import maybe_start_obs_server
+        flusher = maybe_start_flusher(pmetrics, self.args.output) \
+            if self._is_rank0 else None
+        obs_server = maybe_start_obs_server(pmetrics.summary,
+                                            role="trainer") \
+            if self._is_rank0 else None
         nthreads = transform_threads()
         feed = None
         if nthreads > 0:
@@ -496,6 +516,9 @@ class MiniCluster:
                             # follows via the opt-state counter
                             print(f"sync: re-admitted at iter {new_it}"
                                   f" (was {it})", flush=True)
+                            from .obs.recorder import record
+                            record("trainer", "sync_readmitted",
+                                   iter_from=it, iter_to=new_it)
                             it = new_it
                             st = ps.set_iter(st, it)
                     timer.tick(n)
@@ -598,6 +621,17 @@ class MiniCluster:
                                 write_main=self._is_rank0)
                             if self._is_rank0:
                                 print(f"snapshot → {m}")
+                                from .obs.recorder import record
+                                record("trainer", "snapshot",
+                                       iter=it, path=m)
+        except BaseException as e:
+            # fatal training error: land the flight recorder before
+            # the exception unwinds the process
+            from .obs.recorder import maybe_dump, record
+            record("trainer", "fatal",
+                   error=f"{type(e).__name__}: {e}")
+            maybe_dump("fatal_exception")
+            raise
         finally:
             # stop the ingest threads whatever happens (a step failure
             # must not leak a reader/pool/stager still decoding at full
@@ -614,6 +648,13 @@ class MiniCluster:
                 # and land the final exchange counts in the artifact
                 sync.finalize(it)
                 pmetrics.set_info("sync", sync.info())
+            if obs_server is not None:
+                obs_server.stop()
+            if flusher is not None:
+                # final flush so <output>/metrics.json carries the
+                # complete run (including the sync/faults info blocks
+                # finalized just above)
+                flusher.stop()
             if self._is_rank0 and self.args.pipeline_metrics \
                     and pmetrics.has_samples():
                 try:

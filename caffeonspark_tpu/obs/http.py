@@ -1,0 +1,124 @@
+"""Training-side metrics port (COS_METRICS_PORT).
+
+Serving replicas and the fleet router have always had `/metrics`; the
+trainer only dumped its PipelineMetrics at exit.  This tiny server
+gives a LIVE training process the same scrapeable surface:
+
+  GET  /healthz               {"ok": true, "role": "trainer"}
+  GET  /metrics               PipelineMetrics summary (JSON)
+  GET  /metrics?format=prom   Prometheus exposition (obs/prom.py)
+  GET  /v1/traces[?trace=]    this process's finished spans
+  POST /v1/profile            bounded jax.profiler capture
+                              (obs/profiler.py) on the live trainer
+
+It reuses the serving JsonHandler (one Content-Length framing
+implementation repo-wide) and binds loopback by default — same
+exposure stance as the serving servers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Callable, Optional
+
+from ..utils.envutils import env_int
+from .prom import render_summary
+
+_LOG = logging.getLogger(__name__)
+
+
+def _make_handler():
+    # the serving JsonHandler carries the shared framing + the
+    # /v1/profile and /v1/traces implementations; imported lazily so
+    # obs never drags the serving package in at import time
+    from ..serving.http_server import JsonHandler
+
+    class Handler(JsonHandler):
+        log_prefix = "obs http: "
+
+        def do_GET(self):
+            path, q = self._route()
+            if path == "/healthz":
+                self._send(200, {"ok": True,
+                                 "role": self.server.role})
+            elif path == "/metrics":
+                summary = self.server.metrics_fn()
+                if q.get("format") == "prom":
+                    self._send_text(200, render_summary(
+                        summary, {"role": self.server.role}))
+                else:
+                    self._send(200, summary)
+            elif path == "/v1/traces":
+                self._handle_traces(q)
+            else:
+                self._send(404, {"error": f"no route {path}"})
+
+        def do_POST(self):
+            path, _q = self._route()
+            if path == "/v1/profile":
+                self._handle_profile()
+            else:
+                self._send(404, {"error": f"no route {path}"})
+
+    return Handler
+
+
+class ObsHTTPServer:
+    """Bind-and-go metrics/trace/profile surface over a summary
+    callable; port 0 picks an ephemeral port (read `.port` back)."""
+
+    def __init__(self, metrics_fn: Callable[[], dict], *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 role: str = "trainer"):
+        from http.server import ThreadingHTTPServer
+        self._httpd = ThreadingHTTPServer((host, port), _make_handler())
+        self._httpd.daemon_threads = True
+        self._httpd.metrics_fn = metrics_fn
+        self._httpd.role = role
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start_background(self) -> "ObsHTTPServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="cos-obs-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._httpd.server_close()
+
+
+def maybe_start_obs_server(metrics_fn: Callable[[], dict],
+                           role: str = "trainer"
+                           ) -> Optional[ObsHTTPServer]:
+    """COS_METRICS_PORT=N starts the server on port N (0 = ephemeral;
+    unset/absent = disabled — the historical no-port behavior)."""
+    port_s = os.environ.get("COS_METRICS_PORT")
+    if port_s is None or port_s == "":
+        return None
+    port = env_int("COS_METRICS_PORT", 0, strict=False)
+    try:
+        srv = ObsHTTPServer(metrics_fn, port=max(0, port),
+                            role=role).start_background()
+    except OSError as e:
+        # an observability knob must never take training down: a port
+        # conflict (second trainer on the box, a relaunch racing its
+        # not-yet-exited predecessor) warns and runs without the port
+        _LOG.warning("obs: COS_METRICS_PORT=%s bind failed (%s) — "
+                     "metrics port disabled for this run", port_s, e)
+        return None
+    _LOG.info("obs: metrics port up on %d (role=%s)", srv.port, role)
+    print(json.dumps({"obs_metrics_port": srv.port, "role": role}),
+          flush=True)
+    return srv

@@ -1,0 +1,67 @@
+"""On-demand profiler capture: a bounded `jax.profiler.trace` on a
+LIVE process, started over HTTP instead of a restart with `-profile`.
+
+`POST /v1/profile {"duration_ms": N}` on a serving replica (or the
+training-side metrics port) captures N ms of XLA device timeline into
+a TensorBoard-loadable trace directory and answers with its path —
+concurrent requests keep serving; the profiler rides alongside.
+
+One capture at a time per process (jax.profiler is a process-global),
+enforced with a non-blocking try-lock: a second POST while one runs
+answers 409 instead of queueing operator requests behind each other.
+Duration is clamped to PROFILE_MAX_MS so a fat-fingered request can't
+leave the profiler running for an hour.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+import time
+
+_LOG = logging.getLogger(__name__)
+
+PROFILE_DEFAULT_MS = 1000.0
+PROFILE_MAX_MS = 30_000.0
+
+_capture_lock = threading.Lock()
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already running in this process (HTTP 409)."""
+
+
+def capture(duration_ms: float = PROFILE_DEFAULT_MS,
+            log_dir: str = "") -> dict:
+    """Run one bounded jax.profiler trace; returns
+    {"trace_dir", "duration_ms"}.  The sleep bounds the capture —
+    device work proceeds normally underneath it (the profiler hooks
+    the runtime, it does not serialize it)."""
+    dur = max(10.0, min(float(duration_ms or PROFILE_DEFAULT_MS),
+                        PROFILE_MAX_MS))
+    if not _capture_lock.acquire(blocking=False):
+        raise ProfilerBusy("a profiler capture is already running")
+    try:
+        out_dir = log_dir or os.environ.get("COS_PROFILE_DIR", "")
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            trace_dir = tempfile.mkdtemp(prefix="cos_profile_",
+                                         dir=out_dir)
+        else:
+            trace_dir = tempfile.mkdtemp(prefix="cos_profile_")
+        import jax
+        t0 = time.monotonic()
+        jax.profiler.start_trace(trace_dir)
+        try:
+            time.sleep(dur / 1e3)
+        finally:
+            jax.profiler.stop_trace()
+        wall = time.monotonic() - t0
+        _LOG.info("profiler capture: %.0f ms -> %s", wall * 1e3,
+                  trace_dir)
+        return {"trace_dir": trace_dir,
+                "duration_ms": round(wall * 1e3, 1)}
+    finally:
+        _capture_lock.release()

@@ -1,0 +1,280 @@
+"""Prometheus exposition of the PipelineMetrics JSON summary.
+
+The summary dict (`PipelineMetrics.summary()` — the exact JSON the
+trainer dumps and every serving `/metrics` answers) renders into
+text exposition format (`/metrics?format=prom`), so the counters,
+percentile rings, and gauges the repo already keeps become scrapeable
+without a second bookkeeping path:
+
+  counters      -> `cos_<name>_total` counter
+  stage series  -> ONE family per statistic with a `stage` label:
+                   `cos_stage_seconds_total` / `cos_stage_calls_total`
+                   (counters) and `cos_stage_ms{quantile=...}` /
+                   `cos_stage_ms_max` / `cos_stage_ms_mean` (gauges)
+  gauges        -> `cos_gauge_mean` / `cos_gauge_max` /
+                   `cos_gauge_samples_total` with a `name` label
+  steps         -> `cos_steps_total`; steady_steps_per_sec, uptime,
+                   queue_depth_now, model_version -> plain gauges
+  router table  -> `cos_replica_up{replica,state}` /
+                   `cos_replica_outstanding` /
+                   `cos_replica_requests_total` / ..._failures_total /
+                   ..._restarts_total
+
+Label-parameterizing the families (stage/name/replica/model — plus a
+caller-supplied base label set like `{"replica": "replica0"}` for the
+router's fleet aggregation) keeps the family NAME set fixed, so two
+summaries merged into one scrape can never emit a duplicate family
+header — the thing real scrapers reject.
+
+`parse_exposition` is the round-trip validator the tests and the
+bench use: it re-parses rendered output, failing on duplicate
+families, type-less samples, or malformed lines.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+_VALID_FAMILY = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def sanitize(name: str) -> str:
+    """Metric/label-value-safe identifier from an arbitrary counter or
+    stage name (`flush_bucket_8`, `page_in_modelA`)."""
+    out = _NAME_RE.sub("_", str(name))
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out or "_"
+
+
+class PromWriter:
+    """Accumulates samples by family; families are declared once with
+    a type, samples append under them — merging any number of
+    summaries (fleet aggregation) without duplicate headers."""
+
+    def __init__(self, prefix: str = "cos"):
+        self.prefix = prefix
+        # family -> (type, help); insertion-ordered
+        self._families: Dict[str, Tuple[str, str]] = {}
+        self._samples: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+
+    def family(self, name: str, ftype: str, help_text: str) -> str:
+        full = f"{self.prefix}_{name}"
+        prev = self._families.get(full)
+        if prev is not None and prev[0] != ftype:
+            raise ValueError(f"family {full}: type conflict "
+                             f"{prev[0]} vs {ftype}")
+        if prev is None:
+            self._families[full] = (ftype, help_text)
+            self._samples[full] = []
+        return full
+
+    def sample(self, name: str, ftype: str, help_text: str,
+               value: float, labels: Optional[Dict[str, str]] = None
+               ) -> None:
+        full = self.family(name, ftype, help_text)
+        v = float(value)
+        if math.isnan(v) or math.isinf(v):
+            return
+        self._samples[full].append((dict(labels or {}), v))
+
+    # -- summary ingestion ---------------------------------------------
+    def add_summary(self, summary: dict,
+                    labels: Optional[Dict[str, str]] = None) -> None:
+        """One PipelineMetrics summary's counters/series/gauges, every
+        sample carrying `labels` (the router adds {"replica": name})."""
+        base = dict(labels or {})
+
+        for cname, v in (summary.get("counters") or {}).items():
+            self.sample(f"{sanitize(cname)}_total", "counter",
+                        f"counter {cname}", v, base)
+        for stage, st in (summary.get("stages") or {}).items():
+            sl = dict(base, stage=sanitize(stage))
+            self.sample("stage_seconds_total", "counter",
+                        "per-stage accumulated seconds",
+                        st.get("total_s", 0.0), sl)
+            self.sample("stage_calls_total", "counter",
+                        "per-stage sample count",
+                        st.get("count", 0), sl)
+            self.sample("stage_ms_mean", "gauge",
+                        "per-stage mean milliseconds",
+                        st.get("mean_ms", 0.0), sl)
+            self.sample("stage_ms_max", "gauge",
+                        "per-stage max milliseconds",
+                        st.get("max_ms", 0.0), sl)
+            for q, key in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
+                           ("0.99", "p99_ms")):
+                self.sample("stage_ms", "gauge",
+                            "per-stage latency quantiles (ms, over "
+                            "the bounded sample ring)",
+                            st.get(key, 0.0), dict(sl, quantile=q))
+        for gname, g in (summary.get("queue_depths") or {}).items():
+            gl = dict(base, name=sanitize(gname))
+            self.sample("gauge_mean", "gauge", "sampled gauge mean",
+                        g.get("mean", 0.0), gl)
+            self.sample("gauge_max", "gauge", "sampled gauge max",
+                        g.get("max", 0.0), gl)
+            self.sample("gauge_samples_total", "counter",
+                        "sampled gauge observation count",
+                        g.get("samples", 0), gl)
+        if "steps" in summary:
+            self.sample("steps_total", "counter",
+                        "completed solver steps", summary["steps"],
+                        base)
+        for key, fam, help_text in (
+                ("uptime_s", "uptime_seconds", "process uptime"),
+                ("steady_steps_per_sec", "steady_steps_per_sec",
+                 "steady-state steps/sec (warmup-skipped)"),
+                ("queue_depth_now", "queue_depth_now",
+                 "live batcher queue depth (all lanes)"),
+                ("model_version", "model_version",
+                 "current default-model version"),
+                ("warmup_s", "warmup_seconds", "warmup wall time"),
+                ("hbm_budget_mb", "hbm_budget_mb",
+                 "serving HBM budget (MB)")):
+            if summary.get(key) is not None:
+                self.sample(fam, "gauge", help_text, summary[key],
+                            base)
+        for mname, st in (summary.get("models") or {}).items():
+            ml = dict(base, model=sanitize(mname))
+            self.sample("model_resident", "gauge",
+                        "1 = model resident in HBM",
+                        1.0 if st.get("resident") else 0.0, ml)
+            for k in ("requests", "rows", "evictions", "page_ins"):
+                if st.get(k) is not None:
+                    self.sample(f"model_{k}_total", "counter",
+                                f"per-model {k}", st[k], ml)
+            if st.get("p99_ms") is not None:
+                self.sample("model_p99_ms", "gauge",
+                            "per-model p99 latency (ms)",
+                            st["p99_ms"], ml)
+        for rname, st in (summary.get("replicas") or {}).items():
+            rl = dict(base, replica=sanitize(rname))
+            self.sample("replica_up", "gauge",
+                        "1 = replica routable (state=ok)",
+                        1.0 if st.get("state") == "ok" else 0.0,
+                        dict(rl, state=sanitize(st.get("state",
+                                                       "unknown"))))
+            self.sample("replica_outstanding", "gauge",
+                        "router-side in-flight requests",
+                        st.get("outstanding", 0), rl)
+            for k in ("requests", "failures", "restarts"):
+                self.sample(f"replica_{k}_total", "counter",
+                            f"per-replica {k}", st.get(k, 0), rl)
+
+    # -- rendering -----------------------------------------------------
+    def render(self) -> str:
+        lines: List[str] = []
+        for fam, (ftype, help_text) in self._families.items():
+            samples = self._samples[fam]
+            if not samples:
+                continue
+            lines.append(f"# HELP {fam} {help_text}")
+            lines.append(f"# TYPE {fam} {ftype}")
+            for labels, value in samples:
+                if labels:
+                    lab = ",".join(
+                        f'{k}="{_escape(v)}"'
+                        for k, v in sorted(labels.items()))
+                    lines.append(f"{fam}{{{lab}}} {_fmt(value)}")
+                else:
+                    lines.append(f"{fam} {_fmt(value)}")
+        return "\n".join(lines) + "\n"
+
+
+def _escape(v) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _fmt(v: float) -> str:
+    if float(v).is_integer() and abs(v) < 2**53:
+        return str(int(v))
+    return repr(float(v))
+
+
+def render_summary(summary: dict,
+                   labels: Optional[Dict[str, str]] = None) -> str:
+    w = PromWriter()
+    w.add_summary(summary, labels)
+    return w.render()
+
+
+# -- validity (the round-trip the tests pin) ----------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(-?[0-9.eE+-]+|NaN)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str) -> Dict[str, dict]:
+    """Strict-enough exposition parser: returns
+    {family: {"type", "help", "samples": [(labels, value), ...]}}.
+    Raises ValueError on duplicate family declarations, samples with
+    no TYPE, label-syntax garbage, or unparseable lines — the checks
+    a real scraper's rejection would surface in production."""
+    fams: Dict[str, dict] = {}
+    declared: set = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            fams.setdefault(name, {"type": None, "help": None,
+                                   "samples": []})
+            fams[name]["help"] = line.split(" ", 3)[3] \
+                if len(line.split(" ", 3)) > 3 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            name, ftype = parts[2], parts[3]
+            if name in declared:
+                raise ValueError(
+                    f"line {lineno}: duplicate TYPE for family {name}")
+            declared.add(name)
+            fams.setdefault(name, {"type": None, "help": None,
+                                   "samples": []})
+            fams[name]["type"] = ftype
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: unparseable sample "
+                             f"{line!r}")
+        name, _, labelstr, value = m.groups()
+        if name not in fams or fams[name]["type"] is None:
+            raise ValueError(f"line {lineno}: sample for undeclared "
+                             f"family {name}")
+        labels: Dict[str, str] = {}
+        if labelstr:
+            consumed = sum(len(mm.group(0))
+                           for mm in _LABEL_RE.finditer(labelstr))
+            stripped = labelstr.replace(",", "").replace(" ", "")
+            if consumed < len(stripped):
+                raise ValueError(f"line {lineno}: bad label syntax "
+                                 f"{labelstr!r}")
+            labels = {mm.group(1): mm.group(2)
+                      for mm in _LABEL_RE.finditer(labelstr)}
+        fams[name]["samples"].append((labels, float(value)))
+    for name, fam in fams.items():
+        if not _VALID_FAMILY.match(name):
+            raise ValueError(f"bad family name {name!r}")
+    return fams
+
+
+def counter_values(fams: Dict[str, dict]) -> Dict[str, float]:
+    """Flattened {family{sorted-labels}: value} for every counter
+    family — what the monotonicity check compares across scrapes."""
+    out: Dict[str, float] = {}
+    for name, fam in fams.items():
+        if fam["type"] != "counter":
+            continue
+        for labels, value in fam["samples"]:
+            key = name + "|" + ",".join(
+                f"{k}={v}" for k, v in sorted(labels.items()))
+            out[key] = value
+    return out

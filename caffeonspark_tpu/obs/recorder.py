@@ -1,0 +1,146 @@
+"""Flight recorder: a bounded in-memory ring of structured events,
+dumped to an artifact when the process dies messily.
+
+The metrics JSON says HOW MUCH (counters, percentiles); the recorder
+says WHAT HAPPENED, IN WHAT ORDER: replica state transitions, drains,
+rolling reloads, LRU evictions/page-ins, restarts, chaos faults,
+snapshots, sync re-admissions, deploy verdicts.  When a
+kill-under-load drill (or a real outage) ends a process, the ring is
+the reconstructable timeline — "what did this process see in its
+last N events" — instead of whatever half a log line made it to disk.
+
+  * Recording is always-on and cheap: one lock + one list slot per
+    event, at OPERATOR-EVENT rates (state changes, not requests).
+    `COS_RECORDER_EVENTS` sizes the ring (default 512; 0 disables).
+  * `COS_RECORDER_DUMP` names where the artifact lands: a `.json`
+    path is used as-is; anything else is treated as a directory and
+    each process writes `recorder-<pid>.json` inside it (fleet
+    replicas inherit the env — per-pid names keep them from
+    clobbering each other).
+  * `maybe_dump(reason)` writes the artifact through the fsync'd
+    atomic-write path; the serve/train SIGTERM handlers, fatal
+    exception paths, and the chaos fault latch all call it, so a
+    SIGKILL is the only death that loses the ring.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+from ..utils.envutils import env_int
+
+_LOG = logging.getLogger(__name__)
+
+
+class FlightRecorder:
+    """LOCK-FREE by design: record() is called from signal handlers
+    (the SIGTERM dump path records the signal itself), which run on
+    the main thread between bytecodes — a mutex here would deadlock
+    the process the moment a signal lands while the main thread holds
+    it mid-record.  A bounded deque's append is a single GIL-atomic
+    operation, so the handler can always record and the ring stays
+    consistent without any lock."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        cap = (capacity if capacity is not None
+               else env_int("COS_RECORDER_EVENTS", 512, strict=False))
+        self.capacity = max(0, cap)
+        self._ring: "deque[dict]" = deque(maxlen=self.capacity or 1)
+        self._seq = itertools.count(1)
+        self._t0 = time.monotonic()
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    def record(self, source: str, event: str, **detail) -> None:
+        """One structured event; `detail` values must be
+        JSON-serializable (callers pass strings/numbers)."""
+        if not self.capacity:
+            return
+        rec = {"seq": next(self._seq),
+               "ts": round(time.time(), 6),
+               "t_rel_s": round(time.monotonic() - self._t0, 6),
+               "source": source, "event": event}
+        if detail:
+            rec.update(detail)
+        self._ring.append(rec)
+
+    def events(self) -> List[dict]:
+        """Chronological snapshot of the ring."""
+        return list(self._ring)
+
+    def dump(self, path: str, reason: str = "") -> str:
+        """Write the artifact via the fsync'd atomic-write path, so a
+        crash racing the dump never leaves a truncated timeline."""
+        from ..utils.fsutils import atomic_write_local
+        events = self.events()
+        doc = {"schema": "cos-flight-recorder-v1",
+               "pid": os.getpid(),
+               "dumped_at": round(time.time(), 6),
+               "reason": reason,
+               "dropped": max(0, (events[-1]["seq"] - len(events))
+                              if events else 0),
+               "events": events}
+
+        def _write(tmp):
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=2, sort_keys=False)
+                f.write("\n")
+
+        atomic_write_local(path, _write)
+        return path
+
+
+# -- process singleton + dump plumbing ----------------------------------
+_recorder: Optional[FlightRecorder] = None
+_recorder_lock = threading.Lock()
+
+
+def get_recorder() -> FlightRecorder:
+    global _recorder
+    if _recorder is None:
+        with _recorder_lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+    return _recorder
+
+
+def record(source: str, event: str, **detail) -> None:
+    """Module-level convenience: every subsystem records through this
+    one call, so the event stream interleaves in one ring."""
+    get_recorder().record(source, event, **detail)
+
+
+def dump_path() -> Optional[str]:
+    """Resolved COS_RECORDER_DUMP target for THIS process, or None."""
+    p = os.environ.get("COS_RECORDER_DUMP", "")
+    if not p:
+        return None
+    if p.endswith(".json"):
+        return p
+    return os.path.join(p, f"recorder-{os.getpid()}.json")
+
+
+def maybe_dump(reason: str) -> Optional[str]:
+    """Dump the ring to the COS_RECORDER_DUMP target (no-op when the
+    knob is unset or the recorder is disabled).  Never raises: this
+    runs inside signal handlers and fatal-error paths, where a dump
+    failure must not mask the real problem."""
+    path = dump_path()
+    rec = get_recorder()
+    if path is None or not rec.enabled:
+        return None
+    try:
+        rec.record("recorder", "dump", reason=reason)
+        return rec.dump(path, reason=reason)
+    except Exception as e:          # noqa: BLE001 — best-effort
+        _LOG.warning("flight-recorder dump to %s failed: %s", path, e)
+        return None

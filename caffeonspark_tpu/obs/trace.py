@@ -1,0 +1,428 @@
+"""Distributed request tracing: trace/span ids over the serving hops.
+
+One slow request through the fleet decomposes into WHICH hop ate the
+latency: the router mints (or adopts, from the client's `X-COS-Trace`
+header) a trace id, opens a span per routing attempt (a retried
+request is ONE trace with N attempt spans, never N orphan traces),
+and forwards the context to the replica, whose handler, batcher, and
+forward hook each contribute child spans:
+
+    router.request              client-observed wall at the router
+      router.attempt            one per pick (attrs: replica, outcome)
+        replica.request         replica-side wall (parse -> respond)
+          serve.queue_wait      submit -> flush pickup (the "RPC
+                                Considered Harmful" queueing term)
+          serve.pack            flush assembly: decode/transform/pad
+          serve.fwd             jitted forward dispatch + row fetch
+          serve.exec            whole-flush execution (attrs: bucket,
+                                batch — padding visible as bucket-real)
+
+Sampling (`COS_TRACE_SAMPLE`, default 0) is resolved ONCE per process
+(COS003 discipline).  0 is INERT: `span()` returns a no-op whose cost
+is one attribute check and one thread-local read — the serving hot
+path is byte-identical with tracing off.  An inbound sampled header
+always wins over the local rate, so a trace stays whole across hops
+whatever each process's own sampling says.
+
+Finished spans land in a bounded in-memory ring (served by
+`GET /v1/traces`; the router aggregates rings across replicas) and,
+when `COS_TRACE_DIR` names a directory, in a per-process JSONL spool
+`trace-<pid>.jsonl` that survives the process.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional
+
+from ..utils.envutils import env_num
+
+_LOG = logging.getLogger(__name__)
+
+TRACE_HEADER = "X-COS-Trace"
+
+
+class SpanCtx(NamedTuple):
+    """Wire-propagatable span identity: what a child names as parent."""
+    trace_id: str
+    span_id: str
+
+    def to_header(self) -> str:
+        return f"{self.trace_id}:{self.span_id}"
+
+
+def parse_header(value: Optional[str]) -> Optional[SpanCtx]:
+    """`X-COS-Trace: <trace_id>:<span_id>` -> SpanCtx; None/garbage ->
+    None (an unparseable header must never fail a predict)."""
+    if not value:
+        return None
+    parts = value.strip().split(":")
+    if len(parts) != 2 or not parts[0] or not parts[1]:
+        return None
+    return SpanCtx(parts[0], parts[1])
+
+
+def _new_id(nbits: int = 64) -> str:
+    return f"{random.getrandbits(nbits):0{nbits // 4}x}"
+
+
+class _NullSpan:
+    """The inert span: every operation is a no-op, `ctx` is None so
+    downstream propagation (headers, request slots) stays absent."""
+
+    __slots__ = ()
+    ctx = None
+
+    def set(self, key, value):
+        return self
+
+    def header(self) -> Optional[str]:
+        return None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __bool__(self):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One live span; finishes into a compact ring record on exit.
+    The hot path stays allocation-light: attrs dict is created only
+    on the first set(), the record is a tuple rendered to a dict only
+    when read (recent()/spool drain) — finishing a span is a couple
+    of clock reads and one locked list-slot write."""
+
+    __slots__ = ("tracer", "name", "ctx", "parent_id", "_t0",
+                 "_ts", "attrs")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 trace_id: str, parent_id: Optional[str]):
+        self.tracer = tracer
+        self.name = name
+        self.ctx = SpanCtx(trace_id, tracer._next_span_id())
+        self.parent_id = parent_id
+        self._t0 = time.monotonic()
+        self._ts = time.time()
+        self.attrs: Optional[Dict[str, object]] = None
+
+    def set(self, key, value):
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs[key] = value
+        return self
+
+    def header(self) -> str:
+        return self.ctx.to_header()
+
+    def __enter__(self):
+        self.tracer._push(self.ctx)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.tracer._pop()
+        if exc is not None:
+            self.set("error", f"{type(exc).__name__}: {exc}")
+        self.tracer._finish(self, time.monotonic() - self._t0)
+        return False
+
+    def __bool__(self):
+        return True
+
+
+class Tracer:
+    """Per-process tracer: sampling decision, thread-local span stack,
+    bounded finished-span ring, optional JSONL spool."""
+
+    def __init__(self, service: str = "", *,
+                 sample: Optional[float] = None,
+                 spool_dir: Optional[str] = None,
+                 capacity: int = 4096):
+        self.service = service or f"pid{os.getpid()}"
+        self.sample = (sample if sample is not None
+                       else max(0.0, min(1.0, env_num(
+                           "COS_TRACE_SAMPLE", 0.0, strict=False))))
+        self.spool_dir = (spool_dir if spool_dir is not None
+                          else os.environ.get("COS_TRACE_DIR", ""))
+        self._cap = max(16, capacity)
+        self._lock = threading.Lock()
+        # finish path: ONE GIL-atomic deque.append, no lock — the
+        # executor thread is the serving bottleneck and every
+        # microsecond of span bookkeeping on it is amplified into
+        # request latency.  Readers (recent(), the spool drainer)
+        # absorb the staged records into the ring under the lock.
+        self._staged: "deque[tuple]" = deque(maxlen=2 * self._cap)
+        # ring of COMPACT tuples (trace, span, parent, name, ts, dur,
+        # attrs) — rendered to dicts only when read; deque(maxlen)
+        # keeps it bounded AND chronological with no index juggling
+        self._ring: "deque[tuple]" = deque(maxlen=self._cap)
+        self._local = threading.local()
+        self._rng = random.Random()
+        # span ids: per-process random prefix + cheap counter — unique
+        # across the fleet without a 64-bit RNG draw per span
+        self._id_prefix = f"{random.getrandbits(32):08x}"
+        self._id_counter = itertools.count(1)
+        # spool: absorbed records buffer here; the background drainer
+        # serializes + writes them OFF the request path
+        self._pending: List[tuple] = []
+        self._spool = None          # lazily-opened JSONL handle
+        self._spool_path: Optional[str] = None
+        # serializes open/write/close of the spool handle: the 0.2s
+        # drainer and a shutdown-path flush_spool() (or reconfigure)
+        # may drain concurrently, and two buffered handles appending
+        # to one file would interleave mid-line
+        self._spool_lock = threading.Lock()
+        self._drainer: Optional[threading.Thread] = None
+        self._drain_stop = threading.Event()
+
+    def _next_span_id(self) -> str:
+        return f"{self._id_prefix}{next(self._id_counter):07x}"
+
+    # -- sampling / context --------------------------------------------
+    def enabled(self) -> bool:
+        return self.sample > 0.0
+
+    def sample_root(self) -> bool:
+        """One sampling draw — True means this process roots a new
+        trace for the request it is looking at."""
+        if self.sample <= 0.0:
+            return False
+        return self.sample >= 1.0 or self._rng.random() < self.sample
+
+    def from_header(self, value: Optional[str]) -> Optional[SpanCtx]:
+        return parse_header(value)
+
+    def _stack(self) -> List[SpanCtx]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def current(self) -> Optional[SpanCtx]:
+        st = getattr(self._local, "stack", None)
+        return st[-1] if st else None
+
+    def _push(self, ctx: SpanCtx) -> None:
+        self._stack().append(ctx)
+
+    def _pop(self) -> None:
+        st = getattr(self._local, "stack", None)
+        if st:
+            st.pop()
+
+    def activate(self, ctx: Optional[SpanCtx]):
+        """Context manager installing `ctx` as the thread's current
+        parent — the cross-thread handoff (a batcher executor thread
+        adopting a request's context so the model hook's spans nest
+        under it).  None -> no-op."""
+        return _Activation(self, ctx) if ctx is not None else NULL_SPAN
+
+    # -- span creation -------------------------------------------------
+    def span(self, name: str, parent: Optional[SpanCtx] = None,
+             root: bool = False):
+        """Open a span.  Parent resolution: explicit `parent` wins,
+        else the thread's current span, else a new root when `root`
+        (the caller's sampling draw said yes).  No parent and no root
+        -> the inert NULL_SPAN (tracing-off hot path)."""
+        if parent is None:
+            parent = self.current()
+        if parent is not None:
+            return Span(self, name, parent.trace_id, parent.span_id)
+        if root:
+            return Span(self, name, _new_id(), None)
+        return NULL_SPAN
+
+    def record_span(self, name: str, parent: Optional[SpanCtx],
+                    duration_s: float, **attrs) -> None:
+        """Record an already-measured interval as a finished span
+        (the batcher back-dates queue-wait from the request's submit
+        timestamp).  No-op when parent is None."""
+        if parent is None:
+            return
+        rec = (parent.trace_id, self._next_span_id(), parent.span_id,
+               name, time.time() - duration_s, duration_s,
+               attrs or None)
+        self._store(rec)
+
+    # -- finished spans ------------------------------------------------
+    def _finish(self, span: Span, duration_s: float) -> None:
+        self._store((span.ctx.trace_id, span.ctx.span_id,
+                     span.parent_id, span.name, span._ts, duration_s,
+                     span.attrs))
+
+    def _store(self, rec: tuple) -> None:
+        # hot path: one atomic append.  The bounded deque guarantees
+        # memory even if nothing ever reads; sustained bursts past
+        # 2x capacity between absorptions drop oldest (ring
+        # semantics anyway).
+        self._staged.append(rec)
+        if self.spool_dir and self._drainer is None:
+            with self._lock:
+                if self._drainer is None:
+                    self._start_drainer_locked()
+
+    def _absorb_staged(self) -> None:
+        """Move staged records into the ring (and the spool-pending
+        buffer) — reader-side work, never the request path."""
+        with self._lock:
+            while True:
+                try:
+                    rec = self._staged.popleft()
+                except IndexError:
+                    break
+                self._ring.append(rec)
+                if self.spool_dir:
+                    self._pending.append(rec)
+
+    def _rec_to_dict(self, rec: tuple) -> dict:
+        out = {"trace_id": rec[0], "span_id": rec[1],
+               "parent_id": rec[2], "name": rec[3],
+               "service": self.service,
+               "ts": round(rec[4], 6),
+               "dur_ms": round(rec[5] * 1e3, 4)}
+        if rec[6]:
+            out["attrs"] = dict(rec[6])
+        return out
+
+    def _rec_to_line(self, rec: tuple) -> str:
+        """One JSONL line, hand-assembled: ids/names are [0-9a-zA-Z._-]
+        by construction so only the attrs dict (rare) pays a real
+        json.dumps — the drainer serializes thousands of spans per
+        second and generic dict encoding was its hot spot."""
+        attrs = f', "attrs": {json.dumps(rec[6])}' if rec[6] else ""
+        parent = f'"{rec[2]}"' if rec[2] is not None else "null"
+        return (f'{{"trace_id": "{rec[0]}", "span_id": "{rec[1]}", '
+                f'"parent_id": {parent}, "name": "{rec[3]}", '
+                f'"service": "{self.service}", "ts": {rec[4]:.6f}, '
+                f'"dur_ms": {rec[5] * 1e3:.4f}{attrs}}}' "\n")
+
+    # -- spool (background drainer: serialization never taxes the
+    # -- request path, and never runs under the ring lock) -------------
+    def _start_drainer_locked(self) -> None:
+        self._drain_stop.clear()
+        self._drainer = threading.Thread(target=self._drain_loop,
+                                         name="cos-trace-spool",
+                                         daemon=True)
+        self._drainer.start()
+
+    def _drain_loop(self) -> None:
+        # short cadence on purpose: draining is O(records since last
+        # drain) of GIL-holding string work, and one big burst every
+        # few seconds would stall the serving executor for its whole
+        # duration — many small steals beat one long monopoly
+        while not self._drain_stop.wait(0.2):
+            self._drain_once()
+
+    def _drain_once(self) -> None:
+        self._absorb_staged()
+        with self._spool_lock:
+            with self._lock:
+                batch, self._pending = self._pending, []
+            if not batch or not self.spool_dir:
+                return
+            try:
+                if self._spool is None:
+                    os.makedirs(self.spool_dir, exist_ok=True)
+                    self._spool_path = os.path.join(
+                        self.spool_dir, f"trace-{os.getpid()}.jsonl")
+                    self._spool = open(self._spool_path, "a")
+                self._spool.write("".join(self._rec_to_line(r)
+                                          for r in batch))
+                self._spool.flush()
+            except OSError as e:
+                _LOG.warning("trace spool write failed (%s) — "
+                             "disabling the spool, ring stays live",
+                             e)
+                self.spool_dir = ""
+                self._spool = None
+
+    def flush_spool(self) -> Optional[str]:
+        """Force-drain pending records to the JSONL file (shutdown
+        paths call this so a SIGTERM never loses the buffered tail)."""
+        self._drain_once()
+        return self._spool_path
+
+    def recent(self, trace_id: Optional[str] = None,
+               limit: int = 1024) -> List[dict]:
+        """Finished spans, oldest first (ring order), optionally
+        filtered to one trace."""
+        self._absorb_staged()
+        with self._lock:
+            spans = list(self._ring)
+        if trace_id:
+            spans = [r for r in spans if r[0] == trace_id]
+        return [self._rec_to_dict(r) for r in spans[-limit:]]
+
+    def reconfigure(self, sample: Optional[float] = None,
+                    spool_dir: Optional[str] = None) -> "Tracer":
+        """Benches/tests flip sampling inside one process; production
+        sets COS_TRACE_SAMPLE before start and never calls this."""
+        if sample is not None:
+            self.sample = max(0.0, min(1.0, float(sample)))
+        if spool_dir is not None:
+            self._drain_once()          # land the old spool's tail
+            with self._spool_lock:
+                if self._spool is not None:
+                    try:
+                        self._spool.close()
+                    except OSError:
+                        pass
+                self._spool = None
+                self._spool_path = None
+                with self._lock:
+                    self._pending = []
+                self.spool_dir = spool_dir
+        return self
+
+
+class _Activation:
+    __slots__ = ("tracer", "ctx")
+
+    def __init__(self, tracer: Tracer, ctx: SpanCtx):
+        self.tracer = tracer
+        self.ctx = ctx
+
+    def __enter__(self):
+        self.tracer._push(self.ctx)
+        return self
+
+    def __exit__(self, *exc):
+        self.tracer._pop()
+        return False
+
+
+# -- process singleton --------------------------------------------------
+_tracer: Optional[Tracer] = None
+_tracer_lock = threading.Lock()
+
+
+def get_tracer(service: str = "") -> Tracer:
+    """The process tracer (created on first use; `service` names it on
+    that first call — router vs replica vs trainer)."""
+    global _tracer
+    if _tracer is None:
+        with _tracer_lock:
+            if _tracer is None:
+                _tracer = Tracer(service)
+    return _tracer
+
+
+def span_tree(spans: List[dict]) -> Dict[str, List[dict]]:
+    """children-by-parent-id index (tests and the aggregate view)."""
+    tree: Dict[str, List[dict]] = {}
+    for s in spans:
+        tree.setdefault(s.get("parent_id") or "", []).append(s)
+    return tree

@@ -131,6 +131,8 @@ class CaffeProcessor:
         # the solver thread: all drop accounting shares one lock
         self._drop_lock = threading.Lock()
         self.metrics = PipelineMetrics()  # step-timeline (stop() dumps)
+        self._flusher = None          # COS_METRICS_FLUSH_S (start())
+        self._obs_server = None       # COS_METRICS_PORT (start())
         self._train_pool: Optional[TransformerPool] = None
         self._val_pool: Optional[TransformerPool] = None
         self._snapshotter = None      # lazy AsyncSnapshotter (-async_snapshot)
@@ -169,6 +171,17 @@ class CaffeProcessor:
             q.reset()
         self._train_pool = None     # _run_train builds fresh pools
         self._val_pool = None
+        # observability: periodic summary flush to <output>/metrics.json
+        # (COS_METRICS_FLUSH_S — a SIGKILLed run keeps telemetry) and
+        # the live metrics port (COS_METRICS_PORT)
+        if self._flusher is None and self.rank == 0:
+            from .metrics import maybe_start_flusher
+            self._flusher = maybe_start_flusher(
+                self.metrics, getattr(self.conf, "outputPath", ""))
+        if self._obs_server is None and self.rank == 0:
+            from .obs.http import maybe_start_obs_server
+            self._obs_server = maybe_start_obs_server(
+                self.metrics.summary, role="trainer")
         self._thread = threading.Thread(target=self._run_train,
                                         daemon=True)
         self._thread.start()
@@ -204,6 +217,12 @@ class CaffeProcessor:
                 self._snapshotter.wait(timeout=600)
             except BaseException as e:      # noqa: BLE001
                 snap_err = e                # must not mask train error
+        if self._obs_server is not None:
+            self._obs_server.stop()
+            self._obs_server = None
+        if self._flusher is not None:       # final flush at stop
+            self._flusher.stop()
+            self._flusher = None
         self._dump_metrics()
         CaffeProcessor._instance = None
         if self._error is not None:
@@ -484,6 +503,10 @@ class CaffeProcessor:
                         or checkpoint.state_is_sharded(st):
                     self._snapshot(final=True, export_params=export_p)
         except BaseException as e:     # surfaced on stop()/join()
+            from .obs.recorder import maybe_dump, record
+            record("trainer", "fatal",
+                   error=f"{type(e).__name__}: {e}")
+            maybe_dump("fatal_exception")
             self._error = e
         finally:
             # tear the pipeline down in dependency order: close the

@@ -50,6 +50,8 @@ import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from ..metrics import PipelineMetrics
+from ..obs.recorder import record as record_event
+from ..obs.trace import get_tracer
 
 _LOG = logging.getLogger(__name__)
 
@@ -136,9 +138,10 @@ def bucket_for(n: int, buckets: Sequence[int]) -> int:
 
 class _Request:
     __slots__ = ("record", "deadline", "t_submit", "_event", "_row",
-                 "_error", "version")
+                 "_error", "version", "trace")
 
-    def __init__(self, record, deadline: Optional[float]):
+    def __init__(self, record, deadline: Optional[float],
+                 trace=None):
         self.record = record
         self.deadline = deadline          # time.monotonic() or None
         self.t_submit = time.monotonic()
@@ -146,6 +149,9 @@ class _Request:
         self._row = None
         self._error: Optional[BaseException] = None
         self.version: Optional[int] = None
+        # obs.trace.SpanCtx of the submitting request's server span
+        # (None = untraced — the hot path checks exactly this)
+        self.trace = trace
 
     def complete(self, row, version: Optional[int]):
         self._row = row
@@ -294,6 +300,7 @@ class MicroBatcher:
             depth = 4 * self.max_batch
         self.default_timeout_ms = default_timeout_ms
         self.metrics = metrics or PipelineMetrics()
+        self._tracer = get_tracer()
         self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
         # assembler → executor handoff; depth 1 so at most one flush is
         # staged ahead of the one executing (deeper staging would age
@@ -329,6 +336,8 @@ class MicroBatcher:
         """Reject new submits; with drain, everything already queued is
         flushed before the dispatcher exits, else pending requests fail
         with ServingStopped."""
+        record_event("batcher", "stop", drain=drain,
+                     queued=self._q.qsize())
         # _drain must be visible before _stopping: the dispatcher reads
         # them in the reverse order, so a reordered pair could flush a
         # no-drain stop's backlog
@@ -377,13 +386,13 @@ class MicroBatcher:
                     r.fail(ServingStopped("serving stopped"))
 
     # -- submit -------------------------------------------------------
-    def submit(self, record, timeout_ms: Optional[float] = None
-               ) -> PendingResult:
+    def submit(self, record, timeout_ms: Optional[float] = None,
+               trace=None) -> PendingResult:
         tmo = timeout_ms if timeout_ms is not None \
             else self.default_timeout_ms
         deadline = (time.monotonic() + tmo / 1e3
                     if tmo is not None else None)
-        req = _Request(record, deadline)
+        req = _Request(record, deadline, trace=trace)
         with self._submit_lock:
             if self._stopping:
                 raise ServingStopped("serving is stopping")
@@ -397,8 +406,8 @@ class MicroBatcher:
         return PendingResult(req)
 
     def submit_many(self, records: Sequence[Any],
-                    timeout_ms: Optional[float] = None
-                    ) -> List[PendingResult]:
+                    timeout_ms: Optional[float] = None,
+                    trace=None) -> List[PendingResult]:
         """All-or-nothing multi-record submit: either every record is
         enqueued or none is.  Per-record submit would strand the
         already-accepted prefix of a list that hits queue-full — those
@@ -422,7 +431,8 @@ class MicroBatcher:
                     f"{len(records)} records do not fit the request "
                     f"queue (depth {self._q.maxsize}) — service "
                     "saturated or list larger than the queue")
-            reqs = [_Request(r, deadline) for r in records]
+            reqs = [_Request(r, deadline, trace=trace)
+                    for r in records]
             for req in reqs:
                 self._q.put_nowait(req)
         return [PendingResult(r) for r in reqs]
@@ -572,17 +582,48 @@ class MicroBatcher:
         bucket = bucket_for(len(live), self.buckets)
         m.gauge("queue_depth", self._q.qsize())
         m.gauge("batch_fill", len(live) / bucket)
+        # tracing (inert when nothing in this flush carries a ctx):
+        # per traced request a back-dated queue_wait span (submit ->
+        # flush pickup), then the whole-flush execution under the
+        # first traced context so the model hook's pack/fwd spans
+        # nest beneath it
+        traced = [r for r in live if r.trace is not None]
         t0 = time.monotonic()
+        if traced:
+            seen = set()
+            for r in traced:
+                if r.trace in seen:
+                    continue        # co-submitted siblings share a ctx
+                seen.add(r.trace)
+                self._tracer.record_span(
+                    "serve.queue_wait", r.trace, t0 - r.t_submit)
         try:
-            rows, version = self.run_batch([r.record for r in live],
-                                           bucket)
+            with self._tracer.activate(traced[0].trace
+                                       if traced else None):
+                rows, version = self.run_batch(
+                    [r.record for r in live], bucket)
         except BaseException as e:     # noqa: BLE001 — per-flush fault
             _LOG.warning("serving flush failed: %s", e)
             m.incr("failed_flushes")
+            record_event("batcher", "flush_failed",
+                         error=f"{type(e).__name__}: {e}",
+                         batch=len(live))
+            if traced:
+                done = time.monotonic()
+                for ctx in {r.trace for r in traced}:
+                    self._tracer.record_span(
+                        "serve.exec", ctx, done - t0, bucket=bucket,
+                        batch=len(live),
+                        error=f"{type(e).__name__}: {e}")
             for r in live:
                 r.fail(e)
             return
         done = time.monotonic()
+        if traced:
+            for ctx in {r.trace for r in traced}:
+                self._tracer.record_span(
+                    "serve.exec", ctx, done - t0, bucket=bucket,
+                    batch=len(live), padded=bucket - len(live))
         m.add("fwd_flush", done - t0)
         if not self._first_flush_seen:
             self._first_flush_seen = True

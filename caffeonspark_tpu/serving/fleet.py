@@ -39,6 +39,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..metrics import PipelineMetrics
+from ..obs.recorder import record as record_event
 from ..tools.supervisor import terminate_processes
 from .batcher import _env_int
 from .retry import RetryPolicy
@@ -319,6 +320,9 @@ class Fleet:
             _LOG.warning("fleet: %s died (rc=%s) — restarting "
                          "(%d/%d)", name, rep.proc.returncode,
                          rep.restart_count, self.max_restarts)
+            record_event("fleet", "replica_died", replica=name,
+                         rc=rep.proc.returncode,
+                         restart=rep.restart_count)
             self.metrics.incr("replica_restarts")
             self.router.note_restart(name)
             t0 = time.monotonic()
@@ -346,9 +350,14 @@ class Fleet:
                 self.router.set_state(name, OK)
                 self.metrics.add("replica_rejoin",
                                  time.monotonic() - t0)
+                record_event("fleet", "replica_rejoined",
+                             replica=name, url=rep.url,
+                             wall_s=round(time.monotonic() - t0, 3))
             else:
                 _LOG.error("fleet: restarted %s failed to become "
                            "healthy", name)
+                record_event("fleet", "restart_unhealthy",
+                             replica=name)
 
     def _heal_respawn_model(self, rep: ReplicaProcess) -> None:
         """Reload a freshly-respawned replica onto the fleet's
@@ -470,6 +479,7 @@ class Fleet:
             raise RuntimeError(
                 "rollback: no recorded default model (fleet launched "
                 "without -model/-weights and never rolled)")
+        record_event("fleet", "rollback_start", model=target)
         versions: Dict[str, int] = {}
         fail_kinds = TRANSPORT_ERRORS + (RouterRequestError,
                                          TimeoutError, OSError,
@@ -517,6 +527,8 @@ class Fleet:
                            name, e)
                 continue
         self.metrics.incr("rollbacks")
+        record_event("fleet", "rollback_done", model=target,
+                     rerolled=sorted(versions))
         return versions
 
     def publish_model(self, spec: dict) -> Dict[str, dict]:

@@ -31,7 +31,22 @@ Routes:
                      live state)
   GET  /metrics      serving metrics (PipelineMetrics JSON, plus
                      queue_depth_now / per-bucket flush counters /
-                     per-model `models` block)
+                     per-model `models` block); `?format=prom`
+                     renders the same summary as Prometheus
+                     exposition (obs/prom.py)
+  GET  /v1/traces    this process's finished trace spans
+                     (obs/trace.py ring; `?trace=<id>` filters) —
+                     the router aggregates these across replicas
+  POST /v1/profile   {"duration_ms": N} → bounded jax.profiler
+                     capture on the LIVE replica; answers the
+                     TensorBoard-loadable trace dir (409 while one
+                     is already running)
+
+Distributed tracing: an inbound `X-COS-Trace: <trace>:<span>` header
+(or this process's own COS_TRACE_SAMPLE draw) opens a
+`replica.request` span whose context threads through the batcher —
+queue-wait / pack / forward / execution spans nest under it.  With
+no header and sampling off (the default) the whole path is inert.
 
 Status mapping: 429 queue-full fast-reject, 504 deadline exceeded,
 400 malformed request, 404 unknown model, 503 draining or model
@@ -48,6 +63,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
+from ..obs import profiler
+from ..obs.prom import render_summary
+from ..obs.trace import TRACE_HEADER, get_tracer
 from .batcher import DeadlineExceeded, QueueFullError, ServingStopped
 
 _LOG = logging.getLogger(__name__)
@@ -70,6 +88,44 @@ class JsonHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code: int, text: str,
+                   ctype: str = "text/plain; version=0.0.4"):
+        """Plain-text response (the Prometheus exposition content
+        type by default)."""
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _handle_profile(self):
+        """POST /v1/profile: bounded jax.profiler capture on the live
+        process (shared by the replica front end and the training
+        metrics port); 409 while one is already running."""
+        try:
+            req = self._read_json()
+            out = profiler.capture(req.get("duration_ms") or 0,
+                                   log_dir=str(req.get("dir") or ""))
+        except profiler.ProfilerBusy as e:
+            self._send(409, {"error": str(e)})
+        except (ValueError, json.JSONDecodeError, TypeError) as e:
+            self._send(400, {"error": str(e)})
+        except Exception as e:     # noqa: BLE001 — capture fault
+            self._send(503, {"error": f"{type(e).__name__}: {e}"})
+        else:
+            self._send(200, dict(out, ok=True))
+
+    def _handle_traces(self, q):
+        """GET /v1/traces[?trace=][&limit=]: this process's finished
+        spans from the tracer ring, oldest first."""
+        try:
+            limit = int(q.get("limit", 1024))
+        except ValueError:
+            limit = 1024
+        self._send(200, {"spans": get_tracer().recent(
+            q.get("trace"), limit=limit)})
+
     def log_message(self, fmt, *args):      # route to logging, not stderr
         _LOG.debug(self.log_prefix + fmt, *args)
 
@@ -90,7 +146,7 @@ class _Handler(JsonHandler):
     # self.server is the ServingHTTPServer below
     def do_GET(self):
         svc = self.server.service
-        path, _q = self._route()
+        path, q = self._route()
         if path == "/healthz":
             # version COUNTER, never current(): the health poll must
             # not force a page-in (and LRU-touch) of the default
@@ -121,7 +177,17 @@ class _Handler(JsonHandler):
                 out["mesh"] = mesh
             self._send(200, out)
         elif path == "/metrics":
-            self._send(200, svc.metrics_summary())
+            summary = svc.metrics_summary()
+            if q.get("format") == "prom":
+                # Prometheus exposition of the same summary dict the
+                # JSON route answers (obs/prom.py — one bookkeeping
+                # path, two renderings)
+                self._send_text(200, render_summary(
+                    summary, {"role": "replica"}))
+            else:
+                self._send(200, summary)
+        elif path == "/v1/traces":
+            self._handle_traces(q)
         elif path == "/v1/models":
             self._send(200, {"models": svc.models_summary()})
         else:
@@ -132,6 +198,8 @@ class _Handler(JsonHandler):
         path, q = self._route()
         if path == "/v1/predict":
             self._predict(svc, q)
+        elif path == "/v1/profile":
+            self._handle_profile()
         elif path == "/v1/models":
             self._add_model(svc)
         elif path == "/v1/drain":
@@ -199,6 +267,17 @@ class _Handler(JsonHandler):
                              "model_version": version})
 
     def _predict(self, svc, q):
+        # distributed tracing: adopt the router's (or client's)
+        # X-COS-Trace context, else draw this process's own sample;
+        # both off -> sp is the inert NULL_SPAN and trace stays None
+        # through the whole submit path (byte-identical hot path)
+        tracer = get_tracer("replica")
+        parent = tracer.from_header(self.headers.get(TRACE_HEADER))
+        with tracer.span("replica.request", parent=parent,
+                         root=tracer.sample_root()) as sp:
+            self._predict_traced(svc, q, sp)
+
+    def _predict_traced(self, svc, q, sp):
         try:
             req = self._read_json()
             if not isinstance(req, dict):
@@ -225,7 +304,7 @@ class _Handler(JsonHandler):
             # all-or-nothing: queue-full must not strand an already-
             # submitted prefix that still executes after the 429
             pending = svc.submit_many(records, timeout_ms=timeout_ms,
-                                      model=model)
+                                      model=model, trace=sp.ctx)
         except KeyError as e:
             self._send(404, {"error": str(e)})
             return
@@ -250,7 +329,9 @@ class _Handler(JsonHandler):
                "model_version": pending[-1].model_version}
         if model is not None:
             out["model"] = model
-        self._send(200, out)
+        sp.set("rows", len(rows))
+        with get_tracer().span("replica.respond", parent=sp.ctx):
+            self._send(200, out)
 
 
 class ServingHTTPServer(ThreadingHTTPServer):

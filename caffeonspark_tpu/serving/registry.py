@@ -51,6 +51,7 @@ import numpy as np
 
 from .. import checkpoint
 from ..metrics import PipelineMetrics
+from ..obs.recorder import record as record_event
 from ..net import Net, Params
 from ..proto import NetParameter, NetState, Phase, SolverParameter
 from . import quant
@@ -306,6 +307,9 @@ class ModelRegistry:
         _LOG.info("model registry: %s version %d <- %s (%s, %.1f MB "
                   "resident)", entry.name, mv.version, path, wd,
                   nbytes / 2**20)
+        record_event("registry", "published", model=entry.name,
+                     version=mv.version, weight_dtype=wd,
+                     mb=round(nbytes / 2**20, 3))
         return mv
 
     def _publish_sidecar(self, entry: _ModelEntry, sidecar: str,
@@ -429,6 +433,8 @@ class ModelRegistry:
         assert victim.current is not None
         _LOG.info("model registry: paging OUT %s (%.1f MB, LRU)",
                   victim.name, victim.current.nbytes / 2**20)
+        record_event("registry", "evicted", model=victim.name,
+                     mb=round(victim.current.nbytes / 2**20, 3))
         victim.current = victim.current._replace(params=None,
                                                  scales=None)
         victim.resident = False
@@ -488,6 +494,9 @@ class ModelRegistry:
             _LOG.info("model registry: paged IN %s (%.1f MB, "
                       "%.1f ms)", entry.name, mv.nbytes / 2**20,
                       wall * 1e3)
+            record_event("registry", "paged_in", model=entry.name,
+                         mb=round(mv.nbytes / 2**20, 3),
+                         wall_ms=round(wall * 1e3, 1))
             return mv
 
     # -- read side ------------------------------------------------------

@@ -49,6 +49,9 @@ from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
 
 from ..metrics import PipelineMetrics
+from ..obs.prom import PromWriter
+from ..obs.recorder import record as record_event
+from ..obs.trace import TRACE_HEADER, get_tracer
 from .retry import RetryPolicy, retry_call
 
 _LOG = logging.getLogger(__name__)
@@ -91,15 +94,21 @@ class RouterRequestError(RuntimeError):
 
 
 def http_json(url: str, *, data: Optional[bytes] = None,
-               timeout: float = 30.0, method: Optional[str] = None
+               timeout: float = 30.0, method: Optional[str] = None,
+               headers: Optional[Dict[str, str]] = None
                ) -> Tuple[int, dict]:
     """One HTTP exchange, JSON both ways.  Non-2xx returns (code,
     parsed body) instead of raising so callers classify by status;
-    transport failures raise OSError/URLError."""
+    transport failures raise OSError/URLError.  `headers` add to (and
+    may override) the default content type — the trace context rides
+    here."""
+    hdrs = {"Content-Type": "application/json"}
+    if headers:
+        hdrs.update(headers)
     req = urllib.request.Request(
         url, data=data, method=method or ("POST" if data is not None
                                           else "GET"),
-        headers={"Content-Type": "application/json"})
+        headers=hdrs)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.status, json.loads(r.read() or b"{}")
@@ -143,6 +152,7 @@ class Router:
         self.http_timeout_s = http_timeout_s
         self.health_timeout_s = health_timeout_s
         self.metrics = metrics or PipelineMetrics()
+        self._tracer = get_tracer("router")
         self._health_thread: Optional[threading.Thread] = None
         self._health_stop = threading.Event()
         for name, url in (endpoints or {}).items():
@@ -169,10 +179,15 @@ class Router:
     def set_state(self, name: str, state: str) -> None:
         with self._lock:
             rep = self._replicas.get(name)
-            if rep is not None and rep.state != state:
-                _LOG.info("router: replica %s %s -> %s", name,
-                          rep.state, state)
-                rep.state = state
+            if rep is None or rep.state == state:
+                return
+            prev = rep.state
+            rep.state = state
+        _LOG.info("router: replica %s %s -> %s", name, prev, state)
+        # flight recorder: the drain/down timeline a post-mortem
+        # reconstructs (recorded OUTSIDE the table lock — COS005)
+        record_event("router", "state", replica=name,
+                     prev=prev, state=state)
 
     def _apply_poll(self, name: str, url: str, prev: str,
                     status: str) -> None:
@@ -181,14 +196,19 @@ class Router:
         issued — a concurrent drain (set after the snapshot but before
         the stale 'ok' response landed) or a restart's update_url
         supersedes the result; the next poll sees fresh state."""
+        changed = False
         with self._lock:
             rep = self._replicas.get(name)
             if rep is None or rep.url != url or rep.state != prev:
                 return
             if rep.state != status:
-                _LOG.info("router: replica %s %s -> %s", name,
-                          rep.state, status)
                 rep.state = status
+                changed = True
+        if changed:
+            _LOG.info("router: replica %s %s -> %s", name, prev,
+                      status)
+            record_event("router", "state", replica=name,
+                         prev=prev, state=status, via="health_poll")
 
     def replica_url(self, name: str) -> str:
         with self._lock:
@@ -246,7 +266,7 @@ class Router:
     # -- request path -------------------------------------------------
     def predict(self, payload,
                 timeout_s: Optional[float] = None,
-                query: str = "") -> dict:
+                query: str = "", trace=None) -> dict:
         """Route one /v1/predict body; returns the replica's parsed
         response.  `payload` is a dict (programmatic callers) or
         pre-encoded JSON bytes — the HTTP front door passes the raw
@@ -255,9 +275,15 @@ class Router:
         chokepoint.  `query` is the client's raw query string
         (`model=<name>` multi-model routing rides there as well as in
         the JSON body) — forwarded verbatim so name routing survives
-        the proxy hop.  Retryable failures re-pick (usually a
-        different replica — the failed one is marked down or has
-        higher outstanding); non-retryable replica errors surface as
+        the proxy hop.  `trace` (a SpanCtx) threads distributed
+        tracing through: every ATTEMPT gets its own span under one
+        trace — a retried request is one trace with N attempts, never
+        N orphans — and the context forwards to the replica as
+        X-COS-Trace (the raw-passthrough body is untouched; the
+        context rides in the HEADER, which is what lets it survive
+        this path).  Retryable failures re-pick (usually a different
+        replica — the failed one is marked down or has higher
+        outstanding); non-retryable replica errors surface as
         RouterRequestError with the original status."""
         data = (payload if isinstance(payload, (bytes, bytearray))
                 else json.dumps(payload).encode())
@@ -265,45 +291,62 @@ class Router:
         route_path = "/v1/predict" + (f"?{query}" if query else "")
         t0 = time.monotonic()
         last_failed: List[Optional[str]] = [None]
+        attempt_i = [0]
 
         def attempt() -> dict:
             rep = self._pick(avoid=last_failed[0])
             last_failed[0] = rep.name
+            attempt_i[0] += 1
             failed = True
-            try:
+            with self._tracer.span("router.attempt",
+                                   parent=trace) as sp:
+                sp.set("replica", rep.name)
+                sp.set("attempt", attempt_i[0])
+                hdrs = ({TRACE_HEADER: sp.header()}
+                        if sp.ctx is not None else None)
                 try:
-                    code, body = http_json(rep.url + route_path,
-                                            data=data, timeout=timeout)
-                except TRANSPORT_ERRORS + (ValueError,) as e:
-                    # ValueError: a 200 whose body does not parse — a
-                    # replica that broken is as routable-around as a
-                    # refused connection
-                    # transport failure: the replica is gone or
-                    # wedged — stop routing to it before the next
-                    # health poll would notice
-                    self.set_state(rep.name, DOWN)
-                    self.metrics.incr("retry_conn")
-                    raise RouteRetryable(
-                        f"{rep.name}: {e}") from e
-                if code == 429:
-                    self.metrics.incr("retry_429")
-                    raise RouteRetryable(f"{rep.name}: 429 queue full")
-                if code == 503:
-                    # draining/stopping (or a model fault — bounded
-                    # retries against a peer are the right call for
-                    # both: the drain case must not surface, and a
-                    # deterministic fault fails on every peer anyway)
-                    self.metrics.incr("retry_503")
-                    raise RouteRetryable(
-                        f"{rep.name}: 503 {body.get('error', '')}")
-                if code >= 400:
-                    raise RouterRequestError(code, body)
-                failed = False
-                return body
-            finally:
-                self._done(rep, failed=failed)
+                    try:
+                        code, body = http_json(
+                            rep.url + route_path, data=data,
+                            timeout=timeout, headers=hdrs)
+                    except TRANSPORT_ERRORS + (ValueError,) as e:
+                        # ValueError: a 200 whose body does not parse
+                        # — a replica that broken is as
+                        # routable-around as a refused connection
+                        # transport failure: the replica is gone or
+                        # wedged — stop routing to it before the next
+                        # health poll would notice
+                        self.set_state(rep.name, DOWN)
+                        self.metrics.incr("retry_conn")
+                        sp.set("outcome", "transport_error")
+                        raise RouteRetryable(
+                            f"{rep.name}: {e}") from e
+                    if code == 429:
+                        self.metrics.incr("retry_429")
+                        sp.set("outcome", "429")
+                        raise RouteRetryable(
+                            f"{rep.name}: 429 queue full")
+                    if code == 503:
+                        # draining/stopping (or a model fault —
+                        # bounded retries against a peer are the
+                        # right call for both: the drain case must
+                        # not surface, and a deterministic fault
+                        # fails on every peer anyway)
+                        self.metrics.incr("retry_503")
+                        sp.set("outcome", "503")
+                        raise RouteRetryable(
+                            f"{rep.name}: 503 "
+                            f"{body.get('error', '')}")
+                    if code >= 400:
+                        sp.set("outcome", str(code))
+                        raise RouterRequestError(code, body)
+                    failed = False
+                    sp.set("outcome", "ok")
+                    return body
+                finally:
+                    self._done(rep, failed=failed)
 
-        def on_retry(err, attempt_i):
+        def on_retry(err, attempt_i_):
             self.metrics.incr("retries")
 
         out = retry_call(
@@ -375,6 +418,7 @@ class Router:
         version before a reload)."""
         url = self.replica_url(name)
         prev = self.states().get(name, OK)
+        record_event("router", "drain", replica=name)
         self._set_drain_intent(name, True)
         self.set_state(name, DRAINING)
         try:
@@ -423,6 +467,7 @@ class Router:
 
     def undrain_replica(self, name: str) -> None:
         url = self.replica_url(name)
+        record_event("router", "undrain", replica=name)
         # intent cleared up front: even if the POST below fails, the
         # poller may now lift DRAINING once the replica reports ok
         self._set_drain_intent(name, False)
@@ -464,24 +509,39 @@ class Router:
         body_req: Dict[str, str] = {"model": model_path}
         if model_name is not None:
             body_req["name"] = model_name
-        for idx, name in enumerate(self.names()):
-            self.drain_replica(name, wait_idle_s=wait_idle_s)
-            if before_reload is not None:
-                before_reload(name, idx)
-            url = self.replica_url(name)
-            code, body = http_json(
-                url + "/v1/reload",
-                data=json.dumps(body_req).encode(),
-                timeout=max(self.http_timeout_s, 60.0))
-            if code != 200:
-                # leave the replica draining (it still serves nothing)
-                # rather than re-admitting a version we cannot name
-                raise RouterRequestError(code, body)
-            if on_reloaded is not None:
-                on_reloaded(name)
-            self.undrain_replica(name)
-            versions[name] = body.get("model_version", -1)
-            self.metrics.incr("replica_reloads")
+        record_event("router", "rolling_reload_start",
+                     model=model_path, name=model_name)
+        try:
+            for idx, name in enumerate(self.names()):
+                self.drain_replica(name, wait_idle_s=wait_idle_s)
+                if before_reload is not None:
+                    before_reload(name, idx)
+                url = self.replica_url(name)
+                code, body = http_json(
+                    url + "/v1/reload",
+                    data=json.dumps(body_req).encode(),
+                    timeout=max(self.http_timeout_s, 60.0))
+                if code != 200:
+                    # leave the replica draining (it still serves
+                    # nothing) rather than re-admitting a version we
+                    # cannot name
+                    raise RouterRequestError(code, body)
+                if on_reloaded is not None:
+                    on_reloaded(name)
+                self.undrain_replica(name)
+                versions[name] = body.get("model_version", -1)
+                record_event("router", "replica_reloaded",
+                             replica=name,
+                             version=versions[name])
+                self.metrics.incr("replica_reloads")
+        except BaseException as e:
+            record_event("router", "rolling_reload_failed",
+                         model=model_path,
+                         error=f"{type(e).__name__}: {e}",
+                         swapped=sorted(versions))
+            raise
+        record_event("router", "rolling_reload_done",
+                     model=model_path, replicas=len(versions))
         self.metrics.incr("rolling_reloads")   # one per OPERATION
         return versions
 
@@ -550,6 +610,68 @@ class Router:
                     a["resident_on"].append(rname)
         return agg
 
+    # -- observability aggregation ------------------------------------
+    def collect_traces(self, trace_id: Optional[str] = None,
+                       limit: int = 1024) -> List[dict]:
+        """Cross-replica trace view: this process's spans (router
+        request/attempt) merged with every routable replica's
+        `/v1/traces` ring, sorted by start timestamp — one slow
+        request decomposes into which hop ate the latency without
+        ssh-ing into N processes.  Operator cadence, never the
+        request path."""
+        spans = list(self._tracer.recent(trace_id, limit=limit))
+        with self._lock:
+            targets = [(r.name, r.url)
+                       for r in self._replicas.values()
+                       if r.state in (OK, DRAINING)]
+        q = f"?limit={limit}" + (f"&trace={trace_id}"
+                                 if trace_id else "")
+        for _name, url in targets:
+            try:
+                code, body = http_json(url + "/v1/traces" + q,
+                                       timeout=self.health_timeout_s)
+            except TRANSPORT_ERRORS + (ValueError,):
+                continue
+            if code == 200:
+                spans.extend(body.get("spans") or [])
+        # dedupe by span id: co-located replicas (tests, in-process
+        # fleets) share one process ring, so the same span can come
+        # back from several fetches
+        seen = set()
+        unique = []
+        for s in spans:
+            sid = s.get("span_id")
+            if sid in seen:
+                continue
+            seen.add(sid)
+            unique.append(s)
+        unique.sort(key=lambda s: s.get("ts", 0.0))
+        return unique[-limit:]
+
+    def prom_summary(self) -> str:
+        """Fleet-aggregated Prometheus exposition: the router's own
+        summary (role="router") plus each routable replica's
+        /metrics summary re-rendered under its replica label — one
+        scrape, one family set, every process.  Replica fetches are
+        per-scrape HTTP round-trips: scraper cadence, not the request
+        path."""
+        w = PromWriter()
+        w.add_summary(self.metrics_summary(), {"role": "router"})
+        with self._lock:
+            targets = [(r.name, r.url)
+                       for r in self._replicas.values()
+                       if r.state in (OK, DRAINING)]
+        for name, url in targets:
+            try:
+                code, body = http_json(url + "/metrics",
+                                       timeout=self.health_timeout_s)
+            except TRANSPORT_ERRORS + (ValueError,):
+                continue
+            if code == 200 and isinstance(body, dict):
+                w.add_summary(body, {"role": "replica",
+                                     "replica": name})
+        return w.render()
+
     # -- reporting ----------------------------------------------------
     def metrics_summary(self) -> dict:
         out = self.metrics.summary()
@@ -581,7 +703,7 @@ def _make_handler():
 
         def do_GET(self):
             router: Router = self.server.router
-            path, _q = self._route()
+            path, q = self._route()
             if path == "/healthz":
                 states = router.states()
                 n_ok = sum(1 for s in states.values() if s == OK)
@@ -591,7 +713,19 @@ def _make_handler():
                            {"ok": bool(n_ok), "status": status,
                             "replicas": states})
             elif path == "/metrics":
-                self._send(200, router.metrics_summary())
+                if q.get("format") == "prom":
+                    # fleet-aggregated exposition: router + every
+                    # routable replica under one family set
+                    self._send_text(200, router.prom_summary())
+                else:
+                    self._send(200, router.metrics_summary())
+            elif path == "/v1/traces":
+                try:
+                    limit = int(q.get("limit", 1024))
+                except ValueError:
+                    limit = 1024
+                self._send(200, {"spans": router.collect_traces(
+                    q.get("trace"), limit=limit)})
             elif path == "/v1/models":
                 # fleet-wide per-model aggregation (name-keyed sums +
                 # worst p99 + residency map) — operator cadence, so
@@ -623,25 +757,38 @@ def _make_handler():
                                {"ok": ok, "replicas": out})
                 return
             if self.path.split("?", 1)[0] == "/v1/predict":
-                try:
-                    # raw pass-through: the replica parses/validates
-                    # the body; decoding + re-encoding thousands of
-                    # pixel floats here would double router CPU — the
-                    # query string (?model=) forwards verbatim too
-                    n = int(self.headers.get("Content-Length", 0))
-                    out = router.predict(
-                        self.rfile.read(n) if n else b"{}",
-                        query=urlsplit(self.path).query)
-                except RouterRequestError as e:
-                    self._send(e.code, e.body)
-                except (RouteRetryable, NoReplicaAvailable) as e:
-                    # retries exhausted: the fleet really is saturated
-                    # or down — surface as 503 (try again later)
-                    self._send(503, {"error": str(e)})
-                except (ValueError, json.JSONDecodeError) as e:
-                    self._send(400, {"error": str(e)})
-                else:
-                    self._send(200, out)
+                # trace context: adopt the client's X-COS-Trace or
+                # mint one by this process's sampling draw; the BODY
+                # stays raw-passthrough — the context survives this
+                # path because it rides in the header, never the
+                # payload (trace-context hardening)
+                tracer = get_tracer("router")
+                parent = tracer.from_header(
+                    self.headers.get(TRACE_HEADER))
+                with tracer.span("router.request", parent=parent,
+                                 root=tracer.sample_root()) as sp:
+                    try:
+                        # raw pass-through: the replica parses/
+                        # validates the body; decoding + re-encoding
+                        # thousands of pixel floats here would double
+                        # router CPU — the query string (?model=)
+                        # forwards verbatim too
+                        n = int(self.headers.get("Content-Length", 0))
+                        out = router.predict(
+                            self.rfile.read(n) if n else b"{}",
+                            query=urlsplit(self.path).query,
+                            trace=sp.ctx)
+                    except RouterRequestError as e:
+                        self._send(e.code, e.body)
+                    except (RouteRetryable, NoReplicaAvailable) as e:
+                        # retries exhausted: the fleet really is
+                        # saturated or down — surface as 503 (try
+                        # again later)
+                        self._send(503, {"error": str(e)})
+                    except (ValueError, json.JSONDecodeError) as e:
+                        self._send(400, {"error": str(e)})
+                    else:
+                        self._send(200, out)
             elif self.path == "/v1/reload":
                 try:
                     # the fleet's reload_fn (when fronting a Fleet)

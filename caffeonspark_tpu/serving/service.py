@@ -34,6 +34,8 @@ import numpy as np
 
 from ..data.source import DataSource, ImageRecord, get_source
 from ..metrics import PipelineMetrics
+from ..obs.recorder import record as record_event
+from ..obs.trace import get_tracer
 from .batcher import (FlushLanes, MicroBatcher, PendingResult,
                       QueueFullError, ServingStopped)
 from .retry import RetryPolicy, retry_call
@@ -110,6 +112,7 @@ class InferenceService:
                  metrics: Optional[PipelineMetrics] = None):
         self.conf = conf
         self.metrics = metrics or PipelineMetrics()
+        self._tracer = get_tracer("replica")
         self.registry = ModelRegistry.from_conf(conf,
                                                 metrics=self.metrics)
         model = (getattr(conf, "snapshotModelFile", "")
@@ -309,6 +312,7 @@ class InferenceService:
         while everything already accepted still flushes — the replica-
         side half of the fleet's rolling hot-swap.  Unlike stop(), the
         dispatcher stays up and undraining is instant."""
+        record_event("service", "draining" if flag else "undrained")
         self._draining = bool(flag)
 
     # -- multi-model management ---------------------------------------
@@ -407,18 +411,26 @@ class InferenceService:
         real = len(buf)
         buf = buf + [buf[-1]] * (bucket - real)
         t0 = time.monotonic()
-        batch = sm.source.next_batch(buf)
-        m.add("pack", time.monotonic() - t0)
-        batch = sm.source.apply_device_stage(batch)
+        # span() is inert unless the batcher activated a traced
+        # request's context around this flush (obs/trace.py); the
+        # pack SERIES keeps its historical extent (next_batch only)
+        # while the span also covers the device staging
+        with self._tracer.span("serve.pack") as sp:
+            sp.set("bucket", bucket).set("padded", bucket - real)
+            batch = sm.source.next_batch(buf)
+            m.add("pack", time.monotonic() - t0)
+            batch = sm.source.apply_device_stage(batch)
         fwd = self.registry.forward_for(model)(
             sm.blob_names, weight_dtype=mv.weight_dtype)
         t0 = time.monotonic()
-        if mv.weight_dtype == "f32":
-            out = fwd(mv.params, batch)
-        else:
-            out = fwd(mv.params, mv.scales or {}, batch)
-        rows = fetch_rows(out, sm.blob_names, ids, real=real,
-                          bs=bucket)
+        with self._tracer.span("serve.fwd") as sp:
+            sp.set("bucket", bucket).set("model", model)
+            if mv.weight_dtype == "f32":
+                out = fwd(mv.params, batch)
+            else:
+                out = fwd(mv.params, mv.scales or {}, batch)
+            rows = fetch_rows(out, sm.blob_names, ids, real=real,
+                              bs=bucket)
         m.add("fwd", time.monotonic() - t0)
         if self._recompile_guard is not None:
             self._recompile_guard.check()
@@ -433,10 +445,12 @@ class InferenceService:
         return sm
 
     def submit(self, record, timeout_ms: Optional[float] = None,
-               model: Optional[str] = None) -> PendingResult:
+               model: Optional[str] = None,
+               trace=None) -> PendingResult:
         """Coercion/validation happens HERE, per request — a malformed
         record must be the submitter's error (HTTP 400), never a flush
-        failure that poisons every co-batched request."""
+        failure that poisons every co-batched request.  `trace` is the
+        submitting request's SpanCtx (None = untraced)."""
         if self._draining:
             raise ServingStopped("replica is draining")
         sm = self._served(model)
@@ -444,12 +458,13 @@ class InferenceService:
             record = coerce_record(record, sm.record_dims())
         sm.metrics.incr("requests")
         return self.lanes.lane(sm.name).submit(record,
-                                               timeout_ms=timeout_ms)
+                                               timeout_ms=timeout_ms,
+                                               trace=trace)
 
     def submit_many(self, records: Sequence[Any],
                     timeout_ms: Optional[float] = None,
-                    model: Optional[str] = None
-                    ) -> List[PendingResult]:
+                    model: Optional[str] = None,
+                    trace=None) -> List[PendingResult]:
         """Coerce EVERY record first (a malformed one rejects the list
         before anything is enqueued), then enqueue all-or-nothing — a
         partially-admitted list would execute abandoned rows after its
@@ -462,7 +477,7 @@ class InferenceService:
                    for r in records]
         sm.metrics.incr("requests", len(coerced))
         return self.lanes.lane(sm.name).submit_many(
-            coerced, timeout_ms=timeout_ms)
+            coerced, timeout_ms=timeout_ms, trace=trace)
 
     def reload(self, model_path: str,
                model: Optional[str] = None) -> int:
@@ -471,6 +486,9 @@ class InferenceService:
         Clears draining: a reload is how a drained replica rejoins the
         rotation (rolling swap)."""
         version = self.registry.load(model_path, model=model).version
+        record_event("service", "reloaded",
+                     model=model or DEFAULT_MODEL, version=version,
+                     path=model_path)
         self._draining = False
         return version
 

@@ -81,6 +81,8 @@ import random
 import time
 from typing import NamedTuple, Optional, Tuple
 
+from ..obs.recorder import maybe_dump as _recorder_dump
+from ..obs.recorder import record as _record
 from ..utils.envutils import env_num as _env_float
 
 
@@ -276,6 +278,10 @@ class ChaosInjector:
             open(marker, "w").close()
             print(f"FAULT INJECTION: rank {rank} dying at iter {it}",
                   flush=True)
+            # fault latch: the flight recorder is the only artifact
+            # this process leaves — os._exit skips every finally
+            _record("chaos", "die_once", rank=rank, iter=it)
+            _recorder_dump("fault_latch")
             os._exit(3)
 
     # -- sync-layer injectors ------------------------------------------
@@ -311,6 +317,7 @@ class ChaosInjector:
             self.injected["canary_kills"] += 1
             print(f"FAULT INJECTION: killing canary after "
                   f"{requests_sent} eval requests", flush=True)
+            _record("chaos", "canary_kill", requests=requests_sent)
             return True
         return False
 
@@ -323,6 +330,7 @@ class ChaosInjector:
         if not marker or not self._fire_once(marker):
             return False
         self.injected["snapshot_truncations"] += 1
+        _record("chaos", "snapshot_truncate", paths=list(paths))
         for p in paths:
             if not os.path.exists(p):
                 continue
@@ -345,6 +353,7 @@ class ChaosInjector:
             self.injected["reload_failures"] += 1
             print(f"FAULT INJECTION: failing rolling reload at "
                   f"replica index {replica_index}", flush=True)
+            _record("chaos", "reload_fail", replica_index=replica_index)
             return True
         return False
 
