@@ -10,7 +10,7 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   smoke tpu-tests bench-evidence bench-ingest bench-steploop \
   bench-serving bench-serving-sharded bench-serving-multimodel \
   bench-gradsync bench-syncmode bench-autotune bench-deploy \
-  bench-obs chaos chaos-deploy onchip-artifacts docs clean
+  bench-obs bench-tail chaos chaos-deploy onchip-artifacts docs clean
 
 build: native install
 
@@ -132,6 +132,16 @@ bench-obs:
 	$(CPU_ENV) $(PY) scripts/bench_obs.py \
 	  --out bench_evidence/bench_obs.json
 
+# tail latency: the straggler drill (no-straggler control vs
+# COS_FAULT_REPLICA_SLOW cliff vs hedged-requests recovery, measured
+# at client p99.9) and the zipf cache replay (content-hash response
+# cache + in-flight coalescing vs the cache-off wire at ~0.8 hit
+# rate); ALWAYS exits 0 with one JSON document on stdout
+bench-tail:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_tail.py \
+	  --out bench_evidence/bench_tail.json
+
 # online serving: dynamic micro-batching vs batch=1 dispatch across
 # offered loads; JSON artifact with p50/p99 latency + rows/s per cell
 bench-serving:
@@ -194,6 +204,8 @@ bench-evidence:
 	  --out bench_evidence/bench_deploy.json
 	-$(CPU_ENV) $(PY) scripts/bench_obs.py \
 	  --out bench_evidence/bench_obs.json
+	-$(CPU_ENV) $(PY) scripts/bench_tail.py \
+	  --out bench_evidence/bench_tail.json
 
 # everything the judge wants from ONE healthy tunnel window, in
 # priority order: headline number + evidence, on-chip test artifact,

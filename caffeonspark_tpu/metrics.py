@@ -116,6 +116,7 @@ class _Series:
             "p50_ms": round(1e3 * pct(0.50), 4),
             "p95_ms": round(1e3 * pct(0.95), 4),
             "p99_ms": round(1e3 * pct(0.99), 4),
+            "p99_9_ms": round(1e3 * pct(0.999), 4),
             "max_ms": round(1e3 * self.max, 4),
         }
 

@@ -106,7 +106,7 @@ class PromWriter:
                         "per-stage max milliseconds",
                         st.get("max_ms", 0.0), sl)
             for q, key in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
-                           ("0.99", "p99_ms")):
+                           ("0.99", "p99_ms"), ("0.999", "p99_9_ms")):
                 self.sample("stage_ms", "gauge",
                             "per-stage latency quantiles (ms, over "
                             "the bounded sample ring)",
@@ -164,6 +164,14 @@ class PromWriter:
             for k in ("requests", "failures", "restarts"):
                 self.sample(f"replica_{k}_total", "counter",
                             f"per-replica {k}", st.get(k, 0), rl)
+            # the hedging budget's inputs: router-observed per-replica
+            # success latency (EWMA + ring p95) — why a hedge fired
+            for k, fam in (("lat_ewma_ms", "replica_lat_ewma_ms"),
+                           ("lat_p95_ms", "replica_lat_p95_ms")):
+                if st.get(k) is not None:
+                    self.sample(fam, "gauge",
+                                "router-observed replica latency (ms)",
+                                st[k], rl)
 
     # -- rendering -----------------------------------------------------
     def render(self) -> str:

@@ -254,8 +254,14 @@ class Fleet:
         try:
             for i in range(self.n):
                 name = f"replica{i}"
+                # the fleet-assigned index rides into the subprocess
+                # so per-replica chaos (COS_FAULT_REPLICA_SLOW) can
+                # target one replica; respawns reuse this env dict,
+                # keeping the index stable across restarts
                 self.replicas[name] = ReplicaProcess(
-                    name, self.serve_args, env=self.env).spawn()
+                    name, self.serve_args,
+                    env=dict(self.env,
+                             COS_REPLICA_INDEX=str(i))).spawn()
                 self.router.add_replica(name, "http://unbound",
                                         state=STARTING)
             for name, rep in self.replicas.items():

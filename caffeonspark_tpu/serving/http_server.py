@@ -59,6 +59,7 @@ import base64
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
@@ -277,17 +278,95 @@ class _Handler(JsonHandler):
                          root=tracer.sample_root()) as sp:
             self._predict_traced(svc, q, sp)
 
+    @staticmethod
+    def _parse_predict(raw, q):
+        """Decode the request body and resolve the model: the JSON
+        field beats the query param; both absent = the default model
+        (single-model requests are exactly the pre-plural wire
+        format).  Raises ValueError on malformed input."""
+        req = json.loads(raw.decode())
+        if not isinstance(req, dict):
+            raise ValueError("request body must be a JSON object")
+        model = req.pop("model", None) or q.get("model") or None
+        if model is not None and not isinstance(model, str):
+            raise ValueError("'model' must be a string")
+        return req, model
+
     def _predict_traced(self, svc, q, sp):
+        t_req = time.monotonic()
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n) if n else b"{}"
+        # defer JSON decoding until the cache has had its say: a hit
+        # is answered from the payload DIGEST alone.  Parsing early is
+        # only needed to resolve a per-request model override, and a
+        # body with no '"model"' bytes cannot contain one (bodies that
+        # do not parse can never be hits — only successfully executed
+        # requests are ever inserted)
+        req = model = None
+        if b'"model"' in raw or q.get("model"):
+            try:
+                req, model = self._parse_predict(raw, q)
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"error": str(e)})
+                return
+        # content-hash response cache + single-flight coalescing
+        # (respcache.py; svc.respcache is None by default — this whole
+        # block is skipped and the wire is byte-identical uncached)
+        cache = getattr(svc, "respcache", None)
+        ckey = flight = None
+        if cache is not None:
+            try:
+                version = svc.registry.version_of(model)
+            except KeyError:
+                version = 0      # unknown model: 404s below, uncached
+            if version:
+                ckey = cache.key(model, version, raw)
+                kind, val = cache.begin(ckey)
+                if kind == "hit":
+                    sp.set("cache", "hit")
+                    self._finish_predict(svc, sp, val, t_req)
+                    return
+                if kind == "wait":
+                    value, err = cache.follow(val, svc.http_wait_s)
+                    if err is None and value is not None:
+                        sp.set("cache", "coalesced")
+                        self._finish_predict(svc, sp, value, t_req)
+                        return
+                    # the leader failed or timed out: fall back to our
+                    # own full execution (no flight to complete)
+                    ckey = None
+                else:
+                    flight = val          # we lead; completion is on us
+        if req is None:                   # cold/leading path parses now
+            try:
+                req, model = self._parse_predict(raw, q)
+            except (ValueError, json.JSONDecodeError) as e:
+                if flight is not None:
+                    cache.complete(ckey, flight,
+                                   error=RuntimeError("bad request"))
+                self._send(400, {"error": str(e)})
+                return
         try:
-            req = self._read_json()
-            if not isinstance(req, dict):
-                raise ValueError("request body must be a JSON object")
-            # model routing: JSON field beats the query param; both
-            # absent = the default model (single-model requests are
-            # exactly the pre-plural wire format)
-            model = req.pop("model", None) or q.get("model") or None
-            if model is not None and not isinstance(model, str):
-                raise ValueError("'model' must be a string")
+            out = self._predict_execute(svc, sp, req, model)
+        except BaseException:
+            if flight is not None:
+                cache.complete(ckey, flight,
+                               error=RuntimeError("leader failed"))
+            raise
+        if flight is not None:
+            # an error response (out None) wakes followers with no
+            # value — each retries its own execution rather than
+            # inheriting a failure that may not repeat
+            cache.complete(ckey, flight, value=out,
+                           error=None if out is not None
+                           else RuntimeError("leader failed"))
+        if out is not None:
+            self._finish_predict(svc, sp, out, t_req)
+
+    def _predict_execute(self, svc, sp, req, model):
+        """Parse records, submit, wait; returns the response dict, or
+        None after having sent the mapped error response itself."""
+        try:
             records = req.get("records", [req] if ("data" in req
                                                   or "image_b64" in req)
                               else None)
@@ -307,29 +386,38 @@ class _Handler(JsonHandler):
                                       model=model, trace=sp.ctx)
         except KeyError as e:
             self._send(404, {"error": str(e)})
-            return
+            return None
         except QueueFullError as e:
             self._send(429, {"error": str(e)})
-            return
+            return None
         except ServingStopped as e:
             self._send(503, {"error": str(e)})
-            return
+            return None
         except (ValueError, json.JSONDecodeError, TypeError) as e:
             self._send(400, {"error": str(e)})
-            return
+            return None
         try:
             rows = [p.wait(svc.http_wait_s) for p in pending]
         except DeadlineExceeded as e:
             self._send(504, {"error": str(e)})
-            return
+            return None
         except BaseException as e:        # noqa: BLE001 — model fault
             self._send(503, {"error": f"{type(e).__name__}: {e}"})
-            return
+            return None
         out = {"rows": rows,
                "model_version": pending[-1].model_version}
         if model is not None:
             out["model"] = model
-        sp.set("rows", len(rows))
+        return out
+
+    def _finish_predict(self, svc, sp, out, t_req):
+        """Success epilogue for cold, cached, and coalesced paths.
+        COS_FAULT_REPLICA_SLOW lands here: the injected straggler pads
+        every predict to factor× its own service time, end to end."""
+        slow = getattr(svc, "predict_slow_factor", 1.0)
+        if slow > 1.0:
+            time.sleep((slow - 1.0) * (time.monotonic() - t_req))
+        sp.set("rows", len(out["rows"]))
         with get_tracer().span("replica.respond", parent=sp.ctx):
             self._send(200, out)
 

@@ -1,0 +1,188 @@
+"""Content-hash response cache with in-flight coalescing.
+
+Classification traffic is heavily zipfian — the same image keeps
+arriving (retries, mirrored canary traffic, duplicated upstream
+events) — so the cheapest device execution is the one that never
+happens.  Two layers, one module:
+
+  * **Response cache** — a per-model LRU keyed on
+    `(model, registry version, payload digest)`.  The digest is over
+    the RAW request bytes, so a hit is byte-level identical input and
+    the cached response is exactly the dict a cold execution would
+    have produced (byte-identical wire once re-serialized).  The
+    registry version in the key makes a hot-swap an implicit flush:
+    the first request after a reload misses and re-executes on the
+    new weights, stale entries age out of the LRU.  An optional TTL
+    bounds staleness for deployments that reload rarely.
+  * **In-flight coalescing (single-flight)** — concurrent identical
+    payloads collapse onto ONE device execution: the first request
+    becomes the *leader* and runs the normal submit path; followers
+    block on the leader's completion event and share its response.
+    A leader that fails wakes its followers with no value — each
+    falls back to its own full execution (an error must never fan
+    out to requests that could have succeeded a millisecond later).
+
+Knobs (resolved once at service startup — COS003 discipline; default
+off = the cache object is never created and the wire is byte-identical
+to the uncached server):
+
+  COS_CACHE_CAP     max cached responses PER MODEL (0 = cache off)
+  COS_CACHE_TTL_S   entry time-to-live in seconds (0 = no TTL; the
+                    registry version key still invalidates on reload)
+
+Counters (landed in the service's PipelineMetrics, so they ride the
+existing /metrics JSON + Prometheus exposition): `cache_hits`,
+`cache_misses`, `cache_coalesced`, `cache_evictions`,
+`cache_expired`.
+
+Lock discipline: the cache lock guards only the LRU + in-flight
+tables — never held across an execution or a wait (COS005 posture).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+from .batcher import _env_int, _env_num
+
+CacheKey = Tuple[str, int, str]          # (model, version, digest)
+
+
+class Flight:
+    """One in-flight execution other requests may coalesce onto."""
+
+    __slots__ = ("event", "value", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+
+
+class ResponseCache:
+    """Per-model LRU of predict responses + single-flight table."""
+
+    def __init__(self, capacity: int, ttl_s: float = 0.0,
+                 metrics=None):
+        assert capacity > 0, "use from_env(); capacity 0 means off"
+        self.capacity = int(capacity)
+        self.ttl_s = max(0.0, float(ttl_s))
+        self._lock = threading.Lock()
+        self._metrics = metrics       # optional PipelineMetrics sink
+        # model -> OrderedDict[key -> (response, t_added)]
+        self._lru: Dict[str, "OrderedDict[CacheKey, Tuple[dict, float]]"] = {}
+        self._inflight: Dict[CacheKey, Flight] = {}
+        self.counters = {"cache_hits": 0, "cache_misses": 0,
+                         "cache_coalesced": 0, "cache_evictions": 0,
+                         "cache_expired": 0}
+
+    @classmethod
+    def from_env(cls, metrics=None) -> Optional["ResponseCache"]:
+        """COS_CACHE_CAP > 0 turns the cache on; default off keeps
+        the serving wire byte-identical (no cache object at all)."""
+        cap = _env_int("COS_CACHE_CAP", 0)
+        if cap <= 0:
+            return None
+        return cls(cap, ttl_s=_env_num("COS_CACHE_TTL_S", 0.0),
+                   metrics=metrics)
+
+    def _bump(self, name: str) -> None:
+        # called under self._lock; metrics has its own lock and never
+        # takes this one, so the ordering cache->metrics is acyclic
+        self.counters[name] += 1
+        if self._metrics is not None:
+            self._metrics.incr(name)
+
+    @staticmethod
+    def key(model: Optional[str], version: int,
+            payload: bytes) -> CacheKey:
+        """(model, registry version, sha256 of the raw request bytes).
+        Byte-level on purpose: two semantically equal but differently
+        serialized payloads are different keys — a false miss costs
+        one execution, a false hit would serve the wrong answer."""
+        return (model or "", int(version),
+                hashlib.sha256(payload).hexdigest())
+
+    # -- request path ---------------------------------------------------
+    def begin(self, key: CacheKey):
+        """One atomic admission decision:
+          ("hit", response)  — cached and fresh; serve it.
+          ("wait", Flight)   — an identical payload is executing NOW;
+                               follow() it.
+          ("lead", Flight)   — this request executes; it MUST call
+                               complete() on every exit path or its
+                               followers block until their timeout."""
+        now = time.monotonic()
+        with self._lock:
+            lru = self._lru.get(key[0])
+            if lru is not None:
+                hit = lru.get(key)
+                if hit is not None:
+                    value, t_added = hit
+                    if self.ttl_s and now - t_added > self.ttl_s:
+                        del lru[key]
+                        self._bump("cache_expired")
+                    else:
+                        lru.move_to_end(key)
+                        self._bump("cache_hits")
+                        return ("hit", value)
+            fl = self._inflight.get(key)
+            if fl is not None:
+                self._bump("cache_coalesced")
+                return ("wait", fl)
+            fl = Flight()
+            self._inflight[key] = fl
+            self._bump("cache_misses")
+            return ("lead", fl)
+
+    def complete(self, key: CacheKey, flight: Flight,
+                 value: Optional[dict] = None,
+                 error: Optional[BaseException] = None) -> None:
+        """Leader's epilogue: publish the response (or the failure) to
+        every follower and, on success, insert it into the LRU."""
+        with self._lock:
+            self._inflight.pop(key, None)
+            if error is None and value is not None:
+                lru = self._lru.setdefault(key[0], OrderedDict())
+                lru[key] = (value, time.monotonic())
+                lru.move_to_end(key)
+                while len(lru) > self.capacity:
+                    lru.popitem(last=False)
+                    self._bump("cache_evictions")
+        flight.value = value
+        flight.error = error
+        flight.event.set()
+
+    @staticmethod
+    def follow(flight: Flight, timeout_s: float
+               ) -> Tuple[Optional[dict], Optional[BaseException]]:
+        """Follower's wait: (response, None) when the leader landed,
+        (None, error-or-None) when it failed or the wait timed out —
+        either way the caller falls back to its own execution."""
+        if not flight.event.wait(timeout_s):
+            return (None, TimeoutError("coalesced leader timed out"))
+        return (flight.value, flight.error)
+
+    # -- maintenance ----------------------------------------------------
+    def invalidate(self, model: Optional[str] = None) -> int:
+        """Drop every cached response for `model` (None = all models).
+        The version-in-key already guarantees correctness across
+        reloads; this frees the dead entries' memory immediately."""
+        with self._lock:
+            if model is None:
+                n = sum(len(v) for v in self._lru.values())
+                self._lru.clear()
+            else:
+                n = len(self._lru.pop(model or "", ()))
+            return n
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self.counters,
+                        entries=sum(len(v) for v in self._lru.values()),
+                        capacity=self.capacity, ttl_s=self.ttl_s,
+                        inflight=len(self._inflight))

@@ -47,11 +47,18 @@ class RetryPolicy:
                           else _env_num("COS_SERVE_RETRY_CAP_MS", 500))
         self._rng = random.Random(seed)
 
+    def ceilings_ms(self) -> list:
+        """The per-retry jitter ceilings: delay k is drawn uniformly
+        from [0, ceilings_ms()[k]] ms.  Exposed so tests (and tuning
+        docs) pin the full-jitter distribution bounds against the
+        policy's own schedule instead of re-deriving it."""
+        return [min(self.cap_ms, self.base_ms * (2 ** k))
+                for k in range(self.attempts - 1)]
+
     def delays_s(self) -> Iterator[float]:
         """Backoff before each RETRY (attempts - 1 of them): full
         jitter under an exponentially growing, capped ceiling."""
-        for k in range(self.attempts - 1):
-            ceil_ms = min(self.cap_ms, self.base_ms * (2 ** k))
+        for ceil_ms in self.ceilings_ms():
             yield self._rng.uniform(0.0, ceil_ms) / 1e3
 
 

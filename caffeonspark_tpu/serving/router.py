@@ -30,9 +30,23 @@ build a backlog the others could have absorbed.
     snapshots `current()` once per flush); the fleet-wide invariant
     this adds is that only the old and the new version ever coexist,
     so every response comes from exactly one of them.
+  * **Hedged requests** (COS_HEDGE_PCT; off by default) — the
+    tail-at-scale defense: the router keeps a per-replica and an
+    aggregate success-latency ring; when an in-flight predict exceeds
+    an adaptive budget (the aggregate ring's COS_HEDGE_PCT-th
+    percentile, floored at COS_HEDGE_MIN_MS), the same request fires
+    at a second replica picked AWAY from the straggler.  First
+    response wins; the loser is abandoned and its late response
+    discarded (each leg is its own connection — a late body can never
+    bleed into a later request).  COS_HEDGE_MAX_PCT caps hedges as a
+    fraction of routed traffic so hedging cannot melt an already
+    overloaded fleet.  Hedge legs are extra `router.attempt` spans
+    (attr `hedge=true`) on the same trace; counters `hedges_fired` /
+    `hedges_won`.
 
 Lock discipline (COS005): `Router._lock` guards only the replica
-table and counters — never held across an HTTP call or a sleep.
+table, counters, and latency rings — never held across an HTTP call
+or a sleep.
 """
 
 from __future__ import annotations
@@ -40,6 +54,7 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import queue
 import socket
 import threading
 import time
@@ -120,13 +135,44 @@ def http_json(url: str, *, data: Optional[bytes] = None,
         return e.code, body
 
 
+class _LatRing:
+    """Bounded success-latency sample ring + EWMA, in milliseconds —
+    the hedging budget's input.  Mutated only under the router lock
+    (O(1) add); percentile reads sort a snapshot of <= `capacity`
+    floats, cheap at operator/budget cadence."""
+
+    __slots__ = ("_ring", "_cap", "_i", "count", "ewma_ms")
+
+    def __init__(self, capacity: int = 512):
+        self._ring: List[float] = []
+        self._cap = capacity
+        self._i = 0
+        self.count = 0
+        self.ewma_ms = 0.0
+
+    def add_ms(self, ms: float) -> None:
+        self.count += 1
+        self.ewma_ms = (ms if self.count == 1
+                        else 0.2 * ms + 0.8 * self.ewma_ms)
+        if len(self._ring) < self._cap:
+            self._ring.append(ms)
+        else:
+            self._ring[self._i] = ms
+            self._i = (self._i + 1) % self._cap
+
+    def pct_ms(self, p: float) -> float:
+        s = sorted(self._ring)
+        n = len(s)
+        return s[min(n - 1, int(p * n))] if n else 0.0
+
+
 class _Replica:
     """Router-side view of one replica endpoint.  Mutable fields are
     guarded by the ROUTER's lock (one lock for the whole table — the
     pick must read every replica's outstanding count atomically)."""
 
     __slots__ = ("name", "url", "state", "outstanding", "requests",
-                 "failures", "restarts", "drain_intent")
+                 "failures", "restarts", "drain_intent", "lat")
 
     def __init__(self, name: str, url: str, state: str = STARTING):
         self.name = name
@@ -137,6 +183,7 @@ class _Replica:
         self.failures = 0
         self.restarts = 0
         self.drain_intent = False   # True only for ROUTER-issued drains
+        self.lat = _LatRing()       # router-observed success latency
 
 
 class Router:
@@ -144,7 +191,10 @@ class Router:
                  policy: Optional[RetryPolicy] = None,
                  http_timeout_s: float = 120.0,
                  health_timeout_s: float = 5.0,
-                 metrics: Optional[PipelineMetrics] = None):
+                 metrics: Optional[PipelineMetrics] = None,
+                 hedge_pct: Optional[float] = None,
+                 hedge_min_ms: Optional[float] = None,
+                 hedge_max_pct: Optional[float] = None):
         self._lock = threading.Lock()
         self._replicas: Dict[str, _Replica] = {}
         self._rr = 0             # round-robin tie-break cursor
@@ -155,6 +205,22 @@ class Router:
         self._tracer = get_tracer("router")
         self._health_thread: Optional[threading.Thread] = None
         self._health_stop = threading.Event()
+        # hedged-request knobs, resolved ONCE at construction (COS003).
+        # hedge_pct 0 (the default) = hedging off: predict() stays the
+        # exact single-leg inline path, no thread, no queue.
+        from .batcher import _env_num
+        self.hedge_pct = (hedge_pct if hedge_pct is not None
+                          else _env_num("COS_HEDGE_PCT", 0))
+        self.hedge_min_ms = max(0.0, hedge_min_ms
+                                if hedge_min_ms is not None
+                                else _env_num("COS_HEDGE_MIN_MS", 20))
+        self.hedge_max_pct = max(0.0, hedge_max_pct
+                                 if hedge_max_pct is not None
+                                 else _env_num("COS_HEDGE_MAX_PCT", 10))
+        if not 0 <= self.hedge_pct < 100:
+            raise ValueError(f"COS_HEDGE_PCT={self.hedge_pct}: "
+                             "expected a percentile in [0, 100)")
+        self._lat = _LatRing()   # aggregate ring (the budget's input)
         for name, url in (endpoints or {}).items():
             self.add_replica(name, url)
 
@@ -247,17 +313,32 @@ class Router:
             rep.outstanding += 1
         return rep
 
-    def _done(self, rep: _Replica, failed: bool = False) -> None:
+    def _done(self, rep: _Replica, failed: bool = False,
+              elapsed_s: Optional[float] = None) -> None:
         """`requests` counts COMPLETED requests, not pick attempts —
         a bounced 429/conn-refused attempt lands in `failures`, so the
         bench's per-replica utilization (delta of `requests`) never
-        credits a dead or saturated replica with traffic it shed."""
+        credits a dead or saturated replica with traffic it shed.
+        `elapsed_s` (successful legs only) feeds the per-replica and
+        aggregate latency rings the hedging budget reads — failures
+        are excluded on purpose: a refused connection measures ~0 ms
+        and would drag the budget below real service time."""
         with self._lock:
             rep.outstanding = max(0, rep.outstanding - 1)
             if failed:
                 rep.failures += 1
             else:
                 rep.requests += 1
+                if elapsed_s is not None:
+                    rep.lat.add_ms(elapsed_s * 1e3)
+                    self._lat.add_ms(elapsed_s * 1e3)
+
+    def _unpick(self, rep: _Replica) -> None:
+        """Undo a _pick that never issued a request (a hedge target
+        that turned out to be the straggler itself): outstanding only,
+        neither `requests` nor `failures` moves."""
+        with self._lock:
+            rep.outstanding = max(0, rep.outstanding - 1)
 
     def outstanding(self, name: str) -> int:
         with self._lock:
@@ -293,15 +374,19 @@ class Router:
         last_failed: List[Optional[str]] = [None]
         attempt_i = [0]
 
-        def attempt() -> dict:
-            rep = self._pick(avoid=last_failed[0])
-            last_failed[0] = rep.name
+        def exchange(rep: _Replica, hedged: bool) -> dict:
+            """One HTTP leg against one already-picked replica, fully
+            classified; always balances the pick via _done and (on
+            success) feeds the latency rings."""
             attempt_i[0] += 1
             failed = True
+            leg_t0 = time.monotonic()
             with self._tracer.span("router.attempt",
                                    parent=trace) as sp:
                 sp.set("replica", rep.name)
                 sp.set("attempt", attempt_i[0])
+                if hedged:
+                    sp.set("hedge", True)
                 hdrs = ({TRACE_HEADER: sp.header()}
                         if sp.ctx is not None else None)
                 try:
@@ -344,7 +429,18 @@ class Router:
                     sp.set("outcome", "ok")
                     return body
                 finally:
-                    self._done(rep, failed=failed)
+                    self._done(rep, failed=failed,
+                               elapsed_s=None if failed
+                               else time.monotonic() - leg_t0)
+
+        def attempt() -> dict:
+            rep = self._pick(avoid=last_failed[0])
+            last_failed[0] = rep.name
+            budget_s = self._hedge_budget_s()
+            if budget_s is None:
+                # hedging off: the historical inline single-leg path
+                return exchange(rep, hedged=False)
+            return self._hedged_exchange(exchange, rep, budget_s)
 
         def on_retry(err, attempt_i_):
             self.metrics.incr("retries")
@@ -355,6 +451,88 @@ class Router:
         self.metrics.add("route", time.monotonic() - t0)
         self.metrics.incr("routed")
         return out
+
+    # -- hedged requests ----------------------------------------------
+    def _hedge_budget_s(self) -> Optional[float]:
+        """How long the primary leg may run before a hedge fires:
+        the aggregate latency ring's COS_HEDGE_PCT-th percentile,
+        floored at COS_HEDGE_MIN_MS (which alone carries the cold
+        start, before the ring has samples).  None = hedging off."""
+        if self.hedge_pct <= 0:
+            return None
+        with self._lock:
+            p_ms = self._lat.pct_ms(self.hedge_pct / 100.0)
+        return max(self.hedge_min_ms, p_ms) / 1e3
+
+    def _hedge_allowed(self) -> bool:
+        """COS_HEDGE_MAX_PCT budget cap: hedges may be at most that
+        fraction of routed traffic.  Under overload every request
+        runs past the budget — without the cap hedging would double
+        the fleet's load exactly when it can least afford it."""
+        fired = self.metrics.get_counter("hedges_fired")
+        total = self.metrics.get_counter("routed") + 1
+        return fired < self.hedge_max_pct / 100.0 * total
+
+    def _hedged_exchange(self, exchange, rep: _Replica,
+                         budget_s: float) -> dict:
+        """Run the primary leg with a hedge budget: if it has not
+        completed within `budget_s`, fire the same request at a second
+        replica picked AWAY from the straggler; first successful
+        response wins, the loser is abandoned (its thread drains its
+        own connection; the late response goes nowhere).  If every leg
+        fails, the most meaningful error is re-raised — a replica's
+        own verdict (RouterRequestError) over a retryable bounce."""
+        results: "queue.Queue" = queue.Queue()
+
+        def leg(leg_rep: _Replica, hedged: bool) -> None:
+            try:
+                results.put(("ok", exchange(leg_rep, hedged), hedged))
+            except BaseException as e:  # noqa: BLE001 — classified below
+                results.put(("err", e, hedged))
+
+        threading.Thread(target=leg, args=(rep, False), daemon=True,
+                         name="cos-hedge-primary").start()
+        legs = 1
+        try:
+            first = results.get(timeout=budget_s)
+        except queue.Empty:
+            # primary over budget: hedge AWAY from the straggler (if
+            # the pool has a distinct healthy peer and the traffic cap
+            # allows), then wait for whichever leg lands first
+            hedge_rep = None
+            if self._hedge_allowed():
+                try:
+                    hedge_rep = self._pick(avoid=rep.name)
+                except NoReplicaAvailable:
+                    hedge_rep = None
+                if hedge_rep is not None and hedge_rep.name == rep.name:
+                    self._unpick(hedge_rep)   # only the straggler left
+                    hedge_rep = None
+            if hedge_rep is not None:
+                self.metrics.incr("hedges_fired")
+                record_event("router", "hedge", replica=hedge_rep.name,
+                             straggler=rep.name,
+                             budget_ms=round(budget_s * 1e3, 3))
+                threading.Thread(target=leg, args=(hedge_rep, True),
+                                 daemon=True,
+                                 name="cos-hedge-secondary").start()
+                legs = 2
+            first = results.get()
+        errors: List[BaseException] = []
+        outcome = first
+        while True:
+            kind, val, hedged = outcome
+            if kind == "ok":
+                if hedged:
+                    self.metrics.incr("hedges_won")
+                return val
+            errors.append(val)
+            if len(errors) == legs:
+                for e in errors:
+                    if isinstance(e, RouterRequestError):
+                        raise e
+                raise errors[0]
+            outcome = results.get()   # one leg still in flight
 
     # -- health -------------------------------------------------------
     def check_health_once(self) -> Dict[str, str]:
@@ -680,8 +858,22 @@ class Router:
                 n: {"state": r.state, "url": r.url,
                     "outstanding": r.outstanding,
                     "requests": r.requests, "failures": r.failures,
-                    "restarts": r.restarts}
+                    "restarts": r.restarts,
+                    # the hedging budget's per-replica inputs, so an
+                    # operator can see WHY a hedge fired (and which
+                    # replica is the straggler) from /metrics alone
+                    "lat_ewma_ms": round(r.lat.ewma_ms, 3),
+                    "lat_p95_ms": round(r.lat.pct_ms(0.95), 3)}
                 for n, r in self._replicas.items()}
+            if self.hedge_pct > 0:
+                out["hedge"] = {
+                    "pct": self.hedge_pct,
+                    "min_ms": self.hedge_min_ms,
+                    "max_pct": self.hedge_max_pct,
+                    "budget_ms": round(
+                        max(self.hedge_min_ms,
+                            self._lat.pct_ms(self.hedge_pct / 100.0)),
+                        3)}
         return out
 
     def note_restart(self, name: str) -> None:

@@ -163,6 +163,23 @@ class InferenceService:
         # instead of paying whole-program compilation in its latency
         from ..analysis.runtime import maybe_recompile_guard
         self._recompile_guard = maybe_recompile_guard("serving")
+        # content-hash response cache + single-flight coalescing
+        # (COS_CACHE_CAP; None = off, byte-identical uncached wire) —
+        # the HTTP front end consults it per request (respcache.py)
+        from .respcache import ResponseCache
+        self.respcache = ResponseCache.from_env(metrics=self.metrics)
+        # COS_FAULT_REPLICA_SLOW straggler injector: the fleet assigns
+        # each replica its index via COS_REPLICA_INDEX; a matching
+        # index delays every predict response by (factor-1)× its own
+        # service time (http_server applies it) — resolved ONCE here
+        from ..tools.chaos import resolve as _resolve_faults
+        from ..utils.envutils import env_int as _env_int_strict
+        ridx = _env_int_strict("COS_REPLICA_INDEX", -1, strict=False)
+        plan = _resolve_faults(rank=max(0, ridx))
+        self.predict_slow_factor = plan.replica_slow_factor(ridx)
+        if plan.replica_slow:
+            # self-describing drills: the artifact names the injector
+            self.metrics.set_info("faults", plan.describe())
 
     @staticmethod
     def _build_source(conf) -> DataSource:
@@ -489,6 +506,10 @@ class InferenceService:
         record_event("service", "reloaded",
                      model=model or DEFAULT_MODEL, version=version,
                      path=model_path)
+        if self.respcache is not None:
+            # the version-in-key already guarantees no stale answer;
+            # the purge frees the dead version's entries immediately
+            self.respcache.invalidate(model or DEFAULT_MODEL)
         self._draining = False
         return version
 
@@ -544,6 +565,8 @@ class InferenceService:
         if self.registry.hbm_budget_bytes:
             out["hbm_budget_mb"] = round(
                 self.registry.hbm_budget_bytes / 2**20, 3)
+        if self.respcache is not None:
+            out["respcache"] = self.respcache.stats()
         return out
 
 

@@ -67,6 +67,12 @@ relaunch does not re-fire it):
                                once (the roll must abort and the fleet
                                must roll survivors BACK to the
                                incumbent — deploy auto-rollback)
+  COS_FAULT_REPLICA_SLOW       "idx:factor" — serving replica `idx`
+                               (COS_REPLICA_INDEX, fleet-assigned)
+                               answers each predict factor× slower:
+                               the tail-latency straggler the hedging
+                               drill (scripts/bench_tail.py) injects
+                               without hand-built fakes
 
 The deploy stream tail reuses COS_FAULT_FLAKY_STORAGE: the streaming
 source's directory re-poll (data/streaming.py) absorbs injected
@@ -122,6 +128,8 @@ class FaultPlan(NamedTuple):
     canary_kill: Optional[Tuple[int, str]] = None    # (n_reqs, marker)
     snapshot_truncate: Optional[str] = None          # marker
     reload_fail_rank: Optional[Tuple[int, str]] = None  # (k, marker)
+    # serving straggler: replica `idx` answers predicts factor× slower
+    replica_slow: Optional[Tuple[int, float]] = None    # (idx, factor)
 
     @property
     def active(self) -> bool:
@@ -129,13 +137,24 @@ class FaultPlan(NamedTuple):
                     or self.slow_rank or self.flaky_exchange
                     or self.flaky_storage or self.comm.active
                     or self.canary_kill or self.snapshot_truncate
-                    or self.reload_fail_rank)
+                    or self.reload_fail_rank or self.replica_slow)
 
     @property
     def slow_factor(self) -> float:
         """This rank's slowdown factor (1.0 = healthy)."""
         if self.slow_rank and self.slow_rank[0] == self.rank:
             return max(1.0, self.slow_rank[1])
+        return 1.0
+
+    def replica_slow_factor(self, index: int) -> float:
+        """COS_FAULT_REPLICA_SLOW: this serving replica's predict-path
+        slowdown (1.0 = healthy).  `index` is the fleet-assigned
+        replica index (COS_REPLICA_INDEX), NOT the training rank —
+        a straggler drill against a fleet must not also slow a
+        co-scheduled trainer of the same rank."""
+        if self.replica_slow is not None and index >= 0 \
+                and index == self.replica_slow[0]:
+            return max(1.0, self.replica_slow[1])
         return 1.0
 
     def describe(self) -> dict:
@@ -167,6 +186,9 @@ class FaultPlan(NamedTuple):
             out["snapshot_truncate"] = True
         if self.reload_fail_rank:
             out["reload_fail_rank"] = self.reload_fail_rank[0]
+        if self.replica_slow:
+            out["replica_slow"] = {"replica": self.replica_slow[0],
+                                   "factor": self.replica_slow[1]}
         return out
 
 
@@ -193,6 +215,15 @@ def resolve(rank: int = 0) -> FaultPlan:
             raise ValueError(
                 f"COS_FAULT_SLOW_RANK factor {factor}: must be >= 1")
         slow_rank = (int(r_), factor)
+    rslow = os.environ.get("COS_FAULT_REPLICA_SLOW", "")
+    replica_slow = None
+    if rslow:
+        i_, f_ = rslow.split(":", 1)
+        rfactor = float(f_)
+        if rfactor < 1.0:
+            raise ValueError(f"COS_FAULT_REPLICA_SLOW factor "
+                             f"{rfactor}: must be >= 1")
+        replica_slow = (int(i_), rfactor)
     def _count_marker(name: str) -> Optional[Tuple[int, str]]:
         """Parse an "n:marker" one-shot knob (count, marker path)."""
         v = os.environ.get(name, "")
@@ -223,7 +254,8 @@ def resolve(rank: int = 0) -> FaultPlan:
         canary_kill=_count_marker("COS_FAULT_CANARY_KILL"),
         snapshot_truncate=(
             os.environ.get("COS_FAULT_SNAPSHOT_TRUNCATE", "") or None),
-        reload_fail_rank=_count_marker("COS_FAULT_RELOAD_FAIL_RANK"))
+        reload_fail_rank=_count_marker("COS_FAULT_RELOAD_FAIL_RANK"),
+        replica_slow=replica_slow)
 
 
 class ChaosInjector:

@@ -1,0 +1,454 @@
+"""Tail-latency bench: the straggler drill and the cache replay.
+
+Two drills, one artifact (bench_evidence/bench_tail.json):
+
+  * straggler — a real 2-replica Fleet with COS_FAULT_REPLICA_SLOW
+    delaying one replica's predict path.  Three cells measured at the
+    client: no-straggler control, straggler with hedging off (the
+    p99.9 cliff), straggler with hedged requests on.  Gate
+    `p999_recovery`: the hedged cell's p99.9 lands within 1.5x of the
+    control while the hedge-off cell shows the cliff.
+
+  * cache replay — one in-process service + HTTP front end replaying
+    a zipf-shaped schedule (~0.8 hit rate) with the content-hash
+    response cache on vs off over the SAME schedule.  Gate
+    `cache_speedup`: >= 2x rows/s.  A coalescing sub-drill holds the
+    device busy and fires identical concurrent requests; gate
+    `coalesce_once`: one execution served them all.
+
+Contract (PR 4): ALWAYS exits 0, ONE JSON document on stdout,
+--out writes the same document, progress goes to stderr, failures
+land in doc["error"].  Gates are recorded, not exit-coded.
+
+Usage:
+  python scripts/bench_tail.py [--quick] [--out PATH]
+"""
+
+import argparse
+import json
+import os
+import platform
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_FLAG = "--xla_cpu_multi_thread_eigen=false"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " " + _FLAG).strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+NET_TMPL = """
+name: "tailnet"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param {{ source: "{root}/unused_lmdb" batch_size: 64
+    channels: 3 height: 24 width: 24 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param {{ num_output: {conv} kernel_size: 5 stride: 2
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }}
+layer {{ name: "ip1" type: "InnerProduct" bottom: "conv1" top: "ip1"
+  inner_product_param {{ num_output: {fc}
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
+  bottom: "label" top: "loss" }}
+"""
+
+SOLVER_TMPL = """
+net: "{net}"
+base_lr: 0.01
+lr_policy: "fixed"
+max_iter: 10
+random_seed: 7
+"""
+
+
+def build_model(td: str, conv: int = 16, fc: int = 64):
+    """conv/fc size the net: the straggler drill wants fast service
+    times (many samples per cell), the cache drill wants device
+    execution expensive enough to be the bottleneck the cache skips."""
+    from caffeonspark_tpu import checkpoint
+    from caffeonspark_tpu.proto import NetParameter, SolverParameter
+    from caffeonspark_tpu.solver import Solver
+    net_path = os.path.join(td, "net.prototxt")
+    net_txt = NET_TMPL.format(root=td, conv=conv, fc=fc)
+    with open(net_path, "w") as f:
+        f.write(net_txt)
+    solver_path = os.path.join(td, "solver.prototxt")
+    with open(solver_path, "w") as f:
+        f.write(SOLVER_TMPL.format(net=net_path))
+    s = Solver(SolverParameter.from_text(SOLVER_TMPL.format(net=net_path)),
+               NetParameter.from_text(net_txt))
+    params, _ = s.init()
+    model = os.path.join(td, "serve.caffemodel")
+    checkpoint.save_caffemodel(model, s.train_net, params)
+    return solver_path, model
+
+
+def _record(seed=0):
+    return {"id": f"r{seed}", "label": 0.0,
+            "data": (np.random.RandomState(seed)
+                     .rand(3, 24, 24).astype(np.float32) * 255.0)
+            .round(4).tolist()}
+
+
+def _pcts(lats_s):
+    lats = sorted(lats_s)
+
+    def pct(p):
+        return round(1e3 * lats[min(len(lats) - 1,
+                                    int(p * len(lats)))], 3) \
+            if lats else None
+
+    return {"n": len(lats), "p50_ms": pct(0.50), "p95_ms": pct(0.95),
+            "p99_ms": pct(0.99), "p99_9_ms": pct(0.999)}
+
+
+# ------------------------------------------------------------ straggler
+
+
+def tail_load_cell(router, clients: int, duration_s: float,
+                   think_s: float = 0.0) -> dict:
+    """Offered load with per-client think time: the drill must
+    measure request LATENCY, not saturation — on a contended box a
+    closed loop with zero think time queues at the healthy replica
+    and the queue, not the straggler, becomes the tail.  Per-request
+    latency measured at the caller — retries and hedges included,
+    that IS the tail the client sees."""
+    rec = _record(0)
+    stop = threading.Event()
+    lats = [[] for _ in range(clients)]
+    errors = [0] * clients
+
+    def client(i):
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                out = router.predict({"records": [rec]})
+                assert out["rows"], "empty response"
+                lats[i].append(time.monotonic() - t0)
+            except Exception:      # noqa: BLE001 — counted as failed
+                errors[i] += 1
+                time.sleep(0.001)
+            if think_s:
+                time.sleep(think_s)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=90)
+    elapsed = time.monotonic() - t0
+    all_lats = [x for ls in lats for x in ls]
+    cell = _pcts(all_lats)
+    cell.update({
+        "clients": clients, "duration_s": round(elapsed, 3),
+        "rows_per_sec": round(len(all_lats) / elapsed, 2),
+        "failed": sum(errors)})
+    c = router.metrics_summary()["counters"]
+    cell["hedges_fired"] = c.get("hedges_fired", 0)
+    cell["hedges_won"] = c.get("hedges_won", 0)
+    return cell
+
+
+def run_straggler_drill(out: dict, quick: bool) -> None:
+    import tempfile
+    from caffeonspark_tpu.serving import Fleet
+    from caffeonspark_tpu.serving.retry import RetryPolicy
+    from caffeonspark_tpu.serving.router import OK, Router
+
+    duration = 2.5 if quick else 8.0
+    clients = 4
+    think_s = 0.04
+    factor = 12.0
+    td = tempfile.mkdtemp(prefix="cos_tail_bench_")
+    solver_path, model = build_model(td)
+    aot_dir = os.path.join(td, "aot")
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": _FLAG,
+           "COS_AOT_CACHE_DIR": aot_dir,
+           "COS_RECOMPILE_GUARD": "1",
+           "COS_SERVE_MAX_BATCH": "16",
+           "COS_SERVE_MAX_WAIT_MS": "2"}
+    slow_env = dict(env, COS_FAULT_REPLICA_SLOW=f"1:{factor:g}")
+    serve_args = ["-conf", solver_path, "-model", model,
+                  "-features", "ip2"]
+    drill = {"replicas": 2, "slow_replica": 1, "slow_factor": factor,
+             "clients": clients, "think_s": think_s}
+
+    # control: no straggler (this fleet also fills the AOT cache, so
+    # the two straggler fleets below warm-start from it)
+    fleet = Fleet(serve_args, replicas=2, env=env)
+    try:
+        fleet.start()
+        drill["control"] = tail_load_cell(fleet.router, clients,
+                                          duration, think_s)
+    finally:
+        fleet.stop()
+    print(json.dumps({"cell": "control", **drill["control"]}),
+          file=sys.stderr, flush=True)
+
+    # straggler fleet: replica1 delays every predict by (factor-1)x
+    # its own service time; measure with hedging OFF (the default
+    # router the fleet built), then with a hedged router over the
+    # SAME replicas
+    fleet = Fleet(serve_args, replicas=2, env=slow_env)
+    try:
+        fleet.start()
+        drill["straggler_hedge_off"] = tail_load_cell(
+            fleet.router, clients, duration, think_s)
+        print(json.dumps({"cell": "hedge_off",
+                          **drill["straggler_hedge_off"]}),
+              file=sys.stderr, flush=True)
+        hedged = Router(
+            {n: fleet.router.replica_url(n)
+             for n in fleet.router.names()},
+            policy=RetryPolicy(attempts=4, base_ms=10, cap_ms=500),
+            # budget at the MEDIAN, not p95: with a persistent severe
+            # straggler the mixed ring's p95 IS the straggler, so a
+            # p95 budget never fires early enough — the percentile
+            # knob is the operator's dial for exactly this
+            hedge_pct=50, hedge_min_ms=10, hedge_max_pct=60)
+        for n in hedged.names():
+            hedged.set_state(n, OK)
+        drill["hedge"] = {"pct": 50, "min_ms": 10, "max_pct": 60}
+        drill["straggler_hedge_on"] = tail_load_cell(
+            hedged, clients, duration, think_s)
+        print(json.dumps({"cell": "hedge_on",
+                          **drill["straggler_hedge_on"]}),
+              file=sys.stderr, flush=True)
+    finally:
+        fleet.stop()
+
+    ctrl = drill["control"]["p99_9_ms"]
+    cliff = drill["straggler_hedge_off"]["p99_9_ms"]
+    hedged_p = drill["straggler_hedge_on"]["p99_9_ms"]
+    drill["p999_cliff_x"] = round(cliff / ctrl, 2) if ctrl else None
+    drill["p999_hedged_x"] = round(hedged_p / ctrl, 2) if ctrl else None
+    out["straggler"] = drill
+    out["gates"]["p999_recovery"] = bool(
+        ctrl and hedged_p is not None
+        and hedged_p <= 1.5 * ctrl < cliff)
+
+
+# --------------------------------------------------------- cache replay
+
+
+def _post(port, body):
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.read()
+
+
+def _zipf_schedule(n_requests: int, hot: int, hit_rate: float,
+                   seed: int = 11):
+    """Payload schedule with ~`hit_rate` repeat probability: hot keys
+    drawn zipf-shaped from a pool of `hot` payloads, the rest unique
+    one-shot payloads (compulsory misses)."""
+    rng = np.random.RandomState(seed)
+    ranks = np.arange(1, hot + 1, dtype=np.float64)
+    probs = (1.0 / ranks) / (1.0 / ranks).sum()
+    payloads = {}
+    schedule = []
+    cold_seq = 10_000
+    for i in range(n_requests):
+        if rng.rand() < hit_rate:
+            k = int(rng.choice(hot, p=probs))
+        else:
+            cold_seq += 1
+            k = cold_seq
+        if k not in payloads:
+            payloads[k] = json.dumps(
+                {"records": [_record(seed=k)]}).encode()
+        schedule.append(payloads[k])
+    return schedule
+
+
+def replay(port, schedule, clients: int) -> dict:
+    idx = [0]
+    lock = threading.Lock()
+    errors = [0]
+
+    def client():
+        while True:
+            with lock:
+                if idx[0] >= len(schedule):
+                    return
+                body = schedule[idx[0]]
+                idx[0] += 1
+            try:
+                _post(port, body)
+            except Exception:      # noqa: BLE001 — counted
+                with lock:
+                    errors[0] += 1
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    elapsed = time.monotonic() - t0
+    return {"requests": len(schedule), "failed": errors[0],
+            "duration_s": round(elapsed, 3),
+            "rows_per_sec": round(len(schedule) / elapsed, 2)}
+
+
+def run_cache_drill(out: dict, quick: bool) -> None:
+    import tempfile
+    from caffeonspark_tpu.config import Config
+    from caffeonspark_tpu.serving import (InferenceService,
+                                          ServingHTTPServer)
+
+    n_requests = 150 if quick else 600
+    clients = 4
+    td = tempfile.mkdtemp(prefix="cos_tail_cache_")
+    solver_path, model = build_model(td, conv=64, fc=2048)
+    schedule = _zipf_schedule(n_requests, hot=8, hit_rate=0.85)
+    drill = {"requests": n_requests, "hot_keys": 8,
+             "target_hit_rate": 0.8, "clients": clients}
+
+    def serve(cache_cap):
+        if cache_cap:
+            os.environ["COS_CACHE_CAP"] = str(cache_cap)
+        else:
+            os.environ.pop("COS_CACHE_CAP", None)
+        conf = Config(["-conf", solver_path, "-model", model])
+        svc = InferenceService(conf, blob_names=("ip2",),
+                               max_batch=16, max_wait_ms=2).start()
+        return svc, ServingHTTPServer(svc).start_background()
+
+    # cache ON: same schedule first, then the coalescing sub-drill
+    svc, httpd = serve(cache_cap=64)
+    try:
+        drill["cache_on"] = replay(httpd.port, schedule, clients)
+        cc = svc.respcache.counters
+        served = cc["cache_hits"] + cc["cache_misses"]
+        drill["cache_on"].update({
+            "hit_rate": round(cc["cache_hits"] / served, 3)
+            if served else None,
+            "cache": svc.respcache.stats()})
+        print(json.dumps({"cell": "cache_on", **drill["cache_on"]}),
+              file=sys.stderr, flush=True)
+
+        # coalescing: hold the device busy, fire identical requests
+        dup = json.dumps({"records": [_record(seed=999)]}).encode()
+        orig_run = svc.batcher.run_batch
+
+        def slow_run(*a, **kw):
+            time.sleep(0.4)
+            return orig_run(*a, **kw)
+
+        svc.batcher.run_batch = slow_run
+        rows_before = svc.metrics.get_counter("served_rows")
+        coalesced_before = cc["cache_coalesced"]
+        dups = 6
+        errs = []
+
+        def hit():
+            try:
+                _post(httpd.port, dup)
+            except Exception as e:  # noqa: BLE001 — recorded
+                errs.append(str(e))
+
+        ts = [threading.Thread(target=hit) for _ in range(dups)]
+        ts[0].start()
+        time.sleep(0.15)           # leader holds the flight open
+        for t in ts[1:]:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        svc.batcher.run_batch = orig_run
+        executions = svc.metrics.get_counter("served_rows") - rows_before
+        drill["coalesce"] = {
+            "duplicates": dups, "failed": len(errs),
+            "device_rows_executed": executions,
+            "coalesced": cc["cache_coalesced"] - coalesced_before}
+        out["gates"]["coalesce_once"] = (
+            not errs and executions == 1
+            and drill["coalesce"]["coalesced"] == dups - 1)
+    finally:
+        httpd.stop()
+        svc.stop()
+
+    # cache OFF: identical schedule, identical service config
+    svc, httpd = serve(cache_cap=0)
+    try:
+        drill["cache_off"] = replay(httpd.port, schedule, clients)
+        print(json.dumps({"cell": "cache_off", **drill["cache_off"]}),
+              file=sys.stderr, flush=True)
+    finally:
+        httpd.stop()
+        svc.stop()
+
+    on = drill["cache_on"]["rows_per_sec"]
+    off = drill["cache_off"]["rows_per_sec"]
+    drill["speedup_x"] = round(on / off, 2) if off else None
+    out["cache"] = drill
+    out["gates"]["cache_speedup"] = bool(off and on >= 2.0 * off)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller cells (CI smoke)")
+    ap.add_argument("--out", default="bench_evidence/bench_tail.json")
+    args = ap.parse_args()
+    import jax
+    out = {"bench": "tail", "quick": args.quick,
+           "env": {"platform": platform.platform(),
+                   "python": sys.version.split()[0],
+                   "jax": jax.__version__,
+                   "cpu_count": os.cpu_count()},
+           "notes": "CPU box: absolute latencies are contended and "
+                    "inflated; what the drills prove is the SHAPE — "
+                    "the straggler cliff vs hedged recovery at p99.9, "
+                    "and the cache/coalescing speedup on a repeated "
+                    "mix — not TPU-grade service times",
+           "gates": {},
+           "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      time.gmtime())}
+    try:
+        run_straggler_drill(out, args.quick)
+        run_cache_drill(out, args.quick)
+        out["headline"] = {
+            "metric": "p99_9_ms [control, straggler, hedged] + "
+                      "cache speedup",
+            "p999_ms": [
+                out["straggler"]["control"]["p99_9_ms"],
+                out["straggler"]["straggler_hedge_off"]["p99_9_ms"],
+                out["straggler"]["straggler_hedge_on"]["p99_9_ms"]],
+            "cache_speedup_x": out["cache"]["speedup_x"],
+            "gates": out["gates"]}
+    except Exception as e:      # noqa: BLE001 — artifact over rc
+        out["error"] = f"{type(e).__name__}: {e}"
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(out, sort_keys=True), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

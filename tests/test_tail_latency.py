@@ -1,0 +1,518 @@
+"""Tail-latency layer: hedged requests at the router, the content-hash
+response cache with in-flight coalescing, the retry policy's
+full-jitter bounds, the serving straggler chaos knob, and the p99.9
+quantile plumbing.
+
+The hedging tests run the real Router against stdlib fake replicas
+(the test_serving_fleet idiom) with a scriptable per-replica delay;
+the cache integration tests run the real serving stack on the tiny
+trained net so cache-on responses can be compared byte-for-byte with
+cold executions."""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from caffeonspark_tpu import checkpoint
+from caffeonspark_tpu.config import Config
+from caffeonspark_tpu.metrics import PipelineMetrics
+from caffeonspark_tpu.obs.prom import parse_exposition, render_summary
+from caffeonspark_tpu.proto import NetParameter, SolverParameter
+from caffeonspark_tpu.serving import InferenceService, ServingHTTPServer
+from caffeonspark_tpu.serving.respcache import ResponseCache
+from caffeonspark_tpu.serving.retry import RetryPolicy
+from caffeonspark_tpu.serving.router import OK, Router
+from caffeonspark_tpu.solver import Solver
+from caffeonspark_tpu.tools import chaos
+
+# ------------------------------------------------------------------ retry
+
+
+def test_retry_policy_ceilings_schedule():
+    p = RetryPolicy(attempts=5, base_ms=10, cap_ms=50, seed=1)
+    assert p.ceilings_ms() == [10, 20, 40, 50]   # capped at 50
+    assert RetryPolicy(attempts=1, base_ms=10, cap_ms=50,
+                       seed=1).ceilings_ms() == []
+
+
+def test_retry_policy_full_jitter_distribution_bounds():
+    """delay k ~ U[0, min(cap, base * 2^k)]: every draw inside its
+    ceiling, and over many draws the mean lands near ceiling/2 (the
+    full-jitter signature — NOT equal-jitter's [ceil/2, ceil])."""
+    draws = {k: [] for k in range(3)}
+    for seed in range(300):
+        p = RetryPolicy(attempts=4, base_ms=10, cap_ms=1000, seed=seed)
+        ceils = p.ceilings_ms()
+        for k, d_s in enumerate(p.delays_s()):
+            assert 0.0 <= d_s * 1e3 <= ceils[k]
+            draws[k].append(d_s * 1e3)
+    for k, ceil in enumerate([10, 20, 40]):
+        mean = sum(draws[k]) / len(draws[k])
+        # 300 uniform draws: mean within ±20% of ceil/2
+        assert 0.3 * ceil < mean < 0.7 * ceil, (k, mean)
+        # full jitter reaches BELOW ceil/2 (equal jitter never does)
+        assert min(draws[k]) < 0.5 * ceil
+
+
+# --------------------------------------------------------------- respcache
+
+
+def test_respcache_hit_miss_and_version_invalidation():
+    c = ResponseCache(capacity=4)
+    k1 = c.key("m", 1, b'{"records":[1]}')
+    kind, fl = c.begin(k1)
+    assert kind == "lead"
+    c.complete(k1, fl, value={"rows": [1]})
+    kind, val = c.begin(k1)
+    assert kind == "hit" and val == {"rows": [1]}
+    # a reload bumps the registry version: different key, fresh miss
+    k2 = c.key("m", 2, b'{"records":[1]}')
+    assert k1 != k2
+    kind, fl2 = c.begin(k2)
+    assert kind == "lead"
+    c.complete(k2, fl2, value={"rows": [2]})
+    assert c.counters["cache_hits"] == 1
+    assert c.counters["cache_misses"] == 2
+
+
+def test_respcache_payload_digest_is_byte_level():
+    c = ResponseCache(capacity=4)
+    assert c.key("m", 1, b'{"a": 1}') != c.key("m", 1, b'{"a":1}')
+    assert c.key("m", 1, b"x") != c.key("n", 1, b"x")
+
+
+def test_respcache_lru_eviction_per_model():
+    c = ResponseCache(capacity=2)
+    keys = [c.key("m", 1, bytes([i])) for i in range(3)]
+    for i, k in enumerate(keys):
+        _, fl = c.begin(k)
+        c.complete(k, fl, value={"i": i})
+    # capacity 2: the oldest (keys[0]) was evicted
+    assert c.begin(keys[0])[0] == "lead"
+    assert c.counters["cache_evictions"] == 1
+    assert c.begin(keys[2])[0] == "hit"
+
+
+def test_respcache_ttl_expiry():
+    c = ResponseCache(capacity=4, ttl_s=0.05)
+    k = c.key("m", 1, b"p")
+    _, fl = c.begin(k)
+    c.complete(k, fl, value={"rows": []})
+    assert c.begin(k)[0] == "hit"
+    time.sleep(0.08)
+    kind, _ = c.begin(k)
+    assert kind == "lead"          # expired -> fresh single-flight
+    assert c.counters["cache_expired"] == 1
+
+
+def test_respcache_coalesce_shares_leader_result():
+    c = ResponseCache(capacity=4)
+    k = c.key("m", 1, b"dup")
+    kind, lead = c.begin(k)
+    assert kind == "lead"
+    got = []
+
+    def follower():
+        kind_f, fl = c.begin(k)
+        assert kind_f == "wait"
+        got.append(ResponseCache.follow(fl, 5.0))
+
+    ts = [threading.Thread(target=follower) for _ in range(4)]
+    for t in ts:
+        t.start()
+    time.sleep(0.05)               # all four parked on the flight
+    c.complete(k, lead, value={"rows": ["shared"]})
+    for t in ts:
+        t.join(timeout=10)
+    assert [v for v, _ in got] == [{"rows": ["shared"]}] * 4
+    assert c.counters["cache_coalesced"] == 4
+    assert c.counters["cache_misses"] == 1
+
+
+def test_respcache_leader_failure_wakes_followers_with_no_value():
+    c = ResponseCache(capacity=4)
+    k = c.key("m", 1, b"boom")
+    _, lead = c.begin(k)
+    kind, fl = c.begin(k)
+    assert kind == "wait"
+    c.complete(k, lead, error=RuntimeError("leader died"))
+    value, err = ResponseCache.follow(fl, 5.0)
+    assert value is None and isinstance(err, RuntimeError)
+    # the failure was NOT cached: next request leads again
+    assert c.begin(k)[0] == "lead"
+
+
+def test_respcache_metrics_sink_and_env_gate(monkeypatch):
+    m = PipelineMetrics()
+    c = ResponseCache(capacity=2, metrics=m)
+    k = c.key("m", 1, b"x")
+    _, fl = c.begin(k)
+    c.complete(k, fl, value={})
+    c.begin(k)
+    assert m.get_counter("cache_misses") == 1
+    assert m.get_counter("cache_hits") == 1
+    monkeypatch.delenv("COS_CACHE_CAP", raising=False)
+    assert ResponseCache.from_env() is None          # default: off
+    monkeypatch.setenv("COS_CACHE_CAP", "8")
+    monkeypatch.setenv("COS_CACHE_TTL_S", "1.5")
+    c2 = ResponseCache.from_env()
+    assert c2.capacity == 8 and c2.ttl_s == 1.5
+
+
+# ------------------------------------------------------------------ chaos
+
+
+def test_replica_slow_knob_parse_and_describe(monkeypatch):
+    monkeypatch.setenv("COS_FAULT_REPLICA_SLOW", "1:8")
+    plan = chaos.resolve(rank=0)
+    assert plan.active
+    assert plan.replica_slow == (1, 8.0)
+    assert plan.replica_slow_factor(1) == 8.0
+    assert plan.replica_slow_factor(0) == 1.0
+    assert plan.replica_slow_factor(-1) == 1.0   # no index assigned
+    assert plan.describe()["replica_slow"] == {"replica": 1,
+                                               "factor": 8.0}
+    monkeypatch.setenv("COS_FAULT_REPLICA_SLOW", "0:0.5")
+    with pytest.raises(ValueError):
+        chaos.resolve(rank=0)
+
+
+def test_replica_slow_is_replica_indexed_not_rank_indexed(monkeypatch):
+    # training slow_rank keys on RANK; the serving straggler keys on
+    # the fleet-assigned replica index — rank must not leak through
+    monkeypatch.setenv("COS_FAULT_REPLICA_SLOW", "2:4")
+    plan = chaos.resolve(rank=2)
+    assert plan.slow_factor == 1.0
+    assert plan.replica_slow_factor(2) == 4.0
+
+
+# ----------------------------------------------------------------- p99.9
+
+
+def test_p99_9_quantile_in_summary_and_prom():
+    m = PipelineMetrics()
+    for i in range(1000):
+        m.add("latency", 0.001 if i else 1.0)   # one 1s outlier
+    st = m.summary()["stages"]["latency"]
+    assert st["p99_9_ms"] >= st["p99_ms"]
+    assert st["p99_9_ms"] == pytest.approx(1000.0)
+    fams = parse_exposition(render_summary(m.summary(),
+                                           {"role": "replica"}))
+    q = [s for s in fams["cos_stage_ms"]["samples"]
+         if s[0].get("quantile") == "0.999"]
+    assert len(q) == 1 and q[0][1] == pytest.approx(1000.0)
+
+
+# ---------------------------------------------------------------- hedging
+
+
+class _Fake:
+    """Minimal scriptable replica: /healthz ok, /v1/predict echoes the
+    record ids after `delay` seconds (the straggler dial)."""
+
+    def __init__(self, delay: float = 0.0):
+        self.delay = delay
+        self.served = 0
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._send(200, {"ok": True, "status": "ok",
+                                 "model_version": 1, "queue_depth": 0})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                if outer.delay:
+                    time.sleep(outer.delay)
+                outer.served += 1
+                self._send(200, {
+                    "rows": [{"SampleID": r.get("id", "")}
+                             for r in req.get("records", [])],
+                    "model_version": 1})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self._thread.join(timeout=10)
+        self.httpd.server_close()
+
+
+def _hedge_router(fakes, **kw):
+    kw.setdefault("policy", RetryPolicy(attempts=3, base_ms=0.1,
+                                        cap_ms=0.5, seed=7))
+    r = Router({f"r{i}": f.url for i, f in enumerate(fakes)}, **kw)
+    for name in r.names():
+        r.set_state(name, OK)
+    return r
+
+
+@pytest.fixture()
+def slow_fast():
+    """r0 is a 1.2 s straggler, r1 answers instantly.  The round-robin
+    tie-break cursor starts at 0, so an idle router's FIRST pick is
+    deterministically r0 — the straggler is always the primary."""
+    fakes = [_Fake(delay=1.2), _Fake()]
+    yield fakes
+    for f in fakes:
+        f.stop()
+
+
+def test_hedge_rescues_straggler(slow_fast):
+    router = _hedge_router(slow_fast, hedge_pct=95, hedge_min_ms=60,
+                           hedge_max_pct=100)
+    t0 = time.monotonic()
+    out = router.predict({"records": [{"id": "a"}]})
+    elapsed = time.monotonic() - t0
+    assert out["rows"] == [{"SampleID": "a"}]
+    # the hedge (fired at ~60 ms) won long before the 1.2 s straggler
+    assert elapsed < 0.8, elapsed
+    c = router.metrics_summary()["counters"]
+    assert c["hedges_fired"] == 1
+    assert c["hedges_won"] == 1
+    assert slow_fast[1].served == 1
+
+
+def test_late_loser_discarded_never_corrupts_later_requests(slow_fast):
+    """After a hedge win the straggler's response is still in flight;
+    it must evaporate — every LATER request gets exactly its own
+    answer, id for id."""
+    router = _hedge_router(slow_fast, hedge_pct=95, hedge_min_ms=60,
+                           hedge_max_pct=100)
+    out = router.predict({"records": [{"id": "first"}]})
+    assert out["rows"] == [{"SampleID": "first"}]
+    # while the loser is STILL in flight, issue distinct requests
+    for i in range(3):
+        got = router.predict({"records": [{"id": f"r{i}"}]})
+        assert got["rows"] == [{"SampleID": f"r{i}"}], got
+    time.sleep(1.3)        # the loser lands into the void
+    got = router.predict({"records": [{"id": "after"}]})
+    assert got["rows"] == [{"SampleID": "after"}]
+    assert slow_fast[0].served >= 1   # it DID answer; nobody listened
+
+
+def test_hedge_budget_cap_zero_disables_hedging(slow_fast):
+    router = _hedge_router(slow_fast, hedge_pct=95, hedge_min_ms=60,
+                           hedge_max_pct=0)
+    t0 = time.monotonic()
+    out = router.predict({"records": [{"id": "x"}]})
+    assert time.monotonic() - t0 > 1.0   # rode out the straggler
+    assert out["rows"] == [{"SampleID": "x"}]
+    c = router.metrics_summary()["counters"]
+    assert c.get("hedges_fired", 0) == 0
+
+
+def test_hedge_off_by_default_is_inert(slow_fast):
+    router = _hedge_router(slow_fast)      # no knobs: hedging off
+    assert router.hedge_pct == 0
+    t0 = time.monotonic()
+    router.predict({"records": [{"id": "x"}]})
+    assert time.monotonic() - t0 > 1.0
+    m = router.metrics_summary()
+    assert "hedge" not in m
+    assert m["counters"].get("hedges_fired", 0) == 0
+
+
+def test_router_replica_latency_gauges_and_prom():
+    fakes = [_Fake(), _Fake()]
+    try:
+        router = _hedge_router(fakes)
+        for i in range(6):
+            router.predict({"records": [{"id": str(i)}]})
+        reps = router.metrics_summary()["replicas"]
+        assert all(r["lat_ewma_ms"] > 0 for r in reps.values())
+        assert all("lat_p95_ms" in r for r in reps.values())
+        fams = parse_exposition(router.prom_summary())
+        ewma = fams["cos_replica_lat_ewma_ms"]["samples"]
+        assert {s[0]["replica"] for s in ewma} == {"r0", "r1"}
+        assert all(v > 0 for _, v in ewma)
+    finally:
+        for f in fakes:
+            f.stop()
+
+
+def test_hedge_budget_adapts_to_observed_p95():
+    fakes = [_Fake(), _Fake()]
+    try:
+        router = _hedge_router(fakes, hedge_pct=95, hedge_min_ms=1,
+                               hedge_max_pct=100)
+        for i in range(30):
+            router.predict({"records": [{"id": str(i)}]})
+        budget = router.metrics_summary()["hedge"]["budget_ms"]
+        # fast fakes: the adaptive budget tracked the observed p95
+        # (single-digit ms), not the 1 ms floor alone and not a fixed
+        # default — and stays far below any straggler's 1.2 s
+        assert 1 <= budget < 500
+    finally:
+        for f in fakes:
+            f.stop()
+
+
+# ------------------------------------- cache integration (real serving)
+
+NET_TMPL = """
+name: "tiny"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param {{ source: "{root}/unused_lmdb" batch_size: 8
+    channels: 1 height: 12 width: 12 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param {{ num_output: 4 kernel_size: 3
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "relu" type: "ReLU" bottom: "conv1" top: "conv1" }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "conv1" top: "ip"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}
+"""
+
+SOLVER_TMPL = """
+net: "{net}"
+base_lr: 0.01
+momentum: 0.9
+lr_policy: "fixed"
+max_iter: 20
+random_seed: 5
+"""
+
+
+@pytest.fixture(scope="module")
+def tiny_model(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("tail_model")
+    net_path = tmp_path / "net.prototxt"
+    net_path.write_text(NET_TMPL.format(root=tmp_path))
+    solver_path = tmp_path / "solver.prototxt"
+    solver_path.write_text(SOLVER_TMPL.format(net=net_path))
+    s = Solver(SolverParameter.from_text(
+        SOLVER_TMPL.format(net=net_path)),
+        NetParameter.from_text(NET_TMPL.format(root=tmp_path)))
+    params, _ = s.init()
+    model = str(tmp_path / "m.caffemodel")
+    checkpoint.save_caffemodel(model, s.train_net, params)
+    return str(solver_path), model
+
+
+def _payload(n=2, seed=0):
+    rng = np.random.RandomState(seed)
+    return json.dumps({"records": [
+        {"id": f"r{i}", "data": rng.rand(1, 12, 12).round(4).tolist()}
+        for i in range(n)]}).encode()
+
+
+def _post(port, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.read()
+
+
+@pytest.fixture()
+def cached_server(tiny_model, monkeypatch):
+    monkeypatch.setenv("COS_CACHE_CAP", "32")
+    solver_path, model = tiny_model
+    conf = Config(["-conf", solver_path, "-model", model])
+    svc = InferenceService(conf, blob_names=("ip",),
+                           max_wait_ms=1.0).start()
+    server = ServingHTTPServer(svc).start_background()
+    yield svc, server
+    server.stop()
+    svc.stop()
+
+
+def test_cache_hit_is_byte_identical_and_skips_execution(cached_server):
+    svc, server = cached_server
+    body = _payload(seed=1)
+    cold = _post(server.port, body)
+    rows_before = svc.metrics.get_counter("served_rows")
+    hot = _post(server.port, body)
+    assert hot == cold                       # byte-identical wire
+    assert svc.metrics.get_counter("served_rows") == rows_before
+    assert svc.respcache.counters["cache_hits"] == 1
+    st = svc.metrics_summary()["respcache"]
+    assert st["entries"] == 1 and st["capacity"] == 32
+
+
+def test_cache_reload_invalidates_via_version(cached_server,
+                                              tiny_model):
+    svc, server = cached_server
+    body = _payload(seed=2)
+    first = json.loads(_post(server.port, body))
+    svc.reload(tiny_model[1])                # same weights, new version
+    misses_before = svc.respcache.counters["cache_misses"]
+    second = json.loads(_post(server.port, body))
+    assert svc.respcache.counters["cache_misses"] == misses_before + 1
+    assert second["model_version"] == first["model_version"] + 1
+    assert second["rows"] == first["rows"]   # same weights after all
+
+
+def test_concurrent_duplicates_coalesce_to_one_execution(cached_server):
+    svc, server = cached_server
+    body = _payload(seed=3)
+    orig_run = svc.batcher.run_batch
+
+    def slow_run(*a, **kw):
+        time.sleep(0.4)                      # hold the leader open
+        return orig_run(*a, **kw)
+
+    svc.batcher.run_batch = slow_run
+    rows_before = svc.metrics.get_counter("served_rows")
+    out, errs = [], []
+
+    def hit():
+        try:
+            out.append(_post(server.port, body))
+        except BaseException as e:            # noqa: BLE001
+            errs.append(e)
+
+    leader = threading.Thread(target=hit)
+    leader.start()
+    time.sleep(0.15)                          # leader is mid-flight
+    followers = [threading.Thread(target=hit) for _ in range(5)]
+    for t in followers:
+        t.start()
+    for t in [leader] + followers:
+        t.join(timeout=30)
+    assert not errs
+    assert len(set(out)) == 1 and len(out) == 6   # all byte-identical
+    # ONE device execution served all six requests
+    assert svc.metrics.get_counter("served_rows") - rows_before == 2
+    assert svc.respcache.counters["cache_coalesced"] == 5
+
+
+def test_cache_off_has_no_cache_object(tiny_model, monkeypatch):
+    monkeypatch.delenv("COS_CACHE_CAP", raising=False)
+    solver_path, model = tiny_model
+    conf = Config(["-conf", solver_path, "-model", model])
+    svc = InferenceService(conf, blob_names=("ip",))
+    assert svc.respcache is None
+    assert "respcache" not in svc.metrics_summary()
