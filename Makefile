@@ -10,7 +10,8 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   smoke tpu-tests bench-evidence bench-ingest bench-steploop \
   bench-serving bench-serving-sharded bench-serving-multimodel \
   bench-gradsync bench-syncmode bench-autotune bench-deploy \
-  bench-obs bench-tail chaos chaos-deploy onchip-artifacts docs clean
+  bench-obs bench-tail bench-prodday prodday-smoke chaos \
+  chaos-deploy onchip-artifacts docs clean
 
 build: native install
 
@@ -142,6 +143,23 @@ bench-tail:
 	$(CPU_ENV) $(PY) scripts/bench_tail.py \
 	  --out bench_evidence/bench_tail.json
 
+# production-day replay: checked-in scenarios (scenarios/*.json)
+# through the prodday harness — compressed day with scheduled chaos
+# against the full deploy loop, plus the red/green flash-crowd +
+# straggler A/B (hedging/cache off must go red, on must go green);
+# ALWAYS exits 0 with one JSON document on stdout (bench.py contract)
+bench-prodday:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_prodday.py \
+	  --out bench_evidence/bench_prodday.json
+
+# tier-1-safe smoke day (<60s): scenarios/prodday_smoke.json only,
+# no deploy faults, no A/B cell
+prodday-smoke:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_prodday.py --quick \
+	  --out bench_evidence/bench_prodday_quick.json
+
 # online serving: dynamic micro-batching vs batch=1 dispatch across
 # offered loads; JSON artifact with p50/p99 latency + rows/s per cell
 bench-serving:
@@ -206,6 +224,8 @@ bench-evidence:
 	  --out bench_evidence/bench_obs.json
 	-$(CPU_ENV) $(PY) scripts/bench_tail.py \
 	  --out bench_evidence/bench_tail.json
+	-$(CPU_ENV) $(PY) scripts/bench_prodday.py \
+	  --out bench_evidence/bench_prodday.json
 
 # everything the judge wants from ONE healthy tunnel window, in
 # priority order: headline number + evidence, on-chip test artifact,

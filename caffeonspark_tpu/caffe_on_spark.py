@@ -365,20 +365,27 @@ def _cli_spark_context(conf: Config):
 
 
 def _serve_sigterm_drains() -> None:
-    """Route SIGTERM onto the SIGINT drain path.  The fleet/supervisor
-    teardown (tools/supervisor.terminate_processes) sends SIGTERM with
-    a grace window precisely so accepted serving work can flush;
-    without a handler Python's default disposition kills the process
-    instantly and the drain never runs.  The flight recorder dumps
-    FIRST — if the grace window closes and SIGKILL lands mid-drain,
-    the event timeline is already on disk (COS_RECORDER_DUMP)."""
+    """Route SIGTERM — and an operator's Ctrl-C — onto the same
+    drain-then-exit path.  The fleet/supervisor teardown
+    (tools/supervisor.terminate_processes) sends SIGTERM with a grace
+    window precisely so accepted serving work can flush; without a
+    handler Python's default disposition kills the process instantly
+    and the drain never runs.  The flight recorder dumps FIRST — if
+    the grace window closes and SIGKILL lands mid-drain, the event
+    timeline is already on disk (COS_RECORDER_DUMP).  SIGINT gets the
+    same treatment: Python's default KeyboardInterrupt would run the
+    drain but dump the ring only at the very end of the finally block
+    — a second Ctrl-C mid-drain would lose it, so the dump lands
+    before the drain here too."""
     def handler(signum, frame):
         from .obs.recorder import maybe_dump, record
-        record("serve", "signal", signal="SIGTERM")
-        maybe_dump("sigterm")
+        name = "SIGINT" if signum == signal.SIGINT else "SIGTERM"
+        record("serve", "signal", signal=name)
+        maybe_dump(name.lower())
         raise KeyboardInterrupt
     try:
         signal.signal(signal.SIGTERM, handler)
+        signal.signal(signal.SIGINT, handler)
     except ValueError:
         pass                  # not the main thread (embedded): skip
 

@@ -211,10 +211,15 @@ class DeployController:
             self.fleet = None
 
     # -- chaos --------------------------------------------------------
-    def refresh_faults(self) -> None:
+    def refresh_faults(self, env: Optional[dict] = None) -> None:
         """Re-resolve COS_FAULT_* (host-side) — drills/bench flip the
         deploy knobs between rounds; a long-lived controller picks
-        them up here instead of re-reading env anywhere else."""
+        them up here instead of re-reading env anywhere else.  `env`
+        optionally applies `{COS_FAULT_*: value|None}` updates first
+        (chaos.apply_fault_env — the prodday scenario engine's
+        scheduled-chaos hook; None clears a knob)."""
+        if env:
+            chaos.apply_fault_env(env)
         self.injector = chaos.make_injector()
         self._publish_info()
 

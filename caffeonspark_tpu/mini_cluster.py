@@ -179,8 +179,12 @@ class MiniCluster:
         from .obs.recorder import maybe_dump, record
 
         def on_int(sig, frame):
+            # an operator Ctrl-C mid-drill must not lose the ring:
+            # the recorder dumps on SIGINT exactly like SIGTERM, then
+            # the normal drain (snapshot + exit) runs
             print("\nSIGINT → stop (snapshot + exit)", file=sys.stderr)
             record("trainer", "signal", signal="SIGINT")
+            maybe_dump("sigint")
             self._stop = True
 
         def on_hup(sig, frame):

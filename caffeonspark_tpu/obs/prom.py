@@ -13,8 +13,13 @@ without a second bookkeeping path:
                    `cos_stage_ms_max` / `cos_stage_ms_mean` (gauges)
   gauges        -> `cos_gauge_mean` / `cos_gauge_max` /
                    `cos_gauge_samples_total` with a `name` label
-  steps         -> `cos_steps_total`; steady_steps_per_sec, uptime,
-                   queue_depth_now, model_version -> plain gauges
+  steps         -> `cos_steps_total`; steady_steps_per_sec, uptime
+                   (`cos_uptime_seconds`), queue_depth_now,
+                   model_version -> plain gauges
+  build_info    -> `cos_build_info` info-gauge (value 1; net digest /
+                   serve mesh / weight dtype / pid as labels — with
+                   uptime, the restart detector for scrape-based
+                   error budgets)
   router table  -> `cos_replica_up{replica,state}` /
                    `cos_replica_outstanding` /
                    `cos_replica_requests_total` / ..._failures_total /
@@ -124,6 +129,20 @@ class PromWriter:
             self.sample("steps_total", "counter",
                         "completed solver steps", summary["steps"],
                         base)
+        bi = summary.get("build_info")
+        if bi:
+            # info-gauge (value pinned to 1, identity rides in the
+            # labels): with cos_uptime_seconds this is how scrape-based
+            # error-budget accounting detects a replica RESTART between
+            # scrapes — pid/net-digest label change or uptime decrease
+            # — instead of misreading the counter reset as a negative
+            # rate
+            self.sample("build_info", "gauge",
+                        "process identity info-gauge (value always 1; "
+                        "net digest / serve mesh / weight dtype / pid "
+                        "ride as labels)", 1.0,
+                        dict(base, **{str(k): str(v)
+                                      for k, v in bi.items()}))
         for key, fam, help_text in (
                 ("uptime_s", "uptime_seconds", "process uptime"),
                 ("steady_steps_per_sec", "steady_steps_per_sec",

@@ -144,3 +144,37 @@ def maybe_dump(reason: str) -> Optional[str]:
     except Exception as e:          # noqa: BLE001 — best-effort
         _LOG.warning("flight-recorder dump to %s failed: %s", path, e)
         return None
+
+
+def load_dump_dir(path: str) -> List[dict]:
+    """Merge every `recorder-*.json` dump under `path` (the per-pid
+    artifacts a COS_RECORDER_DUMP directory accumulates across a
+    fleet) into ONE causally-ordered timeline: events sorted by wall
+    timestamp, ties broken by (pid, seq) so one process's own order
+    is never shuffled.  Each event gains a `pid` field naming the
+    process it came from — what incident reconstruction (prodday)
+    walks to explain injected faults.  Unreadable/truncated dumps are
+    skipped (a SIGKILL racing a dump must not sink the whole
+    reconstruction)."""
+    merged: List[dict] = []
+    try:
+        names = sorted(os.listdir(path))
+    except OSError:
+        return merged
+    for name in names:
+        if not (name.startswith("recorder-")
+                and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(path, name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if doc.get("schema") != "cos-flight-recorder-v1":
+            continue
+        pid = doc.get("pid")
+        for ev in doc.get("events") or []:
+            merged.append(dict(ev, pid=pid))
+    merged.sort(key=lambda e: (e.get("ts", 0.0), e.get("pid") or 0,
+                               e.get("seq", 0)))
+    return merged

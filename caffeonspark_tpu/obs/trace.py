@@ -356,14 +356,18 @@ class Tracer:
         return self._spool_path
 
     def recent(self, trace_id: Optional[str] = None,
-               limit: int = 1024) -> List[dict]:
+               limit: int = 1024, min_ms: float = 0.0) -> List[dict]:
         """Finished spans, oldest first (ring order), optionally
-        filtered to one trace."""
+        filtered to one trace and/or to spans at least `min_ms` long
+        (the slow-exemplar query: pull one incident's spans without
+        downloading the whole ring)."""
         self._absorb_staged()
         with self._lock:
             spans = list(self._ring)
         if trace_id:
             spans = [r for r in spans if r[0] == trace_id]
+        if min_ms > 0:
+            spans = [r for r in spans if r[5] * 1e3 >= min_ms]
         return [self._rec_to_dict(r) for r in spans[-limit:]]
 
     def reconfigure(self, sample: Optional[float] = None,

@@ -1,0 +1,33 @@
+"""Production-day replay harness (ROADMAP item: whole-system drill).
+
+Every subsystem has its own drill (chaos, canary, tail-latency,
+sync-mode); `prodday` exercises them TOGETHER on a compressed
+wall-clock and turns the PR 15 observability substrate — tracing,
+flight recorder, Prometheus exposition — into a verdict engine:
+
+  scenario.py   a scenario is a checked-in JSON data file (phases,
+                load shapes, scheduled chaos) validated with
+                line-precise errors — new scenarios are data, not code
+  traffic.py    open-loop traffic generator: diurnal/flash load
+                curves, zipfian payload mix, malformed-payload
+                injection, per-class tenants
+  engine.py     runs a scenario against a live stack (fleet router +
+                optional deploy loop), firing scheduled faults through
+                the COS_FAULT_* runtime hooks
+  verdict.py    per-phase SLO / error-budget accounting from periodic
+                prom scrapes, incident reconstruction from merged
+                flight-recorder dumps, slow-request trace exemplars
+  leaks.py      end-of-day leak gates: fds, child processes, threads,
+                registry residency vs start-of-day
+
+Knobs: COS_PRODDAY_SCRAPE_S, COS_PRODDAY_RECOVERY_S,
+COS_PRODDAY_EXEMPLARS, COS_PRODDAY_INFLIGHT (docs/tuning.md).
+"""
+
+from .engine import ProdDay, FleetStack                    # noqa: F401
+from .leaks import leak_gates, snapshot_leaks              # noqa: F401
+from .scenario import (Scenario, ScenarioError,            # noqa: F401
+                       load_scenario, parse_scenario)
+from .traffic import TrafficGen                            # noqa: F401
+from .verdict import (PromScraper, error_budget,           # noqa: F401
+                      reconstruct_incidents)

@@ -1,0 +1,382 @@
+"""Production-day engine: run a scenario against a live stack and
+emit the day's verdict document.
+
+One compressed day = scenario phases driven in order:
+
+  * an open-loop TrafficGen replays each phase's load shape against
+    the router (zipfian payload mix, malformed injection, tenant
+    classes → per-model FlushLanes);
+  * a fault scheduler fires each phase's chaos at its at_s on the
+    compressed clock, through the EXISTING runtime hooks only —
+    `Fleet.kill_replica`, POST /v1/faults (set_replica_fault),
+    `DeployController.refresh_faults(env)` — never by reaching into
+    internals (the drill must exercise the same levers an operator
+    has);
+  * a PromScraper samples the router's fleet-aggregated exposition
+    on the scrape interval — the verdict engine sees the day only
+    through those scrapes plus the flight-recorder dumps, exactly
+    the operator's view.
+
+End of day: stop the stack (SIGTERM → every replica's recorder dump
+lands in COS_RECORDER_DUMP), dump the harness's own ring, merge, and
+judge — per-phase SLO/error budgets, incident reconstruction (every
+injected fault explained), slow-trace exemplars, leak gates against
+the pre-start snapshot.
+
+Knobs (resolved once, constructor time — COS003):
+
+  COS_PRODDAY_SCRAPE_S    scrape interval override (default: the
+                          scenario's scrape_interval_s)
+  COS_PRODDAY_RECOVERY_S  deadline for a fault's recovery event in
+                          the merged timeline (default 60)
+  COS_PRODDAY_EXEMPLARS   slowest-request traces kept (default 3)
+  COS_PRODDAY_INFLIGHT    traffic generator in-flight cap (default 64)
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..obs import recorder
+from ..obs.trace import SpanCtx
+from ..tools import chaos
+from ..utils.envutils import env_int, env_num
+from .leaks import leak_gates, snapshot_leaks
+from .scenario import Fault, Scenario, Tenant
+from .traffic import TrafficGen, summarize
+from .verdict import (PromScraper, detect_restarts, error_budget,
+                      reconstruct_incidents, slow_exemplars)
+
+
+class FleetStack:
+    """The engine's view of the system under test: a DeployController
+    (full PR 13 loop — streaming ingest → fine-tune → canary → fleet)
+    or a bare Fleet, behind the handful of operator-shaped verbs the
+    scenario kinds map onto."""
+
+    def __init__(self, controller=None, fleet=None):
+        if controller is None and fleet is None:
+            raise ValueError("FleetStack needs a controller or fleet")
+        self.controller = controller
+        self.fleet = fleet
+        self._round_lock = threading.Lock()
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> "FleetStack":
+        if self.controller is not None:
+            if self.controller.fleet is None:
+                self.controller.start()
+            self.fleet = self.controller.fleet
+        elif not self.fleet.replicas:
+            self.fleet.start()
+        return self
+
+    def stop(self) -> None:
+        if self.controller is not None:
+            self.controller.stop()
+            self.fleet = None
+        elif self.fleet is not None:
+            self.fleet.stop()
+
+    # -- traffic ------------------------------------------------------
+    def predict(self, payload: bytes, tenant: Tenant,
+                trace_id: Optional[str]) -> int:
+        """One client request through the router; returns the HTTP
+        status the CLIENT saw (router retries/hedges are invisible
+        here, as they are to a real client).  A caller-chosen trace
+        id rides in as the parent ctx so the request's attempt spans
+        land under an id the harness can query back."""
+        from ..serving.router import RouterRequestError
+        query = f"model={tenant.model}" if tenant.model else ""
+        trace = SpanCtx(trace_id, "0" * 16) if trace_id else None
+        try:
+            self.fleet.router.predict(payload, query=query,
+                                      trace=trace)
+            return 200
+        except RouterRequestError as e:
+            return e.code
+
+    # -- observability ------------------------------------------------
+    def scrape(self) -> str:
+        return self.fleet.router.prom_summary()
+
+    def collect_traces(self, trace_id: str) -> List[dict]:
+        return self.fleet.router.collect_traces(trace_id, min_ms=0.0)
+
+    def residency(self) -> Dict[str, List[str]]:
+        out: Dict[str, List[str]] = {}
+        for model, st in self.fleet.router.models_summary().items():
+            if isinstance(st, dict):
+                out[model] = list(st.get("resident_on") or [])
+        return out
+
+    # -- chaos verbs --------------------------------------------------
+    def kill_replica(self, index: int) -> None:
+        self.fleet.kill_replica(f"replica{index}")
+
+    def set_replica_fault(self, index: int,
+                          env: Dict[str, Optional[str]]) -> None:
+        self.fleet.set_replica_fault(f"replica{index}", env)
+
+    def refresh_faults(self, env: Dict[str, Optional[str]]) -> None:
+        if self.controller is not None:
+            self.controller.refresh_faults(env)
+        else:
+            chaos.apply_fault_env(env)
+
+    def settle(self, timeout_s: float = 30.0) -> bool:
+        """Wait until every replica is alive and routable (state=ok)
+        — end-of-day runs this so a kill near the day's end still
+        gets its respawn (and the scraper still gets the new pid's
+        build_info, which is what explains the counter reset)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            reps = self.fleet.router.metrics_summary()["replicas"]
+            if reps and all(r.get("state") == "ok"
+                            for r in reps.values()) \
+                    and all(rep.alive()
+                            for rep in self.fleet.replicas.values()):
+                return True
+            time.sleep(0.25)
+        return False
+
+    def run_round(self) -> dict:
+        """One full deploy round; serialized — the controller's round
+        loop is single-operator by design, and two scheduled faults
+        both wanting 'the next round' must take turns."""
+        if self.controller is None:
+            raise RuntimeError("scenario schedules a deploy round "
+                               "but the stack has no controller")
+        with self._round_lock:
+            return self.controller.run_round()
+
+
+class ProdDay:
+    """Run one scenario; `run()` returns the verdict document."""
+
+    def __init__(self, scenario: Scenario, stack: FleetStack, *,
+                 payload_pool: List[bytes],
+                 malformed_pool: Optional[List[bytes]] = None,
+                 dump_dir: Optional[str] = None):
+        self.scenario = scenario
+        self.stack = stack
+        self.payload_pool = payload_pool
+        self.malformed_pool = malformed_pool or []
+        self.dump_dir = dump_dir
+        self.scrape_s = env_num("COS_PRODDAY_SCRAPE_S",
+                                scenario.scrape_interval_s,
+                                strict=False)
+        self.recovery_s = env_num("COS_PRODDAY_RECOVERY_S", 60.0,
+                                  strict=False)
+        self.exemplars_n = env_int("COS_PRODDAY_EXEMPLARS", 3,
+                                   strict=False)
+        self.inflight_cap = env_int("COS_PRODDAY_INFLIGHT", 64,
+                                    strict=False)
+        self.injected: List[dict] = []
+        self.fault_errors: List[str] = []
+        self._inj_lock = threading.Lock()
+        # one-shot chaos knobs (canary kill, snapshot truncate,
+        # reload fail) latch on marker FILES — each firing gets its
+        # own, so two scheduled faults of one kind both fire
+        self._work = tempfile.mkdtemp(prefix="cos_prodday_")
+
+    # -- fault firing -------------------------------------------------
+    def _record_injection(self, fault: Fault, phase: str,
+                          error: Optional[str] = None) -> None:
+        rec = dict(fault.to_dict(), phase=phase,
+                   t_wall=time.time())
+        if error:
+            rec["error"] = error
+            self.fault_errors.append(
+                f"{phase}/{fault.kind}@{fault.at_s:g}s: {error}")
+        with self._inj_lock:
+            self.injected.append(rec)
+
+    def _fire(self, fault: Fault, phase: str,
+              stop: threading.Event) -> None:
+        """One scheduled fault's whole lifecycle in its own thread:
+        wait for at_s, fire through the operator hook, and (stateful
+        kinds) wait again and clear at clear_at_s.  The injection is
+        recorded even when the hook errors — an injector that
+        silently did nothing must FAIL reconstruction, not vanish
+        from it."""
+        recorded = [False]
+
+        def note(error=None):
+            recorded[0] = True
+            self._record_injection(fault, phase, error=error)
+
+        try:
+            if fault.kind == "replica_kill":
+                note()
+                self.stack.kill_replica(fault.replica)
+            elif fault.kind == "replica_slow":
+                knob = {"COS_FAULT_REPLICA_SLOW":
+                        f"{fault.replica}:{fault.factor:g}"}
+                note()
+                self.stack.set_replica_fault(fault.replica, knob)
+                if fault.clear_at_s is not None:
+                    stop.wait(fault.clear_at_s - fault.at_s)
+                    self.stack.set_replica_fault(
+                        fault.replica, {"COS_FAULT_REPLICA_SLOW":
+                                        None})
+            elif fault.kind == "flaky_storage":
+                note()
+                self.stack.refresh_faults(
+                    {"COS_FAULT_FLAKY_STORAGE": f"{fault.p:g}"})
+                if fault.clear_at_s is not None:
+                    stop.wait(fault.clear_at_s - fault.at_s)
+                    self.stack.refresh_faults(
+                        {"COS_FAULT_FLAKY_STORAGE": None})
+            elif fault.kind in ("snapshot_truncate", "canary_kill",
+                                "reload_fail"):
+                # deploy-loop faults: arm the knob, run the round the
+                # fault manifests in, then disarm — the same flip/
+                # round/flip sequence the deploy drills use
+                marker = os.path.join(
+                    self._work,
+                    f"{phase}-{fault.kind}-{fault.at_s:g}.marker")
+                knob = {
+                    "snapshot_truncate":
+                        {"COS_FAULT_SNAPSHOT_TRUNCATE": marker},
+                    "canary_kill":
+                        {"COS_FAULT_CANARY_KILL":
+                         f"{fault.after_requests}:{marker}"},
+                    "reload_fail":
+                        {"COS_FAULT_RELOAD_FAIL_RANK":
+                         f"{fault.replica}:{marker}"},
+                }[fault.kind]
+                self.stack.refresh_faults(knob)
+                note()
+                try:
+                    self.stack.run_round()
+                finally:
+                    self.stack.refresh_faults(
+                        {k: None for k in knob})
+            elif fault.kind == "deploy_round":
+                # an ACTION, not a fault: no injection record, no
+                # reconstruction obligation
+                self.stack.run_round()
+        except Exception as e:       # noqa: BLE001 — surfaced in doc
+            if fault.kind == "deploy_round" or recorded[0]:
+                self.fault_errors.append(
+                    f"{phase}/{fault.kind}@{fault.at_s:g}s: {e}")
+            else:
+                note(error=str(e))
+
+    def _schedule_phase_faults(self, phase, stop: threading.Event
+                               ) -> List[threading.Thread]:
+        threads = []
+        for fault in phase.faults:
+            def run(f=fault):
+                if not stop.wait(f.at_s):
+                    self._fire(f, phase.name, stop)
+            th = threading.Thread(
+                target=run, daemon=True,
+                name=f"cos-prodday-fault-{phase.name}-{fault.kind}")
+            th.start()
+            threads.append(th)
+        return threads
+
+    # -- the day ------------------------------------------------------
+    def run(self) -> dict:
+        sc = self.scenario
+        start_snap = snapshot_leaks()
+        self.stack.start()
+        start_snap["resident_pairs"] = snapshot_leaks(
+            self.stack.residency())["resident_pairs"]
+        scraper = PromScraper(self.stack.scrape,
+                              interval_s=self.scrape_s).start()
+        gen = TrafficGen(self.stack.predict, self.payload_pool,
+                         self.malformed_pool, seed=sc.seed,
+                         inflight_cap=self.inflight_cap)
+        recorder.record("prodday", "day_start",
+                              scenario=sc.name)
+        fault_stop = threading.Event()
+        fault_threads: List[threading.Thread] = []
+        phase_runs = []              # (phase, t0, t1, results)
+        for phase in sc.phases:
+            recorder.record("prodday", "phase_start",
+                                  phase=phase.name)
+            fault_threads += self._schedule_phase_faults(phase,
+                                                         fault_stop)
+            t0 = time.monotonic()
+            results = gen.run_phase(phase.load, phase.duration_s)
+            phase_runs.append((phase, t0, time.monotonic(), results))
+        # let in-flight deploy rounds land before judging (they carry
+        # the recovery events reconstruction is owed), then release
+        # any still-armed clear timers
+        for th in fault_threads:
+            th.join(timeout=180.0)
+        fault_stop.set()
+        stragglers = [th.name for th in fault_threads
+                      if th.is_alive()]
+        # recovery settle BEFORE the scraper stops: a kill near the
+        # day's end needs its respawn scraped (new pid in
+        # cos_build_info) for the counter reset to be explained
+        settled = self.stack.settle()
+        scraper.stop()
+        recorder.record("prodday", "day_end", scenario=sc.name)
+
+        all_results = [r for _, _, _, rs in phase_runs for r in rs]
+        exemplars = slow_exemplars(all_results,
+                                   self.stack.collect_traces,
+                                   n=self.exemplars_n)
+        residency_end = self.stack.residency()
+        self.stack.stop()            # SIGTERM → replica dumps land
+        recorder.maybe_dump("prodday_end")
+        end_snap = snapshot_leaks()
+        end_snap["resident_pairs"] = snapshot_leaks(
+            residency_end)["resident_pairs"]
+        leaks = leak_gates(start_snap, end_snap)
+
+        timeline = (recorder.load_dump_dir(self.dump_dir)
+                    if self.dump_dir else
+                    recorder.get_recorder().events())
+        reconstruction = reconstruct_incidents(
+            timeline, self.injected,
+            recovery_deadline_s=self.recovery_s)
+
+        restarts = detect_restarts(scraper.samples)
+        phase_docs = []
+        for phase, t0, t1, results in phase_runs:
+            traffic = summarize(results)
+            budget = error_budget(scraper.samples, t0, t1, phase.slo,
+                                  restarts=restarts)
+            phase_docs.append({
+                "name": phase.name,
+                "duration_s": phase.duration_s,
+                "traffic": traffic,
+                "budget": budget,
+                "ok": bool(budget["slo_ok"]
+                           and traffic["malformed_mishandled"] == 0),
+            })
+        doc = {
+            "scenario": {"name": sc.name, "seed": sc.seed,
+                         "duration_s": sc.duration_s,
+                         "phases": len(sc.phases)},
+            "phases": phase_docs,
+            "incidents": reconstruction,
+            "leaks": leaks,
+            "exemplars": exemplars,
+            "restarts_detected": restarts,
+            "settled": settled,
+            "scrape_samples": len(scraper.samples),
+            "scrape_parse_errors": scraper.parse_errors,
+            "fault_errors": self.fault_errors,
+            "fault_stragglers": stragglers,
+        }
+        doc["gates"] = {
+            "slo": all(p["ok"] for p in phase_docs),
+            "incidents_explained": reconstruction["ok"],
+            "leaks": bool(leaks["ok"]),
+            "scrapes_clean": not scraper.parse_errors,
+            "faults_clean": not self.fault_errors
+            and not stragglers,
+        }
+        doc["ok"] = all(doc["gates"].values())
+        return doc

@@ -1,0 +1,147 @@
+"""End-of-day leak gates: what a compressed production day must NOT
+accumulate.
+
+A day of chaos (replica kills, respawns, deploy rounds, canary
+subprocesses) exercises every create/destroy path in the repo; the
+leak gates compare a start-of-day snapshot against end-of-day and
+fail the run when something survived that shouldn't have:
+
+  fds        open file descriptors of the harness process
+             (/proc/self/fd) — a leaked socket or spool handle per
+             round compounds into EMFILE on a real day
+  children   live child processes (walk /proc for ppid == us) — a
+             replica or canary the teardown failed to reap
+  threads    named live threads — a poller/monitor thread that
+             outlived its stop()
+  residency  HBM/registry residency: (model, replica) resident pairs
+             reported by the serving stack — a paged-in model nothing
+             references any more
+
+Each gate carries a small tolerance (allowlist + slack) because the
+process model has legitimate lazily-created singletons (the trace
+spool drainer thread, the recorder); the gates are calibrated so a
+PLANTED leak of each class trips its gate (pinned by tests).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+# threads the process legitimately creates lazily and never joins
+# (module singletons); a leak gate must not flag the first drill that
+# happened to touch tracing
+THREAD_ALLOWLIST = ("cos-trace-spool", "cos-metrics-flusher",
+                    "pydevd", "MainThread")
+
+
+def open_fds() -> Optional[List[str]]:
+    """Open fd numbers of this process (None when /proc is absent —
+    the gate then reports 'skipped' instead of guessing)."""
+    try:
+        return sorted(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None
+
+
+def child_pids() -> Optional[List[int]]:
+    """Live direct children of this process via /proc/*/stat ppid
+    (field 4 — after the parenthesized comm, which may itself contain
+    spaces, so parse from the LAST ')')."""
+    me = os.getpid()
+    out: List[int] = []
+    try:
+        entries = os.listdir("/proc")
+    except OSError:
+        return None
+    for name in entries:
+        if not name.isdigit():
+            continue
+        try:
+            with open(f"/proc/{name}/stat") as f:
+                stat = f.read()
+            rest = stat[stat.rfind(")") + 2:].split()
+            state, ppid = rest[0], int(rest[1])
+        except (OSError, ValueError, IndexError):
+            continue
+        if ppid == me and state != "Z":     # reaped zombies don't count
+            out.append(int(name))
+    return sorted(out)
+
+
+def thread_names() -> List[str]:
+    return sorted(t.name for t in threading.enumerate() if t.is_alive())
+
+
+def snapshot_leaks(residency: Optional[Dict[str, List[str]]] = None
+                   ) -> dict:
+    """One comparable snapshot.  `residency` is the serving stack's
+    {model: [replica, ...]} resident map (engine supplies it from
+    router /v1/models; None = not applicable)."""
+    resident_pairs = sorted(
+        f"{m}@{r}" for m, reps in (residency or {}).items()
+        for r in reps)
+    return {"fds": open_fds(), "children": child_pids(),
+            "threads": thread_names(),
+            "resident_pairs": resident_pairs}
+
+
+def _gate(ok: Optional[bool], detail: dict) -> dict:
+    out = {"ok": ok, **detail}
+    if ok is None:
+        out["skipped"] = True
+    return out
+
+
+def leak_gates(start: dict, end: dict, *, fd_slack: int = 2,
+               thread_allow: tuple = THREAD_ALLOWLIST,
+               residency_slack: int = 0) -> dict:
+    """Compare two snapshots; returns per-gate verdicts + overall.
+
+    fds: end count may exceed start by at most `fd_slack` (lazily
+    opened singletons like the trace spool file are real and fine;
+    a per-round leak is not).  children: every end-of-day child must
+    have existed at start (no tolerance — the harness owns its
+    process tree).  threads: any non-allowlisted thread present at
+    end but not at start fails.  residency: at most
+    `residency_slack` new (model, replica) resident pairs."""
+    gates: Dict[str, dict] = {}
+
+    if start.get("fds") is None or end.get("fds") is None:
+        gates["fds"] = _gate(None, {})
+    else:
+        n0, n1 = len(start["fds"]), len(end["fds"])
+        gates["fds"] = _gate(n1 <= n0 + fd_slack,
+                             {"start": n0, "end": n1,
+                              "slack": fd_slack})
+
+    if start.get("children") is None or end.get("children") is None:
+        gates["children"] = _gate(None, {})
+    else:
+        new = sorted(set(end["children"]) - set(start["children"]))
+        gates["children"] = _gate(not new,
+                                  {"start": len(start["children"]),
+                                   "end": len(end["children"]),
+                                   "leaked_pids": new})
+
+    new_threads = sorted(
+        t for t in set(end.get("threads") or [])
+        - set(start.get("threads") or [])
+        if not any(t.startswith(a) for a in thread_allow))
+    gates["threads"] = _gate(not new_threads,
+                             {"start": len(start.get("threads") or []),
+                              "end": len(end.get("threads") or []),
+                              "leaked": new_threads})
+
+    p0 = set(start.get("resident_pairs") or [])
+    p1 = set(end.get("resident_pairs") or [])
+    new_pairs = sorted(p1 - p0)
+    gates["residency"] = _gate(len(new_pairs) <= residency_slack,
+                               {"start": sorted(p0), "end": sorted(p1),
+                                "leaked": new_pairs,
+                                "slack": residency_slack})
+
+    gates["ok"] = all(g["ok"] is not False for g in gates.values()
+                      if isinstance(g, dict))
+    return gates

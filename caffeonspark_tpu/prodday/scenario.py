@@ -1,0 +1,585 @@
+"""Scenario files: a production day as a checked-in JSON data file.
+
+The north star wants scenario DIVERSITY to be cheap: a new production
+day — different load curve, different chaos schedule — must be a new
+data file, never new code.  A scenario is phases on a compressed
+wall-clock; each phase sets a load shape (traffic.py) and schedules
+chaos through the existing COS_FAULT_* knobs (engine.py fires them
+via the runtime hooks: `Fleet.kill_replica`, POST /v1/faults,
+`DeployController.refresh_faults`).
+
+Validation is LINE-PRECISE: the stdlib json module reports positions
+only for syntax errors, so this module parses JSON itself (same
+grammar, ~recursive descent) keeping the source line of every key and
+element.  A bad phase, an unknown fault kind, or two overlapping
+stateful-fault windows each reject with `file.json:LINE: message` —
+an operator editing a 200-line scenario gets pointed at the line, not
+at "phase 7 somewhere".
+
+Schema (all times in seconds on the compressed clock):
+
+  {"name": str, "seed": int?, "scrape_interval_s": num?,
+   "slo": {"p99_ms": num, "availability": num in (0,1]},
+   "phases": [
+     {"name": str, "duration_s": num > 0,
+      "load": {"shape": "flat"|"ramp"|"diurnal"|"flash",
+               "rps": num > 0, "floor": num in [0,1]?,
+               "spike_x": num >= 1?, "spike_at": [0,1]?,
+               "spike_frac": (0,1]?,
+               "zipf": {"pool": int, "hot": int, "hit_rate": [0,1]}?,
+               "malformed_p": [0,1)?, "tenants": [...]?},
+      "faults": [{"at_s": num, "kind": <kind>, ...}]?,
+      "slo": {...}?}]}
+
+Fault kinds (each maps onto one existing COS_FAULT_* knob or fleet
+hook; stateful kinds carry a `clear_at_s` window):
+
+  replica_kill       SIGKILL replica N (fleet monitor must respawn)
+  replica_slow       COS_FAULT_REPLICA_SLOW straggler, factor×,
+                     staged/lifted via POST /v1/faults
+  flaky_storage      COS_FAULT_FLAKY_STORAGE on the deploy loop
+  snapshot_truncate  COS_FAULT_SNAPSHOT_TRUNCATE (next deploy round)
+  canary_kill        COS_FAULT_CANARY_KILL after N mirrored requests
+  reload_fail        COS_FAULT_RELOAD_FAIL_RANK mid-roll kill
+  deploy_round       run one full stream→fine-tune→canary→roll round
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+FAULT_KINDS = ("replica_kill", "replica_slow", "flaky_storage",
+               "snapshot_truncate", "canary_kill", "reload_fail",
+               "deploy_round")
+# stateful kinds hold a window [at_s, clear_at_s); overlapping windows
+# of the same kind on the same target are a scenario bug (the second
+# set would clobber the first's clear)
+STATEFUL_KINDS = ("replica_slow", "flaky_storage")
+LOAD_SHAPES = ("flat", "ramp", "diurnal", "flash")
+
+
+class ScenarioError(ValueError):
+    """Validation failure with the offending source line."""
+
+    def __init__(self, msg: str, line: int = 0, path: str = ""):
+        self.line = line
+        self.path = path
+        where = f"{path or '<scenario>'}:{line}: " if line else ""
+        super().__init__(where + msg)
+
+
+# ---------------------------------------------------------------------------
+# Annotated JSON: same values as json.loads, plus source lines
+# ---------------------------------------------------------------------------
+
+class AnnDict(dict):
+    """A parsed JSON object that remembers its own source line and the
+    line of every key."""
+    __slots__ = ("line", "keylines")
+
+
+class AnnList(list):
+    """A parsed JSON array that remembers its own source line and the
+    line of every element."""
+    __slots__ = ("line", "itemlines")
+
+
+class _Parser:
+    """Minimal recursive-descent JSON parser tracking line numbers.
+    Grammar-complete for the JSON this repo checks in; number/string
+    token parsing delegates to json.loads on the token text so escape
+    and float semantics are exactly the stdlib's."""
+
+    def __init__(self, text: str):
+        self.text = text
+        self.i = 0
+        self.line = 1
+
+    def error(self, msg: str) -> ScenarioError:
+        return ScenarioError(msg, line=self.line)
+
+    def _skip_ws(self) -> None:
+        while self.i < len(self.text):
+            c = self.text[self.i]
+            if c == "\n":
+                self.line += 1
+            elif c not in " \t\r":
+                return
+            self.i += 1
+
+    def _expect(self, ch: str) -> None:
+        self._skip_ws()
+        if self.i >= len(self.text) or self.text[self.i] != ch:
+            got = (self.text[self.i] if self.i < len(self.text)
+                   else "end of file")
+            raise self.error(f"expected {ch!r}, got {got!r}")
+        self.i += 1
+
+    def _peek(self) -> str:
+        self._skip_ws()
+        return self.text[self.i] if self.i < len(self.text) else ""
+
+    def parse(self):
+        value = self._value()
+        self._skip_ws()
+        if self.i < len(self.text):
+            raise self.error("trailing data after the document")
+        return value
+
+    def _value(self):
+        c = self._peek()
+        if c == "{":
+            return self._object()
+        if c == "[":
+            return self._array()
+        if c == '"':
+            return self._string()
+        if c == "" :
+            raise self.error("unexpected end of file")
+        return self._literal()
+
+    def _object(self) -> AnnDict:
+        out = AnnDict()
+        out.line = self.line
+        out.keylines = {}
+        self._expect("{")
+        if self._peek() == "}":
+            self.i += 1
+            return out
+        while True:
+            self._skip_ws()
+            key_line = self.line
+            key = self._string()
+            if key in out:
+                raise ScenarioError(f"duplicate key {key!r}",
+                                    line=key_line)
+            self._expect(":")
+            out[key] = self._value()
+            out.keylines[key] = key_line
+            c = self._peek()
+            if c == ",":
+                self.i += 1
+                continue
+            if c == "}":
+                self.i += 1
+                return out
+            raise self.error("expected ',' or '}' in object")
+
+    def _array(self) -> AnnList:
+        out = AnnList()
+        out.line = self.line
+        out.itemlines = []
+        self._expect("[")
+        if self._peek() == "]":
+            self.i += 1
+            return out
+        while True:
+            self._skip_ws()
+            out.itemlines.append(self.line)
+            out.append(self._value())
+            c = self._peek()
+            if c == ",":
+                self.i += 1
+                continue
+            if c == "]":
+                self.i += 1
+                return out
+            raise self.error("expected ',' or ']' in array")
+
+    def _string(self) -> str:
+        self._skip_ws()
+        if self._peek() != '"':
+            raise self.error("expected a string")
+        start = self.i
+        self.i += 1
+        while self.i < len(self.text):
+            c = self.text[self.i]
+            if c == "\\":
+                self.i += 2
+                continue
+            if c == '"':
+                self.i += 1
+                try:
+                    return json.loads(self.text[start:self.i])
+                except ValueError as e:
+                    raise self.error(f"bad string literal: {e}")
+            if c == "\n":
+                raise self.error("unterminated string")
+            self.i += 1
+        raise self.error("unterminated string")
+
+    def _literal(self):
+        start = self.i
+        while (self.i < len(self.text)
+               and self.text[self.i] not in " \t\r\n,}]"):
+            self.i += 1
+        tok = self.text[start:self.i]
+        try:
+            return json.loads(tok)
+        except ValueError:
+            raise self.error(f"bad literal {tok!r}")
+
+
+def parse_annotated(text: str):
+    """json.loads with line bookkeeping: containers come back as
+    AnnDict/AnnList carrying `.line` / `.keylines` / `.itemlines`."""
+    return _Parser(text).parse()
+
+
+# ---------------------------------------------------------------------------
+# Validation
+# ---------------------------------------------------------------------------
+
+def _line_of(container, key) -> int:
+    if isinstance(container, AnnDict):
+        return container.keylines.get(key, container.line)
+    if isinstance(container, AnnList):
+        try:
+            return container.itemlines[key]
+        except (IndexError, TypeError):
+            return container.line
+    return 0
+
+
+def _err(msg: str, container, key, path: str) -> ScenarioError:
+    return ScenarioError(msg, line=_line_of(container, key), path=path)
+
+
+def _check_keys(obj, allowed, what: str, path: str) -> None:
+    for k in obj:
+        if k not in allowed:
+            raise _err(f"{what}: unknown key {k!r} (allowed: "
+                       f"{', '.join(sorted(allowed))})", obj, k, path)
+
+
+def _num(obj, key, what, path, *, default=None, lo=None, hi=None,
+         lo_open=False, hi_open=False, required=False):
+    if key not in obj:
+        if required:
+            raise _err(f"{what}: missing required {key!r}", obj,
+                       next(iter(obj), None), path)
+        return default
+    v = obj[key]
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise _err(f"{what}: {key!r} must be a number, got "
+                   f"{type(v).__name__}", obj, key, path)
+    if lo is not None and (v <= lo if lo_open else v < lo):
+        raise _err(f"{what}: {key!r}={v} out of range", obj, key, path)
+    if hi is not None and (v >= hi if hi_open else v > hi):
+        raise _err(f"{what}: {key!r}={v} out of range", obj, key, path)
+    return float(v)
+
+
+class Tenant:
+    __slots__ = ("name", "weight", "model")
+
+    def __init__(self, name: str, weight: float,
+                 model: Optional[str] = None):
+        self.name, self.weight, self.model = name, weight, model
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "weight": self.weight,
+                "model": self.model}
+
+
+class LoadSpec:
+    """One phase's validated load block (traffic.py consumes this)."""
+
+    __slots__ = ("shape", "rps", "floor", "spike_x", "spike_at",
+                 "spike_frac", "zipf_pool", "zipf_hot", "zipf_hit",
+                 "malformed_p", "tenants")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw[k])
+
+    def to_dict(self) -> dict:
+        out = {k: getattr(self, k) for k in self.__slots__
+               if k != "tenants"}
+        out["tenants"] = [t.to_dict() for t in self.tenants]
+        return out
+
+
+class Fault:
+    __slots__ = ("kind", "at_s", "clear_at_s", "replica", "factor",
+                 "p", "after_requests")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__
+                if getattr(self, k) is not None}
+
+
+class Phase:
+    __slots__ = ("name", "duration_s", "load", "faults", "slo")
+
+    def __init__(self, name, duration_s, load, faults, slo):
+        self.name, self.duration_s = name, duration_s
+        self.load, self.faults, self.slo = load, faults, slo
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "duration_s": self.duration_s,
+                "load": self.load.to_dict(),
+                "faults": [f.to_dict() for f in self.faults],
+                "slo": dict(self.slo)}
+
+
+class Scenario:
+    __slots__ = ("name", "seed", "scrape_interval_s", "slo", "phases")
+
+    def __init__(self, name, seed, scrape_interval_s, slo, phases):
+        self.name, self.seed = name, seed
+        self.scrape_interval_s = scrape_interval_s
+        self.slo, self.phases = slo, phases
+
+    @property
+    def duration_s(self) -> float:
+        return sum(p.duration_s for p in self.phases)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "seed": self.seed,
+                "scrape_interval_s": self.scrape_interval_s,
+                "slo": dict(self.slo),
+                "phases": [p.to_dict() for p in self.phases]}
+
+
+def _validate_slo(obj, path: str, what: str,
+                  base: Optional[dict] = None) -> dict:
+    _check_keys(obj, {"p99_ms", "availability"}, what, path)
+    out = dict(base or {})
+    p99 = _num(obj, "p99_ms", what, path, lo=0, lo_open=True,
+               required=base is None)
+    avail = _num(obj, "availability", what, path, lo=0, hi=1,
+                 lo_open=True, required=base is None)
+    if p99 is not None:
+        out["p99_ms"] = p99
+    if avail is not None:
+        out["availability"] = avail
+    return out
+
+
+def _validate_tenants(arr, path: str, what: str) -> List[Tenant]:
+    if not isinstance(arr, list) or not arr:
+        raise ScenarioError(f"{what}: 'tenants' must be a non-empty "
+                            "array",
+                            line=getattr(arr, "line", 0), path=path)
+    out = []
+    for i, t in enumerate(arr):
+        tw = f"{what} tenant[{i}]"
+        if not isinstance(t, dict):
+            raise _err(f"{tw}: must be an object", arr, i, path)
+        _check_keys(t, {"name", "weight", "model"}, tw, path)
+        name = t.get("name")
+        if not isinstance(name, str) or not name:
+            raise _err(f"{tw}: 'name' must be a non-empty string",
+                       t, "name" if "name" in t else None, path)
+        weight = _num(t, "weight", tw, path, default=1.0, lo=0,
+                      lo_open=True)
+        model = t.get("model")
+        if model is not None and not isinstance(model, str):
+            raise _err(f"{tw}: 'model' must be a string or null",
+                       t, "model", path)
+        out.append(Tenant(name, weight, model))
+    return out
+
+
+def _validate_load(obj, path: str, what: str) -> LoadSpec:
+    if not isinstance(obj, dict):
+        raise ScenarioError(f"{what}: 'load' must be an object",
+                            line=getattr(obj, "line", 0), path=path)
+    _check_keys(obj, {"shape", "rps", "floor", "spike_x", "spike_at",
+                      "spike_frac", "zipf", "malformed_p", "tenants"},
+                what, path)
+    shape = obj.get("shape", "flat")
+    if shape not in LOAD_SHAPES:
+        raise _err(f"{what}: unknown load shape {shape!r} (allowed: "
+                   f"{', '.join(LOAD_SHAPES)})", obj, "shape", path)
+    rps = _num(obj, "rps", what, path, lo=0, lo_open=True,
+               required=True)
+    floor = _num(obj, "floor", what, path, default=0.25, lo=0, hi=1)
+    spike_x = _num(obj, "spike_x", what, path, default=4.0, lo=1)
+    spike_at = _num(obj, "spike_at", what, path, default=0.5, lo=0,
+                    hi=1)
+    spike_frac = _num(obj, "spike_frac", what, path, default=0.2,
+                      lo=0, hi=1, lo_open=True)
+    zipf = obj.get("zipf") or {}
+    if not isinstance(zipf, dict):
+        raise _err(f"{what}: 'zipf' must be an object", obj, "zipf",
+                   path)
+    if zipf:
+        _check_keys(zipf, {"pool", "hot", "hit_rate"},
+                    f"{what} zipf", path)
+    pool = int(_num(zipf, "pool", f"{what} zipf", path, default=16,
+                    lo=1))
+    hot = int(_num(zipf, "hot", f"{what} zipf", path, default=4,
+                   lo=1))
+    hit = _num(zipf, "hit_rate", f"{what} zipf", path, default=0.0,
+               lo=0, hi=1)
+    if hot > pool:
+        raise _err(f"{what} zipf: hot={hot} exceeds pool={pool}",
+                   zipf, "hot" if "hot" in zipf else "pool", path)
+    malformed_p = _num(obj, "malformed_p", what, path, default=0.0,
+                       lo=0, hi=1, hi_open=True)
+    tenants = (_validate_tenants(obj["tenants"], path, what)
+               if "tenants" in obj
+               else [Tenant("default", 1.0)])
+    return LoadSpec(shape=shape, rps=rps, floor=floor,
+                    spike_x=spike_x, spike_at=spike_at,
+                    spike_frac=spike_frac, zipf_pool=pool,
+                    zipf_hot=hot, zipf_hit=hit,
+                    malformed_p=malformed_p, tenants=tenants)
+
+
+_FAULT_KEYS: Dict[str, set] = {
+    "replica_kill": {"at_s", "kind", "replica"},
+    "replica_slow": {"at_s", "kind", "replica", "factor",
+                     "clear_at_s"},
+    "flaky_storage": {"at_s", "kind", "p", "clear_at_s"},
+    "snapshot_truncate": {"at_s", "kind"},
+    "canary_kill": {"at_s", "kind", "after_requests"},
+    "reload_fail": {"at_s", "kind", "replica"},
+    "deploy_round": {"at_s", "kind"},
+}
+
+
+def _validate_fault(obj, arr, i: int, duration_s: float, path: str,
+                    what: str) -> Fault:
+    if not isinstance(obj, dict):
+        raise _err(f"{what}: must be an object", arr, i, path)
+    kind = obj.get("kind")
+    if kind not in FAULT_KINDS:
+        raise _err(f"{what}: unknown fault kind {kind!r} (known: "
+                   f"{', '.join(FAULT_KINDS)})", obj,
+                   "kind" if "kind" in obj else None, path)
+    _check_keys(obj, _FAULT_KEYS[kind], what, path)
+    at_s = _num(obj, "at_s", what, path, required=True, lo=0)
+    if at_s >= duration_s:
+        raise _err(f"{what}: at_s={at_s:g} is at/after the phase end "
+                   f"(duration_s={duration_s:g})", obj, "at_s", path)
+    clear = _num(obj, "clear_at_s", what, path)
+    if clear is not None:
+        if kind not in STATEFUL_KINDS:
+            raise _err(f"{what}: {kind!r} takes no clear_at_s", obj,
+                       "clear_at_s", path)
+        if clear <= at_s or clear > duration_s:
+            raise _err(f"{what}: clear_at_s={clear:g} must lie in "
+                       f"(at_s, duration_s]", obj, "clear_at_s", path)
+    f = Fault(kind=kind, at_s=at_s, clear_at_s=clear)
+    if kind in ("replica_kill", "replica_slow", "reload_fail"):
+        f.replica = int(_num(obj, "replica", what, path,
+                             required=True, lo=0))
+    if kind == "replica_slow":
+        f.factor = _num(obj, "factor", what, path, default=8.0, lo=1)
+    if kind == "flaky_storage":
+        f.p = _num(obj, "p", what, path, default=0.3, lo=0, hi=1,
+                   hi_open=True)
+    if kind == "canary_kill":
+        f.after_requests = int(_num(obj, "after_requests", what, path,
+                                    default=1, lo=0))
+    return f
+
+
+def _check_overlaps(faults: List[Fault], arr, path: str,
+                    what: str) -> None:
+    """Two stateful faults of the same kind on the same target with
+    overlapping [at_s, clear_at_s) windows: the later set would
+    clobber the earlier clear — reject with the later fault's line.
+    Runs on the SOURCE order (pairwise — fault lists are small) so
+    the reported line is the file's, not a sorted index's."""
+    def window(f: Fault) -> Tuple[float, float]:
+        return (f.at_s, f.clear_at_s if f.clear_at_s is not None
+                else float("inf"))
+
+    for i, f in enumerate(faults):
+        if f.kind not in STATEFUL_KINDS:
+            continue
+        for j in range(i):
+            g = faults[j]
+            if (g.kind, g.replica) != (f.kind, f.replica):
+                continue
+            (a0, a1), (b0, b1) = window(g), window(f)
+            if b0 < a1 and a0 < b1:
+                raise _err(
+                    f"{what}[{i}]: {f.kind} window "
+                    f"[{b0:g}, {'inf' if b1 == float('inf') else format(b1, 'g')})"
+                    f" overlaps the schedule at line "
+                    f"{_line_of(arr, j)}", arr, i, path)
+
+
+def _validate_phase(obj, arr, i: int, base_slo: dict,
+                    path: str) -> Phase:
+    what = f"phase[{i}]"
+    if not isinstance(obj, dict):
+        raise _err(f"{what}: must be an object", arr, i, path)
+    _check_keys(obj, {"name", "duration_s", "load", "faults", "slo"},
+                what, path)
+    name = obj.get("name")
+    if not isinstance(name, str) or not name:
+        raise _err(f"{what}: 'name' must be a non-empty string", obj,
+                   "name" if "name" in obj else None, path)
+    what = f"phase[{i}] {name!r}"
+    duration = _num(obj, "duration_s", what, path, required=True,
+                    lo=0, lo_open=True)
+    if "load" not in obj:
+        raise _err(f"{what}: missing required 'load'", obj, "name",
+                   path)
+    load = _validate_load(obj["load"], path, what)
+    faults_arr = obj.get("faults", AnnList())
+    if not isinstance(faults_arr, list):
+        raise _err(f"{what}: 'faults' must be an array", obj,
+                   "faults", path)
+    faults = [_validate_fault(f, faults_arr, j, duration, path,
+                              f"{what} fault[{j}]")
+              for j, f in enumerate(faults_arr)]
+    _check_overlaps(faults, faults_arr, path, f"{what} fault")
+    faults.sort(key=lambda f: f.at_s)
+    slo = (_validate_slo(obj["slo"], path, f"{what} slo", base_slo)
+           if "slo" in obj else dict(base_slo))
+    return Phase(name, duration, load, faults, slo)
+
+
+def parse_scenario(text: str, path: str = "") -> Scenario:
+    """Parse + validate a scenario document; raises ScenarioError
+    (with the offending line) on anything a run could trip over."""
+    try:
+        doc = parse_annotated(text)
+    except ScenarioError as e:
+        raise ScenarioError(str(e).split(": ", 1)[-1], line=e.line,
+                            path=path)
+    if not isinstance(doc, dict):
+        raise ScenarioError("scenario must be a JSON object", line=1,
+                            path=path)
+    _check_keys(doc, {"name", "seed", "scrape_interval_s", "slo",
+                      "phases"}, "scenario", path)
+    name = doc.get("name")
+    if not isinstance(name, str) or not name:
+        raise _err("scenario: 'name' must be a non-empty string", doc,
+                   "name" if "name" in doc else None, path)
+    seed = int(_num(doc, "seed", "scenario", path, default=7))
+    scrape = _num(doc, "scrape_interval_s", "scenario", path,
+                  default=0.5, lo=0, lo_open=True)
+    if "slo" not in doc or not isinstance(doc["slo"], dict):
+        raise _err("scenario: missing required 'slo' object", doc,
+                   "slo" if "slo" in doc else "name", path)
+    slo = _validate_slo(doc["slo"], path, "scenario slo")
+    phases_arr = doc.get("phases")
+    if not isinstance(phases_arr, list) or not phases_arr:
+        raise _err("scenario: 'phases' must be a non-empty array",
+                   doc, "phases" if "phases" in doc else "name", path)
+    phases = [_validate_phase(p, phases_arr, i, slo, path)
+              for i, p in enumerate(phases_arr)]
+    names = [p.name for p in phases]
+    if len(set(names)) != len(names):
+        dup = next(n for n in names if names.count(n) > 1)
+        raise _err(f"scenario: duplicate phase name {dup!r}",
+                   phases_arr, names.index(dup), path)
+    return Scenario(name, seed, scrape, slo, phases)
+
+
+def load_scenario(path: str) -> Scenario:
+    with open(path) as f:
+        return parse_scenario(f.read(), path=path)

@@ -1,0 +1,235 @@
+"""Open-loop traffic generator for the production-day harness.
+
+OPEN-loop is the point: a closed-loop client (send, wait, send) slows
+down exactly when the system does, hiding the queueing collapse that
+"RPC Considered Harmful" shows dominates small-payload serving.  Here
+arrivals follow a Poisson process whose rate tracks the phase's load
+shape regardless of completions — when the fleet falls behind, work
+piles up the way a real flash crowd piles up.  (A bounded in-flight
+cap protects the host box; requests shed at the cap are COUNTED as
+offered-but-shed, never silently dropped.)
+
+Load shapes over a phase of duration T (t in [0, T]):
+
+  flat      r(t) = rps
+  ramp      r(t) = rps * (floor + (1-floor) * t/T)
+  diurnal   r(t) = rps * (floor + (1-floor) * ½(1-cos 2πt/T))
+            — one day's trough→peak→trough in one phase
+  flash     r(t) = rps, ×spike_x inside the window
+            [spike_at*T, (spike_at+spike_frac)*T] — the flash crowd
+
+Payload mix is zipfian over a pool of pre-serialized request bodies
+(PR 16's cache premise: a hot head of repeated payloads is what makes
+the content-hash response cache and in-flight coalescing pay), with
+`malformed_p` of requests drawn from an adversarial pool — those must
+come back 4xx, never 5xx, and never crash a replica.  Tenant classes
+(interactive/batch/...) pick per-request by weight and may route to a
+named model — mapping onto the service's per-model FlushLanes.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+import time
+from typing import Callable, Dict, List, NamedTuple, Optional
+
+from .scenario import LoadSpec, Tenant
+
+
+class RequestResult(NamedTuple):
+    t_rel_s: float           # send time relative to phase start
+    lat_ms: float
+    status: int              # HTTP-ish status (0 = transport failure)
+    tenant: str
+    malformed: bool
+    shed: bool               # dropped at the local in-flight cap
+    trace_id: Optional[str]
+
+
+def rate_at(load: LoadSpec, t: float, duration_s: float) -> float:
+    """Target arrival rate (req/s) at phase-relative time t."""
+    frac = min(1.0, max(0.0, t / duration_s)) if duration_s else 0.0
+    if load.shape == "flat":
+        return load.rps
+    if load.shape == "ramp":
+        return load.rps * (load.floor + (1 - load.floor) * frac)
+    if load.shape == "diurnal":
+        return load.rps * (load.floor + (1 - load.floor)
+                           * 0.5 * (1 - math.cos(2 * math.pi * frac)))
+    if load.shape == "flash":
+        lo, hi = load.spike_at, load.spike_at + load.spike_frac
+        return load.rps * (load.spike_x if lo <= frac < hi else 1.0)
+    raise ValueError(f"unknown shape {load.shape!r}")
+
+
+def zipf_ranks(n: int, hot: int, rng: random.Random,
+               s: float = 1.0) -> Callable[[], int]:
+    """Sampler over [0, n): zipf-weighted ranks — rank 0 hottest.
+    `hot` only shapes the head steepness indirectly via n; kept for
+    symmetry with the scenario schema (pool/hot document intent)."""
+    weights = [1.0 / (r + 1) ** s for r in range(n)]
+    total = sum(weights)
+    cdf, acc = [], 0.0
+    for w in weights:
+        acc += w / total
+        cdf.append(acc)
+
+    def pick() -> int:
+        u = rng.random()
+        for i, c in enumerate(cdf):
+            if u <= c:
+                return i
+        return n - 1
+    return pick
+
+
+class TrafficGen:
+    """Drive one phase's load against a `send` callable.
+
+    send(payload: bytes, tenant: Tenant, trace_id: str|None) -> int
+    returns an HTTP status (or raises — counted as transport failure,
+    status 0).  Payload pools are pre-serialized bytes so the
+    generator's own CPU cost stays flat across phases."""
+
+    def __init__(self, send: Callable[[bytes, Tenant, Optional[str]],
+                                      int],
+                 payload_pool: List[bytes],
+                 malformed_pool: Optional[List[bytes]] = None,
+                 *, seed: int = 7, inflight_cap: int = 64,
+                 workers: int = 16, trace_every: int = 1):
+        if not payload_pool:
+            raise ValueError("payload_pool must be non-empty")
+        self.send = send
+        self.payload_pool = list(payload_pool)
+        self.malformed_pool = list(malformed_pool or [])
+        self.seed = seed
+        self.inflight_cap = inflight_cap
+        self.workers = workers
+        self.trace_every = max(1, trace_every)
+        self._inflight = 0
+        self._lock = threading.Lock()
+
+    # -- one phase ----------------------------------------------------
+    def run_phase(self, load: LoadSpec, duration_s: float,
+                  stop: Optional[threading.Event] = None
+                  ) -> List[RequestResult]:
+        """Open-loop replay of one phase; blocks for ~duration_s and
+        returns every offered request's outcome."""
+        rng = random.Random(self.seed)
+        pick_payload = zipf_ranks(
+            min(load.zipf_pool, len(self.payload_pool)),
+            load.zipf_hot, rng)
+        tenants = load.tenants or [Tenant("default", 1.0)]
+        t_weights = [t.weight for t in tenants]
+        results: List[RequestResult] = []
+        res_lock = threading.Lock()
+        threads: List[threading.Thread] = []
+        t0 = time.monotonic()
+        seq = 0
+        t_next = 0.0
+        while True:
+            now = time.monotonic() - t0
+            if now >= duration_s or (stop is not None
+                                     and stop.is_set()):
+                break
+            if t_next > now:
+                time.sleep(min(t_next - now, 0.05))
+                continue
+            # fire the arrival scheduled for t_next
+            seq += 1
+            tenant = rng.choices(tenants, weights=t_weights)[0]
+            malformed = (self.malformed_pool
+                         and rng.random() < load.malformed_p)
+            payload = (rng.choice(self.malformed_pool) if malformed
+                       else self.payload_pool[pick_payload()
+                                              % len(self.payload_pool)])
+            trace_id = (f"pd{self.seed:x}{seq:08x}"
+                        if seq % self.trace_every == 0 else None)
+            with self._lock:
+                shed = self._inflight >= self.inflight_cap
+                if not shed:
+                    self._inflight += 1
+            if shed:
+                with res_lock:
+                    results.append(RequestResult(
+                        round(t_next, 4), 0.0, 0, tenant.name,
+                        bool(malformed), True, None))
+            else:
+                th = threading.Thread(
+                    target=self._fire,
+                    args=(payload, tenant, trace_id, bool(malformed),
+                          t_next, t0, results, res_lock),
+                    daemon=True)
+                th.start()
+                threads.append(th)
+            # open loop: next arrival from the CURRENT target rate,
+            # independent of completions
+            r = max(1e-6, rate_at(load, t_next, duration_s))
+            t_next += rng.expovariate(r)
+        for th in threads:
+            th.join(timeout=30.0)
+        results.sort(key=lambda r: r.t_rel_s)
+        return results
+
+    def _fire(self, payload, tenant, trace_id, malformed, t_sched,
+              t0, results, res_lock):
+        t_send = time.monotonic()
+        try:
+            status = self.send(payload, tenant, trace_id)
+        except Exception:           # noqa: BLE001 — transport failure
+            status = 0
+        lat_ms = (time.monotonic() - t_send) * 1e3
+        with self._lock:
+            self._inflight -= 1
+        with res_lock:
+            results.append(RequestResult(
+                round(t_sched, 4), round(lat_ms, 3), int(status),
+                tenant.name, malformed, False, trace_id))
+
+
+def summarize(results: List[RequestResult]) -> Dict[str, object]:
+    """Client-side ground truth for one phase: counts by outcome
+    class, latency percentiles of well-formed successes, per-tenant
+    rollup, and the malformed-handling check (a malformed payload
+    must 4xx, never 5xx/transport — adversarial inputs crashing a
+    replica would show up here first)."""
+    ok = [r for r in results if not r.malformed and not r.shed
+          and 200 <= r.status < 300]
+    lat = sorted(r.lat_ms for r in ok)
+
+    def pct(p: float) -> Optional[float]:
+        if not lat:
+            return None
+        return round(lat[min(len(lat) - 1,
+                             int(p * (len(lat) - 1)))], 3)
+
+    wellformed = [r for r in results if not r.malformed]
+    failures = [r for r in wellformed if not r.shed
+                and not 200 <= r.status < 300]
+    malformed = [r for r in results if r.malformed and not r.shed]
+    mal_bad = [r for r in malformed
+               if r.status >= 500 or r.status == 0]
+    tenants: Dict[str, Dict[str, int]] = {}
+    for r in results:
+        t = tenants.setdefault(r.tenant, {"offered": 0, "ok": 0,
+                                          "failed": 0, "shed": 0})
+        t["offered"] += 1
+        if r.shed:
+            t["shed"] += 1
+        elif 200 <= r.status < 300:
+            t["ok"] += 1
+        else:
+            t["failed"] += 1
+    return {
+        "offered": len(results),
+        "ok": len(ok),
+        "failed": len(failures),
+        "shed": sum(1 for r in results if r.shed),
+        "malformed_offered": len(malformed),
+        "malformed_mishandled": len(mal_bad),
+        "p50_ms": pct(0.50), "p95_ms": pct(0.95), "p99_ms": pct(0.99),
+        "max_ms": round(lat[-1], 3) if lat else None,
+        "tenants": tenants,
+    }

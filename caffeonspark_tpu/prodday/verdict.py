@@ -1,0 +1,365 @@
+"""Verdict engine: pass/fail decided from the observability substrate.
+
+The production-day harness never asks the system under test how it
+feels — every verdict is computed from what an OPERATOR could see:
+
+  * per-phase SLO compliance + error-budget accounting from periodic
+    router prom scrapes (`parse_exposition` re-validates every scrape
+    the way a real scraper would);
+  * counter deltas between scrapes clamp at zero ONLY when a restart
+    was detected for that process (cos_uptime_seconds decreased or
+    the cos_build_info pid label changed) — an unexplained counter
+    reset is itself a finding;
+  * post-run incident reconstruction: flight-recorder dumps from
+    every process merge into one causally-ordered timeline
+    (obs.recorder.load_dump_dir) and every injected fault must be
+    EXPLAINED — its evidence event must appear after injection and
+    its recovery event within COS_PRODDAY_RECOVERY_S;
+  * trace exemplars: the N slowest client requests' trace ids are
+    fetched back through `/v1/traces?trace=&min_ms=` so the artifact
+    carries the span decomposition of the day's worst latency.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..obs.prom import counter_values, parse_exposition
+
+
+class PromScraper:
+    """Periodic scrape loop: `scrape()` returns exposition text (the
+    router's fleet-aggregated /metrics?format=prom); each sample is
+    parsed (strict) and timestamped.  Parse failures are recorded,
+    not swallowed — a scrape a real Prometheus would reject is a
+    finding in itself."""
+
+    def __init__(self, scrape: Callable[[], str],
+                 interval_s: float = 0.5):
+        self._scrape = scrape
+        self.interval_s = interval_s
+        self.samples: List[Tuple[float, Dict[str, dict]]] = []
+        self.parse_errors: List[str] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PromScraper":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="cos-prodday-scraper",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def scrape_once(self) -> None:
+        t = time.monotonic()
+        try:
+            fams = parse_exposition(self._scrape())
+        except Exception as e:       # noqa: BLE001 — recorded finding
+            self.parse_errors.append(f"t={t:.3f}: "
+                                     f"{type(e).__name__}: {e}")
+            return
+        self.samples.append((t, fams))
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.scrape_once()
+            self._stop.wait(self.interval_s)
+        self.scrape_once()           # one last sample closes the day
+
+
+# ---------------------------------------------------------------------------
+# restart detection + budget math
+# ---------------------------------------------------------------------------
+
+def _identity_keys(fams: Dict[str, dict]) -> Dict[str, str]:
+    """{process-label-set: pid} from cos_build_info samples — the
+    restart detector's identity map."""
+    out: Dict[str, str] = {}
+    for labels, _v in (fams.get("cos_build_info") or
+                       {"samples": []})["samples"]:
+        ident = ",".join(f"{k}={v}" for k, v in sorted(labels.items())
+                         if k not in ("pid",))
+        out[ident] = labels.get("pid", "")
+    return out
+
+
+def _uptimes(fams: Dict[str, dict]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for labels, v in (fams.get("cos_uptime_seconds") or
+                      {"samples": []})["samples"]:
+        key = ",".join(f"{k}={v2}"
+                       for k, v2 in sorted(labels.items()))
+        out[key] = v
+    return out
+
+
+def detect_restarts(samples: List[Tuple[float, Dict[str, dict]]]
+                    ) -> List[dict]:
+    """Scan the scrape series for process restarts: a cos_build_info
+    pid change or a cos_uptime_seconds decrease for the same label
+    set.  Identity carries forward across scrape GAPS — a killed
+    replica disappears from the fleet scrape while it is down, so
+    the old and new pid are never in adjacent samples; comparing
+    against the last SEEN identity is what catches the respawn."""
+    out: List[dict] = []
+    last_pid: Dict[str, str] = {}
+    last_up: Dict[str, float] = {}
+    for t, fams in samples:
+        for ident, pid in _identity_keys(fams).items():
+            old = last_pid.get(ident)
+            if old is not None and old != pid:
+                out.append({"who": ident, "kind": "pid_change",
+                            "t": round(t, 3),
+                            "old_pid": old, "new_pid": pid})
+            last_pid[ident] = pid
+        for key, v in _uptimes(fams).items():
+            old = last_up.get(key)
+            if old is not None and v < old:
+                out.append({"who": key, "kind": "uptime_reset",
+                            "t": round(t, 3),
+                            "from_s": round(old, 3),
+                            "to_s": round(v, 3)})
+            last_up[key] = v
+    return out
+
+
+def _counter_deltas(samples: List[Tuple[float, Dict[str, dict]]],
+                    t0: float, t1: float,
+                    restart_ts: List[float]
+                    ) -> Tuple[Dict[str, float], List[str]]:
+    """Sum of per-scrape-pair counter deltas inside [t0, t1].
+    Negative deltas clamp to 0; a clamp in a window with NO detected
+    restart is reported as an unexplained reset."""
+    totals: Dict[str, float] = {}
+    unexplained: List[str] = []
+    window = [(t, f) for t, f in samples if t0 <= t <= t1]
+    for i in range(1, len(window)):
+        t_prev, prev = window[i - 1]
+        t_cur, cur = window[i]
+        cv_prev, cv_cur = counter_values(prev), counter_values(cur)
+        restarted = any(t_prev < rt <= t_cur for rt in restart_ts)
+        for key, v in cv_cur.items():
+            old = cv_prev.get(key)
+            if old is None:
+                continue
+            d = v - old
+            if d < 0:
+                if not restarted:
+                    unexplained.append(
+                        f"{key}: {old:g} -> {v:g} at t={t_cur:.3f}")
+                d = max(0.0, v)   # restarted process: count its new total
+            totals[key] = totals.get(key, 0.0) + d
+    return totals, unexplained
+
+
+def _gauge_series(samples, t0, t1, family: str,
+                  match: Dict[str, str]) -> List[float]:
+    out: List[float] = []
+    for t, fams in samples:
+        if not t0 <= t <= t1:
+            continue
+        for labels, v in (fams.get(family) or
+                          {"samples": []})["samples"]:
+            if all(labels.get(k) == v2 for k, v2 in match.items()):
+                out.append(v)
+    return out
+
+
+def error_budget(samples: List[Tuple[float, Dict[str, dict]]],
+                 t0: float, t1: float, slo: dict,
+                 restarts: Optional[List[dict]] = None) -> dict:
+    """Scrape-based SLO verdict for one phase window [t0, t1].
+
+    Error budget: with availability target A over N observed routed
+    requests, the budget is (1-A)*N failed attempts; consumption is
+    the router-observed per-replica failure delta (retries the router
+    absorbed still consume budget — they cost capacity and tail).
+    Latency: the fleet's route-stage p99 gauge must sit within
+    slo.p99_ms for every scrape of the window (the gauge is already a
+    moving percentile over the bounded ring)."""
+    restarts = restarts if restarts is not None \
+        else detect_restarts(samples)
+    rts = [r["t"] for r in restarts]
+    deltas, unexplained = _counter_deltas(samples, t0, t1, rts)
+
+    def total(prefix: str, match: str = "") -> float:
+        return sum(v for k, v in deltas.items()
+                   if k.startswith(prefix) and match in k)
+
+    routed = total("cos_routed_total|", "role=router")
+    failures = total("cos_replica_failures_total|", "role=router")
+    retries = total("cos_retries_total|", "role=router")
+    hedges = total("cos_hedges_fired_total|", "role=router")
+    observed = routed + failures
+    avail = float(slo.get("availability", 0.999))
+    budget = (1.0 - avail) * observed
+    p99_target = float(slo.get("p99_ms", 0.0))
+    p99s = _gauge_series(samples, t0, t1, "cos_stage_ms",
+                         {"role": "router", "stage": "route",
+                          "quantile": "0.99"})
+    p99_worst = max(p99s) if p99s else None
+    in_window = [r for r in restarts if t0 <= r["t"] <= t1]
+    out = {
+        "routed": routed, "failures": failures,
+        "retries": retries, "hedges_fired": hedges,
+        "scrapes": sum(1 for t, _ in samples if t0 <= t <= t1),
+        "availability_slo": avail,
+        "error_budget": round(budget, 3),
+        "budget_consumed": failures,
+        "budget_ok": failures <= budget or failures == 0,
+        "p99_target_ms": p99_target,
+        "p99_worst_ms": round(p99_worst, 3)
+        if p99_worst is not None else None,
+        "p99_ok": (p99_worst is not None
+                   and p99_worst <= p99_target) if p99_target else None,
+        "restarts": in_window,
+        "unexplained_counter_resets": unexplained,
+    }
+    out["slo_ok"] = bool(out["budget_ok"]
+                         and out["p99_ok"] is not False
+                         and not unexplained)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# incident reconstruction
+# ---------------------------------------------------------------------------
+
+def _match(ev: dict, source: str, event: str, **attrs) -> bool:
+    if ev.get("source") != source or ev.get("event") != event:
+        return False
+    for k, v in attrs.items():
+        if ev.get(k) != v:
+            return False
+    return True
+
+
+def _expectations(fault: dict) -> Optional[Tuple[Callable, Callable]]:
+    """(evidence_predicate, recovery_predicate) for one injected
+    fault record — the reconstruction CONTRACT: which recorder events
+    prove the fault actually landed and which prove the system
+    recovered from it."""
+    kind = fault["kind"]
+    rep = f"replica{fault.get('replica')}" \
+        if fault.get("replica") is not None else None
+    if kind == "replica_kill":
+        return (lambda e: _match(e, "fleet", "replica_died",
+                                 replica=rep),
+                lambda e: _match(e, "fleet", "replica_rejoined",
+                                 replica=rep))
+    if kind == "replica_slow":
+        def ev_set(e):
+            return (_match(e, "fleet", "replica_fault_set",
+                           replica=rep)
+                    and (e.get("env") or {}).get(
+                        "COS_FAULT_REPLICA_SLOW"))
+
+        def ev_clear(e):
+            return (_match(e, "fleet", "replica_fault_set",
+                           replica=rep)
+                    and not (e.get("env") or {}).get(
+                        "COS_FAULT_REPLICA_SLOW"))
+        return ev_set, ev_clear
+    if kind == "flaky_storage":
+        def st_set(e):
+            return (_match(e, "chaos", "faults_applied")
+                    and (e.get("env") or {}).get(
+                        "COS_FAULT_FLAKY_STORAGE"))
+
+        def st_clear(e):
+            return (_match(e, "chaos", "faults_applied")
+                    and "COS_FAULT_FLAKY_STORAGE" in (e.get("env")
+                                                      or {})
+                    and not (e.get("env") or {}).get(
+                        "COS_FAULT_FLAKY_STORAGE"))
+        return st_set, st_clear
+    if kind == "snapshot_truncate":
+        return (lambda e: _match(e, "chaos", "snapshot_truncate"),
+                lambda e: _match(e, "deploy", "round"))
+    if kind == "canary_kill":
+        return (lambda e: _match(e, "chaos", "canary_kill"),
+                lambda e: (_match(e, "deploy", "round")
+                           and e.get("verdict") in ("aborted",
+                                                    "reject",
+                                                    "skipped")))
+    if kind == "reload_fail":
+        return (lambda e: _match(e, "chaos", "reload_fail"),
+                lambda e: _match(e, "fleet", "rollback_done"))
+    return None      # deploy_round etc.: an action, not a fault
+
+
+def reconstruct_incidents(timeline: List[dict], injected: List[dict],
+                          recovery_deadline_s: float = 60.0) -> dict:
+    """Walk the merged recorder timeline and EXPLAIN every injected
+    fault: its evidence event must appear at/after the injection
+    wall-time and its recovery event within `recovery_deadline_s` of
+    the evidence.  Faults without expectations (deploy_round) pass
+    through as actions.  The whole day fails reconstruction if any
+    fault stays unexplained — a chaos knob that silently did nothing
+    is as much a harness bug as a fault nothing recovered from."""
+    incidents: List[dict] = []
+    for fault in injected:
+        exp = _expectations(fault)
+        if exp is None:
+            continue
+        ev_pred, rec_pred = exp
+        t_inj = fault["t_wall"]
+        # small slack absorbs clock granularity between processes
+        evidence = next((e for e in timeline
+                         if e.get("ts", 0) >= t_inj - 0.25
+                         and ev_pred(e)), None)
+        recovery = None
+        if evidence is not None:
+            t_ev = evidence.get("ts", t_inj)
+            recovery = next(
+                (e for e in timeline
+                 if t_ev <= e.get("ts", 0)
+                 <= t_ev + recovery_deadline_s
+                 and e is not evidence and rec_pred(e)), None)
+        incidents.append({
+            "fault": {k: v for k, v in fault.items()
+                      if k != "t_wall"},
+            "t_injected": round(t_inj, 3),
+            "evidence": evidence,
+            "recovery": recovery,
+            "recovery_s": round(recovery["ts"] - evidence["ts"], 3)
+            if recovery and evidence else None,
+            "explained": bool(evidence is not None
+                              and recovery is not None),
+        })
+    return {
+        "events_merged": len(timeline),
+        "faults_injected": len(incidents),
+        "explained": sum(1 for i in incidents if i["explained"]),
+        "ok": all(i["explained"] for i in incidents),
+        "incidents": incidents,
+    }
+
+
+def slow_exemplars(results, fetch_traces: Callable[[str], List[dict]],
+                   n: int = 3) -> List[dict]:
+    """The day's N slowest successful client requests, each with the
+    span decomposition pulled back through /v1/traces?trace=<id> —
+    the artifact shows WHERE the worst latency went, not just that it
+    happened."""
+    traced = [r for r in results
+              if r.trace_id and 200 <= r.status < 300]
+    worst = sorted(traced, key=lambda r: -r.lat_ms)[:n]
+    out = []
+    for r in worst:
+        try:
+            spans = fetch_traces(r.trace_id)
+        except Exception as e:       # noqa: BLE001 — best-effort
+            spans = [{"error": f"{type(e).__name__}: {e}"}]
+        out.append({"trace_id": r.trace_id,
+                    "lat_ms": r.lat_ms, "tenant": r.tenant,
+                    "spans": spans})
+    return out

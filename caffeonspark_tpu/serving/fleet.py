@@ -553,6 +553,31 @@ class Fleet:
     def kill_replica(self, name: str) -> None:
         self.replicas[name].kill()
 
+    def set_replica_fault(self, name: str, env: Dict[str, Optional[str]]
+                          ) -> dict:
+        """Scripted-chaos hook: flip COS_FAULT_* knobs inside ONE live
+        replica via its POST /v1/faults route (prodday stages a
+        straggler mid-phase and lifts it later without a respawn).
+        The env rides into the replica's respawn env too, so a
+        restart-on-death respawn keeps the scenario's intent until the
+        scenario clears it."""
+        rep = self.replicas[name]
+        for k, v in env.items():
+            if v is None or v == "":
+                rep.env = rep.env or {}
+                rep.env.pop(k, None)
+            else:
+                rep.env = dict(rep.env or {}, **{k: str(v)})
+        code, body = http_json(
+            rep.url + "/v1/faults",
+            data=json.dumps({"env": env}).encode(), timeout=30.0)
+        if code != 200:
+            raise RuntimeError(f"set_replica_fault({name}): {body}")
+        record_event("fleet", "replica_fault_set", replica=name,
+                     env={k: (None if v in (None, "") else str(v))
+                          for k, v in env.items()})
+        return body
+
     def restarts(self) -> int:
         return self._restarts
 

@@ -35,8 +35,13 @@ Routes:
                      renders the same summary as Prometheus
                      exposition (obs/prom.py)
   GET  /v1/traces    this process's finished trace spans
-                     (obs/trace.py ring; `?trace=<id>` filters) —
-                     the router aggregates these across replicas
+                     (obs/trace.py ring; `?trace=<id>`, `?min_ms=`,
+                     `?limit=` filter) — the router aggregates these
+                     across replicas
+  POST /v1/faults    {"env": {"COS_FAULT_*": value|null}} → flip
+                     chaos knobs in the LIVE replica and re-resolve
+                     the fault plan (the prodday scenario engine's
+                     scripted-straggler hook)
   POST /v1/profile   {"duration_ms": N} → bounded jax.profiler
                      capture on the LIVE replica; answers the
                      TensorBoard-loadable trace dir (409 while one
@@ -118,14 +123,20 @@ class JsonHandler(BaseHTTPRequestHandler):
             self._send(200, dict(out, ok=True))
 
     def _handle_traces(self, q):
-        """GET /v1/traces[?trace=][&limit=]: this process's finished
-        spans from the tracer ring, oldest first."""
+        """GET /v1/traces[?trace=][&min_ms=][&limit=]: this process's
+        finished spans from the tracer ring, oldest first.  `min_ms`
+        keeps only spans at least that long — incident reconstruction
+        pulls one slow trace without downloading the whole ring."""
         try:
             limit = int(q.get("limit", 1024))
         except ValueError:
             limit = 1024
+        try:
+            min_ms = float(q.get("min_ms", 0.0))
+        except ValueError:
+            min_ms = 0.0
         self._send(200, {"spans": get_tracer().recent(
-            q.get("trace"), limit=limit)})
+            q.get("trace"), limit=limit, min_ms=min_ms)})
 
     def log_message(self, fmt, *args):      # route to logging, not stderr
         _LOG.debug(self.log_prefix + fmt, *args)
@@ -216,6 +227,23 @@ class _Handler(JsonHandler):
                 self._send(200, {"ok": True,
                                  "status": "draining" if flag
                                  else "ok"})
+        elif path == "/v1/faults":
+            # scripted-chaos hook (prodday scenario engine): flip
+            # COS_FAULT_* knobs inside a LIVE replica — the env is
+            # normally read once at startup (COS003), so runtime
+            # scenarios need this explicit re-resolve
+            try:
+                req = self._read_json()
+                env = req.get("env")
+                if not isinstance(env, dict):
+                    raise ValueError("'env' must be an object of "
+                                     "COS_FAULT_* -> value|null")
+                plan = svc.apply_faults(env)
+            except (ValueError, json.JSONDecodeError, TypeError) as e:
+                self._send(400, {"error": str(e)})
+            else:
+                self._send(200, {"ok": True,
+                                 "faults": plan.describe()})
         elif path == "/v1/reload":
             try:
                 req = self._read_json()

@@ -54,6 +54,7 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import os
 import queue
 import socket
 import threading
@@ -790,20 +791,24 @@ class Router:
 
     # -- observability aggregation ------------------------------------
     def collect_traces(self, trace_id: Optional[str] = None,
-                       limit: int = 1024) -> List[dict]:
+                       limit: int = 1024,
+                       min_ms: float = 0.0) -> List[dict]:
         """Cross-replica trace view: this process's spans (router
         request/attempt) merged with every routable replica's
         `/v1/traces` ring, sorted by start timestamp — one slow
         request decomposes into which hop ate the latency without
-        ssh-ing into N processes.  Operator cadence, never the
-        request path."""
-        spans = list(self._tracer.recent(trace_id, limit=limit))
+        ssh-ing into N processes.  `min_ms` forwards to every ring so
+        an exemplar query moves only the slow spans.  Operator
+        cadence, never the request path."""
+        spans = list(self._tracer.recent(trace_id, limit=limit,
+                                         min_ms=min_ms))
         with self._lock:
             targets = [(r.name, r.url)
                        for r in self._replicas.values()
                        if r.state in (OK, DRAINING)]
         q = f"?limit={limit}" + (f"&trace={trace_id}"
-                                 if trace_id else "")
+                                 if trace_id else "") \
+            + (f"&min_ms={min_ms:g}" if min_ms > 0 else "")
         for _name, url in targets:
             try:
                 code, body = http_json(url + "/v1/traces" + q,
@@ -853,6 +858,11 @@ class Router:
     # -- reporting ----------------------------------------------------
     def metrics_summary(self) -> dict:
         out = self.metrics.summary()
+        # cos_build_info identity for the ROUTER process: scrape-based
+        # error-budget accounting pins restarts on pid change +
+        # cos_uptime_seconds decrease (the replica-side block carries
+        # the net digest/mesh/dtype — serving/service.py)
+        out["build_info"] = {"pid": str(os.getpid())}
         with self._lock:
             out["replicas"] = {
                 n: {"state": r.state, "url": r.url,
@@ -916,8 +926,12 @@ def _make_handler():
                     limit = int(q.get("limit", 1024))
                 except ValueError:
                     limit = 1024
+                try:
+                    min_ms = float(q.get("min_ms", 0.0))
+                except ValueError:
+                    min_ms = 0.0
                 self._send(200, {"spans": router.collect_traces(
-                    q.get("trace"), limit=limit)})
+                    q.get("trace"), limit=limit, min_ms=min_ms)})
             elif path == "/v1/models":
                 # fleet-wide per-model aggregation (name-keyed sums +
                 # worst p99 + residency map) — operator cadence, so

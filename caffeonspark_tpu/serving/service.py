@@ -27,6 +27,7 @@ for everything else.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -175,6 +176,7 @@ class InferenceService:
         from ..tools.chaos import resolve as _resolve_faults
         from ..utils.envutils import env_int as _env_int_strict
         ridx = _env_int_strict("COS_REPLICA_INDEX", -1, strict=False)
+        self._replica_index = ridx
         plan = _resolve_faults(rank=max(0, ridx))
         self.predict_slow_factor = plan.replica_slow_factor(ridx)
         if plan.replica_slow:
@@ -520,6 +522,23 @@ class InferenceService:
         layout = self.registry.layout
         return layout.describe() if layout is not None else None
 
+    def apply_faults(self, env: Dict[str, Optional[str]]):
+        """Runtime chaos hook (POST /v1/faults): flip COS_FAULT_*
+        knobs inside the live replica and re-resolve the plan.  The
+        env is normally read ONCE at startup (COS003) — scripted
+        scenarios (prodday) need this explicit re-resolve to stage a
+        straggler mid-phase and lift it later.  Only COS_FAULT_* keys
+        are accepted; a None/null value clears the knob."""
+        from ..tools.chaos import apply_fault_env
+        plan = apply_fault_env(env, rank=max(0, self._replica_index))
+        self.predict_slow_factor = \
+            plan.replica_slow_factor(self._replica_index)
+        self.metrics.set_info("faults", plan.describe())
+        record_event("service", "faults_applied",
+                     env={k: v for k, v in env.items()},
+                     slow_factor=self.predict_slow_factor)
+        return plan
+
     # -- reporting ----------------------------------------------------
     def models_summary(self) -> Dict[str, dict]:
         """Per-model block for /metrics and /v1/models: registry state
@@ -548,9 +567,35 @@ class InferenceService:
             })
         return out
 
+    def build_info(self) -> Dict[str, str]:
+        """Identity labels for the `cos_build_info` info-gauge: net
+        digest (the AOT serving-identity key), serve mesh signature,
+        weight dtype, pid.  A scrape that sees these CHANGE between
+        samples (or `cos_uptime_seconds` decrease) knows the replica
+        restarted — counter deltas must clamp at zero instead of being
+        misread as a huge negative rate."""
+        if getattr(self, "_build_info", None) is None:
+            from .aot import aot_cache_key
+            layout = self.registry.layout
+            mesh_sig = layout.signature() if layout is not None \
+                else "single"
+            self._build_info = {
+                "net_digest": aot_cache_key(
+                    self.conf.netParam, self.batcher.buckets,
+                    self.blob_names,
+                    mesh_sig=layout.signature()
+                    if layout is not None else None,
+                    weight_dtype=self.registry.weight_dtype),
+                "serve_mesh": mesh_sig,
+                "weight_dtype": self.registry.weight_dtype or "f32",
+                "pid": str(os.getpid()),
+            }
+        return dict(self._build_info)
+
     def metrics_summary(self) -> dict:
         out = self.metrics.summary()
         out["model_version"] = self.registry.version
+        out["build_info"] = self.build_info()
         out["buckets"] = list(self.batcher.buckets)
         # live depth + status: what the fleet router polls to spot a
         # backed-up replica and to confirm a drain went idle (ALL

@@ -392,3 +392,29 @@ class ChaosInjector:
 
 def make_injector(rank: int = 0) -> ChaosInjector:
     return ChaosInjector(resolve(rank))
+
+
+def apply_fault_env(env: dict, rank: int = 0) -> FaultPlan:
+    """Runtime knob flip: apply `{COS_FAULT_*: value|None}` updates to
+    this process's environment (None clears the knob) and re-resolve
+    the plan.  This is the ONE sanctioned exception to the read-once
+    rule: scripted scenarios (prodday) stage and lift faults mid-run
+    through explicit re-resolve hooks — `DeployController
+    .refresh_faults` and the replica's POST /v1/faults — never through
+    ambient re-reads on the hot path.  Only COS_FAULT_* keys are
+    accepted so a scenario file cannot rewrite unrelated process
+    state."""
+    for k, v in env.items():
+        if not str(k).startswith("COS_FAULT_"):
+            raise ValueError(f"apply_fault_env: {k!r} is not a "
+                             "COS_FAULT_* knob")
+    for k, v in env.items():
+        if v is None or v == "":
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = str(v)
+    plan = resolve(rank)
+    _record("chaos", "faults_applied", rank=rank,
+            env={k: (None if v in (None, "") else str(v))
+                 for k, v in env.items()})
+    return plan

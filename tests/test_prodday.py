@@ -1,0 +1,546 @@
+"""Production-day harness (caffeonspark_tpu/prodday): scenario
+parsing, traffic shapes, verdict math, incident reconstruction, and
+leak gates.
+
+The pins that matter:
+  * scenario validation is LINE-PRECISE — a bad phase, an unknown
+    fault kind, or two overlapping stateful-fault windows each reject
+    with the offending source line in the message;
+  * every checked-in scenarios/*.json parses clean;
+  * a PLANTED leak of each class (fd, child process, thread, resident
+    pair) trips exactly its gate;
+  * error-budget accounting clamps counter resets ONLY when a restart
+    was detected for the window, and detect_restarts catches a pid
+    change across a scrape GAP (a killed replica is absent from the
+    fleet scrape while down);
+  * incident reconstruction explains a fault only when evidence AND
+    recovery events appear in order within the deadline;
+  * /v1/traces?min_ms= filters spans by duration at the ring.
+"""
+
+import json
+import math
+import os
+import random
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from caffeonspark_tpu.obs.prom import PromWriter, parse_exposition
+from caffeonspark_tpu.obs.trace import Tracer
+from caffeonspark_tpu.prodday.leaks import leak_gates, snapshot_leaks
+from caffeonspark_tpu.prodday.scenario import (
+    ScenarioError, load_scenario, parse_scenario)
+from caffeonspark_tpu.prodday.traffic import (
+    RequestResult, TrafficGen, rate_at, summarize, zipf_ranks)
+from caffeonspark_tpu.prodday.verdict import (
+    detect_restarts, error_budget, reconstruct_incidents,
+    slow_exemplars)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# scenario parsing: line-precise validation
+# ---------------------------------------------------------------------------
+
+GOOD = """\
+{
+  "name": "ok-day",
+  "slo": {"p99_ms": 500, "availability": 0.99},
+  "phases": [
+    {"name": "p0", "duration_s": 10,
+     "load": {"shape": "flat", "rps": 5}}
+  ]
+}
+"""
+
+
+def test_scenario_minimal_parses():
+    sc = parse_scenario(GOOD, path="good.json")
+    assert sc.name == "ok-day"
+    assert sc.duration_s == 10
+    assert sc.phases[0].load.shape == "flat"
+    # defaults flow down
+    assert sc.phases[0].slo["p99_ms"] == 500.0
+
+
+def expect_line(text, line, fragment):
+    with pytest.raises(ScenarioError) as ei:
+        parse_scenario(text, path="scn.json")
+    msg = str(ei.value)
+    assert msg.startswith(f"scn.json:{line}: "), msg
+    assert fragment in msg, msg
+
+
+def test_unknown_fault_kind_reports_its_line():
+    text = GOOD.replace(
+        '     "load": {"shape": "flat", "rps": 5}}',
+        '     "load": {"shape": "flat", "rps": 5},\n'
+        '     "faults": [\n'
+        '       {"kind": "replica_melt", "at_s": 1}\n'
+        '     ]}')
+    expect_line(text, 8, "unknown fault kind 'replica_melt'")
+
+
+def test_bad_phase_missing_duration_reports_its_line():
+    text = GOOD.replace('"duration_s": 10,\n', '')
+    # phase object now starts (and errors) on its own line
+    expect_line(text, 5, "missing required 'duration_s'")
+
+
+def test_overlapping_stateful_windows_report_later_line():
+    text = GOOD.replace(
+        '     "load": {"shape": "flat", "rps": 5}}',
+        '     "load": {"shape": "flat", "rps": 5},\n'
+        '     "faults": [\n'
+        '       {"kind": "replica_slow", "at_s": 1, "clear_at_s": 6,\n'
+        '        "replica": 0, "factor": 4},\n'
+        '       {"kind": "replica_slow", "at_s": 4, "clear_at_s": 9,\n'
+        '        "replica": 0, "factor": 8}\n'
+        '     ]}')
+    expect_line(text, 10, "overlaps the schedule at line 8")
+
+
+def test_non_overlapping_or_other_target_windows_pass():
+    text = GOOD.replace(
+        '     "load": {"shape": "flat", "rps": 5}}',
+        '     "load": {"shape": "flat", "rps": 5},\n'
+        '     "faults": [\n'
+        '       {"kind": "replica_slow", "at_s": 1, "clear_at_s": 4,\n'
+        '        "replica": 0},\n'
+        '       {"kind": "replica_slow", "at_s": 4, "clear_at_s": 9,\n'
+        '        "replica": 0},\n'
+        '       {"kind": "replica_slow", "at_s": 2, "clear_at_s": 5,\n'
+        '        "replica": 1}\n'
+        '     ]}')
+    sc = parse_scenario(text)
+    assert len(sc.phases[0].faults) == 3
+
+
+def test_fault_at_or_after_phase_end_rejected():
+    text = GOOD.replace(
+        '     "load": {"shape": "flat", "rps": 5}}',
+        '     "load": {"shape": "flat", "rps": 5},\n'
+        '     "faults": [{"kind": "replica_kill", "at_s": 10,'
+        ' "replica": 0}]}')
+    expect_line(text, 7, "at/after the phase end")
+
+
+def test_clear_at_s_on_oneshot_kind_rejected():
+    text = GOOD.replace(
+        '     "load": {"shape": "flat", "rps": 5}}',
+        '     "load": {"shape": "flat", "rps": 5},\n'
+        '     "faults": [{"kind": "replica_kill", "at_s": 1,'
+        ' "replica": 0, "clear_at_s": 3}]}')
+    # per-kind key allowlist rejects the stray window key
+    expect_line(text, 7, "unknown key 'clear_at_s'")
+
+
+def test_duplicate_key_and_trailing_garbage_rejected():
+    expect_line('{\n  "name": "x",\n  "name": "y"\n}', 3,
+                "duplicate key")
+    with pytest.raises(ScenarioError):
+        parse_scenario(GOOD + "trailing")
+
+
+def test_unknown_top_level_key_rejected():
+    expect_line(GOOD.replace('"name": "ok-day",',
+                             '"name": "ok-day",\n  "rpz": 1,'),
+                3, "unknown key 'rpz'")
+
+
+def test_checked_in_scenarios_parse():
+    scdir = os.path.join(REPO, "scenarios")
+    paths = sorted(os.listdir(scdir))
+    assert paths, "scenarios/ must not be empty"
+    for p in paths:
+        sc = load_scenario(os.path.join(scdir, p))
+        assert sc.phases and sc.duration_s > 0
+
+
+# ---------------------------------------------------------------------------
+# traffic: load shapes + zipf mix + open-loop generator
+# ---------------------------------------------------------------------------
+
+def load_of(text):
+    return parse_scenario(text).phases[0].load
+
+
+def mk_load(**kw):
+    body = {"shape": "flat", "rps": 10}
+    body.update(kw)
+    doc = {"name": "t", "slo": {"p99_ms": 1, "availability": 0.9},
+           "phases": [{"name": "p", "duration_s": 10, "load": body}]}
+    return load_of(json.dumps(doc))
+
+
+def test_rate_at_shapes():
+    flat = mk_load()
+    assert rate_at(flat, 0, 10) == 10 == rate_at(flat, 9.9, 10)
+    ramp = mk_load(shape="ramp", floor=0.5)
+    assert rate_at(ramp, 0, 10) == pytest.approx(5.0)
+    assert rate_at(ramp, 10, 10) == pytest.approx(10.0)
+    di = mk_load(shape="diurnal", floor=0.2)
+    assert rate_at(di, 0, 10) == pytest.approx(2.0)
+    assert rate_at(di, 5, 10) == pytest.approx(10.0)   # midday peak
+    assert rate_at(di, 10, 10) == pytest.approx(2.0)
+    fl = mk_load(shape="flash", spike_x=3, spike_at=0.5,
+                 spike_frac=0.2)
+    assert rate_at(fl, 4.9, 10) == 10
+    assert rate_at(fl, 5.0, 10) == 30
+    assert rate_at(fl, 6.9, 10) == 30
+    assert rate_at(fl, 7.0, 10) == 10
+
+
+def test_zipf_ranks_head_heavy_and_deterministic():
+    pick1 = zipf_ranks(8, 2, random.Random(3))
+    pick2 = zipf_ranks(8, 2, random.Random(3))
+    picks1 = [pick1() for _ in range(500)]
+    picks2 = [pick2() for _ in range(500)]
+    assert picks1 == picks2
+    counts = [picks1.count(r) for r in range(8)]
+    assert counts[0] > counts[3] > 0
+    assert all(0 <= p < 8 for p in picks1)
+
+
+def test_traffic_gen_open_loop_counts_and_malformed():
+    statuses = {b"good": 200, b"bad": 400}
+    seen = []
+
+    def send(payload, tenant, trace_id):
+        seen.append((payload, tenant.name, trace_id))
+        return statuses[payload]
+
+    gen = TrafficGen(send, [b"good"], [b"bad"], seed=3,
+                     inflight_cap=64)
+    res = gen.run_phase(mk_load(rps=60, malformed_p=0.2), 1.0)
+    assert res, "open loop must offer requests"
+    s = summarize(res)
+    assert s["offered"] == len(res)
+    assert s["ok"] > 0 and s["failed"] == 0
+    assert s["malformed_offered"] > 0
+    # 400 on a malformed payload is correct handling
+    assert s["malformed_mishandled"] == 0
+    assert s["p99_ms"] is not None
+    # every request got a trace id (trace_every=1 default)
+    assert all(t for _, _, t in seen)
+
+
+def test_traffic_gen_shed_at_inflight_cap():
+    gate = threading.Event()
+
+    def send(payload, tenant, trace_id):
+        gate.wait(5.0)
+        return 200
+
+    gen = TrafficGen(send, [b"x"], seed=5, inflight_cap=2)
+    res = gen.run_phase(mk_load(rps=80), 0.5)
+    gate.set()
+    s = summarize(res)
+    assert s["shed"] > 0, "cap must shed, not queue unboundedly"
+    assert s["shed"] + s["ok"] + s["failed"] == s["offered"]
+
+
+def test_transport_failure_counts_as_status_0():
+    def send(payload, tenant, trace_id):
+        raise ConnectionError("boom")
+
+    gen = TrafficGen(send, [b"x"], seed=5)
+    res = gen.run_phase(mk_load(rps=40), 0.3)
+    assert res and all(r.status == 0 for r in res if not r.shed)
+    assert summarize(res)["failed"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# leak gates: planted leaks each trip exactly their gate
+# ---------------------------------------------------------------------------
+
+def test_leak_gates_clean_pass():
+    snap = snapshot_leaks({"m": ["replica0"]})
+    gates = leak_gates(snap, snap)
+    assert gates["ok"]
+    assert all(gates[k]["ok"] is not False
+               for k in ("fds", "children", "threads", "residency"))
+
+
+def test_planted_fd_leak_trips_fd_gate_only():
+    start = snapshot_leaks()
+    pipes = [os.pipe() for _ in range(3)]   # 6 fds > slack of 2
+    try:
+        end = snapshot_leaks()
+        gates = leak_gates(start, end)
+        assert gates["fds"]["ok"] is False
+        assert gates["children"]["ok"] is not False
+        assert gates["ok"] is False
+    finally:
+        for r, w in pipes:
+            os.close(r)
+            os.close(w)
+    assert leak_gates(start, snapshot_leaks())["fds"]["ok"]
+
+
+def test_planted_child_process_leak_trips_children_gate():
+    start = snapshot_leaks()
+    proc = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(30)"])
+    try:
+        end = snapshot_leaks()
+        gates = leak_gates(start, end, fd_slack=64)
+        assert gates["children"]["ok"] is False
+        assert proc.pid in gates["children"]["leaked_pids"]
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_planted_thread_leak_trips_threads_gate():
+    start = snapshot_leaks()
+    stop = threading.Event()
+    th = threading.Thread(target=stop.wait, name="leaky-poller",
+                          daemon=True)
+    th.start()
+    try:
+        gates = leak_gates(start, snapshot_leaks(), fd_slack=64)
+        assert gates["threads"]["ok"] is False
+        assert "leaky-poller" in gates["threads"]["leaked"]
+    finally:
+        stop.set()
+        th.join()
+
+
+def test_allowlisted_thread_does_not_trip():
+    start = snapshot_leaks()
+    end = dict(start)
+    end["threads"] = sorted(end["threads"] + ["cos-trace-spool"])
+    assert leak_gates(start, end)["threads"]["ok"]
+
+
+def test_planted_residency_leak_trips_residency_gate():
+    start = snapshot_leaks({"m0": ["replica0", "replica1"]})
+    end = snapshot_leaks({"m0": ["replica0", "replica1"],
+                          "m1": ["replica0"]})
+    gates = leak_gates(start, end, fd_slack=64)
+    assert gates["residency"]["ok"] is False
+    assert gates["residency"]["leaked"] == ["m1@replica0"]
+    # a model PAGED OUT by day end is fine (shrinkage is not a leak)
+    assert leak_gates(end, start, fd_slack=64)["residency"]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# verdict: restart detection + error-budget math on synthetic scrapes
+# ---------------------------------------------------------------------------
+
+def scrape(t, routed, failures, pid="100", p99=50.0, uptime=None,
+           extra=""):
+    text = (
+        "# TYPE cos_routed_total counter\n"
+        f'cos_routed_total{{role="router"}} {routed}\n'
+        "# TYPE cos_replica_failures_total counter\n"
+        f'cos_replica_failures_total{{replica="replica0",'
+        f'role="router"}} {failures}\n'
+        "# TYPE cos_stage_ms gauge\n"
+        f'cos_stage_ms{{role="router",stage="route",'
+        f'quantile="0.99"}} {p99}\n'
+        "# TYPE cos_build_info gauge\n"
+        f'cos_build_info{{role="replica",replica="replica0",'
+        f'pid="{pid}"}} 1\n')
+    if uptime is not None:
+        text += ("# TYPE cos_uptime_seconds gauge\n"
+                 f'cos_uptime_seconds{{role="replica",'
+                 f'replica="replica0"}} {uptime}\n')
+    return t, parse_exposition(text + extra)
+
+
+def test_error_budget_within_budget_passes():
+    samples = [scrape(0, 0, 0), scrape(5, 50, 0), scrape(10, 100, 1)]
+    v = error_budget(samples, 0, 10, {"p99_ms": 100,
+                                      "availability": 0.9})
+    assert v["routed"] == 100 and v["failures"] == 1
+    assert v["error_budget"] == pytest.approx(10.1)
+    assert v["budget_ok"] and v["p99_ok"] and v["slo_ok"]
+
+
+def test_error_budget_blown_by_failures():
+    samples = [scrape(0, 0, 0), scrape(10, 100, 30)]
+    v = error_budget(samples, 0, 10, {"p99_ms": 100,
+                                      "availability": 0.95})
+    assert v["failures"] == 30 and not v["budget_ok"]
+    assert not v["slo_ok"]
+
+
+def test_error_budget_blown_by_p99_gauge():
+    samples = [scrape(0, 0, 0), scrape(5, 40, 0, p99=400.0),
+               scrape(10, 80, 0)]
+    v = error_budget(samples, 0, 10, {"p99_ms": 100,
+                                      "availability": 0.9})
+    assert v["budget_ok"]
+    assert v["p99_worst_ms"] == 400.0 and v["p99_ok"] is False
+    assert not v["slo_ok"]
+
+
+def test_detect_restart_across_scrape_gap():
+    # replica absent from the middle scrape (it is DOWN): old and new
+    # pid never share an adjacent sample pair — the carried-forward
+    # identity map must still flag the change
+    down = (5, parse_exposition(
+        "# TYPE cos_routed_total counter\n"
+        'cos_routed_total{role="router"} 50\n'))
+    samples = [scrape(0, 0, 0, pid="100", uptime=30.0), down,
+               scrape(10, 100, 0, pid="200", uptime=2.0)]
+    restarts = detect_restarts(samples)
+    kinds = {r["kind"] for r in restarts}
+    assert kinds == {"pid_change", "uptime_reset"}
+    pc = next(r for r in restarts if r["kind"] == "pid_change")
+    assert pc["old_pid"] == "100" and pc["new_pid"] == "200"
+    assert pc["t"] == 10
+
+
+def test_counter_reset_with_restart_clamps_without_finding():
+    samples = [scrape(0, 0, 5, pid="100"),
+               scrape(10, 100, 2, pid="200")]   # failures reset 5 -> 2
+    v = error_budget(samples, 0, 10, {"p99_ms": 100,
+                                      "availability": 0.9})
+    assert v["restarts"], "pid change must register"
+    assert v["unexplained_counter_resets"] == []
+    assert v["failures"] == 2     # clamped: the new process's total
+
+
+def test_counter_reset_without_restart_is_a_finding():
+    samples = [scrape(0, 0, 5), scrape(10, 100, 2)]   # same pid
+    v = error_budget(samples, 0, 10, {"p99_ms": 100,
+                                      "availability": 0.9})
+    assert v["unexplained_counter_resets"]
+    assert not v["slo_ok"]
+
+
+# ---------------------------------------------------------------------------
+# incident reconstruction on a synthetic timeline
+# ---------------------------------------------------------------------------
+
+def ev(ts, source, event, **kw):
+    return dict({"ts": ts, "source": source, "event": event}, **kw)
+
+
+def test_reconstruction_explains_kill_and_slow():
+    timeline = [
+        ev(100.0, "prodday", "day_start"),
+        ev(101.2, "fleet", "replica_died", replica="replica0"),
+        ev(103.0, "fleet", "replica_rejoined", replica="replica0"),
+        ev(105.0, "fleet", "replica_fault_set", replica="replica1",
+           env={"COS_FAULT_REPLICA_SLOW": "1:8"}),
+        ev(109.0, "fleet", "replica_fault_set", replica="replica1",
+           env={"COS_FAULT_REPLICA_SLOW": None}),
+    ]
+    injected = [
+        {"kind": "replica_kill", "replica": 0, "phase": "p0",
+         "t_wall": 101.0},
+        {"kind": "replica_slow", "replica": 1, "phase": "p0",
+         "t_wall": 104.9},
+    ]
+    rec = reconstruct_incidents(timeline, injected,
+                                recovery_deadline_s=30)
+    assert rec["ok"] and rec["explained"] == 2
+    kill = rec["incidents"][0]
+    assert kill["evidence"]["event"] == "replica_died"
+    assert kill["recovery_s"] == pytest.approx(1.8)
+
+
+def test_reconstruction_fails_without_recovery_or_evidence():
+    timeline = [ev(101.2, "fleet", "replica_died", replica="replica0")]
+    injected = [{"kind": "replica_kill", "replica": 0,
+                 "t_wall": 101.0}]
+    rec = reconstruct_incidents(timeline, injected)
+    assert not rec["ok"]
+    inc = rec["incidents"][0]
+    assert inc["evidence"] is not None and inc["recovery"] is None
+
+    # evidence BEFORE the injection time does not count
+    early = [ev(90.0, "fleet", "replica_died", replica="replica0"),
+             ev(91.0, "fleet", "replica_rejoined", replica="replica0")]
+    rec2 = reconstruct_incidents(early, injected)
+    assert not rec2["ok"]
+    assert rec2["incidents"][0]["evidence"] is None
+
+
+def test_reconstruction_recovery_deadline_enforced():
+    timeline = [
+        ev(101.0, "fleet", "replica_died", replica="replica0"),
+        ev(200.0, "fleet", "replica_rejoined", replica="replica0"),
+    ]
+    injected = [{"kind": "replica_kill", "replica": 0,
+                 "t_wall": 101.0}]
+    assert not reconstruct_incidents(timeline, injected,
+                                     recovery_deadline_s=30)["ok"]
+    assert reconstruct_incidents(timeline, injected,
+                                 recovery_deadline_s=120)["ok"]
+
+
+def test_reconstruction_canary_kill_needs_non_accept_round():
+    timeline = [
+        ev(101.0, "chaos", "canary_kill"),
+        ev(105.0, "deploy", "round", verdict="accept"),
+    ]
+    injected = [{"kind": "canary_kill", "t_wall": 100.9}]
+    assert not reconstruct_incidents(timeline, injected)["ok"]
+    timeline[1] = ev(105.0, "deploy", "round", verdict="aborted")
+    assert reconstruct_incidents(timeline, injected)["ok"]
+
+
+def test_deploy_round_is_an_action_not_an_incident():
+    rec = reconstruct_incidents([], [{"kind": "deploy_round",
+                                      "t_wall": 100.0}])
+    assert rec["ok"] and rec["faults_injected"] == 0
+
+
+def test_slow_exemplars_fetches_worst_traced():
+    def rr(lat, status=200, trace="t"):
+        return RequestResult(0.0, lat, status, "default", False,
+                             False, trace)
+
+    results = [rr(10, trace="a"), rr(90, trace="b"),
+               rr(50, trace="c"), rr(99, status=500, trace="d"),
+               rr(70, trace=None)]
+    out = slow_exemplars(results, lambda tid: [{"trace": tid}], n=2)
+    assert [e["trace_id"] for e in out] == ["b", "c"]
+    assert out[0]["spans"] == [{"trace": "b"}]
+
+
+# ---------------------------------------------------------------------------
+# satellites: trace ring min_ms filter + build_info exposition roundtrip
+# ---------------------------------------------------------------------------
+
+def test_tracer_recent_min_ms_filter():
+    from caffeonspark_tpu.obs.trace import SpanCtx
+    tr = Tracer("test", sample=1.0, spool_dir="")
+    for i, dur in enumerate((0.001, 0.050, 0.200)):
+        tr.record_span(f"op{i}", SpanCtx(f"t{i}", "0" * 16), dur)
+    assert len(tr.recent()) == 3
+    slow = tr.recent(min_ms=40.0)
+    assert [s["name"] for s in slow] == ["op1", "op2"]
+    assert tr.recent(trace_id="t2", min_ms=40.0)[0]["name"] == "op2"
+    assert tr.recent(min_ms=1000.0) == []
+
+
+def test_build_info_and_uptime_expose_and_roundtrip():
+    w = PromWriter()
+    w.add_summary({"counters": {"requests": 3},
+                   "build_info": {"net_digest": "abc123",
+                                  "serve_mesh": "single",
+                                  "weight_dtype": "f32",
+                                  "pid": "4242"},
+                   "uptime_s": 12.5},
+                  {"role": "replica", "replica": "replica0"})
+    fams = parse_exposition(w.render())
+    bi = fams["cos_build_info"]["samples"]
+    assert len(bi) == 1
+    labels, v = bi[0]
+    assert v == 1.0 and labels["pid"] == "4242"
+    assert labels["net_digest"] == "abc123"
+    up = fams["cos_uptime_seconds"]["samples"][0]
+    assert up[1] == 12.5 and up[0]["replica"] == "replica0"
+    # restart detector sees this identity
+    restarts = detect_restarts([(0.0, fams), (1.0, fams)])
+    assert restarts == []
