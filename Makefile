@@ -9,6 +9,7 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 .PHONY: build native install lint test test-slow spark-test bench \
   smoke tpu-tests bench-evidence bench-ingest bench-steploop \
   bench-serving bench-serving-sharded bench-serving-multimodel \
+  bench-serving-pp \
   bench-gradsync bench-syncmode bench-autotune bench-deploy \
   bench-obs bench-tail bench-prodday prodday-smoke chaos \
   chaos-deploy onchip-artifacts docs clean
@@ -198,6 +199,15 @@ bench-serving-multimodel:
 	$(CPU_ENV) $(PY) scripts/bench_serving.py --multimodel \
 	  --out bench_evidence/bench_serving_multimodel.json
 
+# pipeline-parallel serving: stage-granular HBM paging under a pp=2
+# mesh — over-budget p99 vs the unconstrained control, cold-start
+# TTFR vs whole-model paging, never-mixed + recompile integrity
+# under 500+ concurrent stage page-ins
+bench-serving-pp:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_serving.py --pp 2 \
+	  --out bench_evidence/bench_serving_pp.json
+
 smoke:
 	BENCH_SMOKE=1 $(PY) bench.py
 
@@ -218,6 +228,8 @@ bench-evidence:
 	  --out bench_evidence/bench_autotune.json
 	-$(CPU_ENV) $(PY) scripts/bench_serving.py --multimodel \
 	  --out bench_evidence/bench_serving_multimodel.json
+	-$(CPU_ENV) $(PY) scripts/bench_serving.py --pp 2 \
+	  --out bench_evidence/bench_serving_pp.json
 	-$(CPU_ENV) $(PY) scripts/bench_deploy.py \
 	  --out bench_evidence/bench_deploy.json
 	-$(CPU_ENV) $(PY) scripts/bench_obs.py \

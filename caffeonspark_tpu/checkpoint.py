@@ -408,7 +408,8 @@ def _resolve_learned_net(state_path: str) -> str:
 
 
 def load_serving_params(net: Net, model_path: str, *,
-                        strict: bool = False, layout=None) -> Params:
+                        strict: bool = False, layout=None,
+                        layers=None) -> Params:
     """Snapshot → inference params WITHOUT an optimizer or a training
     run (the serving registry's loader).  Accepts .caffemodel[.h5]
     directly; a .solverstate[.h5] resolves its learned_net pointer
@@ -428,23 +429,34 @@ def load_serving_params(net: Net, model_path: str, *,
     copy of a sharded blob is materialized, so hot-swap wall time and
     peak host RSS scale with 1/N instead of with model size
     (tests/test_serving_sharded.py pins this by making the dense-host
-    path raise)."""
+    path raise).
+
+    `layers` (a collection of layer names) restricts the load to those
+    layers' blobs — the stage-granular page-in path: the registry
+    streams ONE pipeline stage's blobs to that stage's devices while
+    other stages stay cold.  A filtered load that matches zero blobs
+    is legal (a stage of param-less layers); an UNfiltered load that
+    matches nothing still raises."""
     import jax
     path = model_path
     if ".solverstate" in fsutils.basename(path):
         path = _resolve_learned_net(path)
-    if layout is None:
+    if layout is None and layers is None:
         params = net.init(jax.random.key(0))
         return copy_layers(net, params, path, strict=strict)
-    if path.endswith(".h5"):
+    if layout is None or path.endswith(".h5"):
         # the h5 container has no shard sidecar format: dense load,
         # then place on the mesh (a gather-free put — the file is
         # already a dense host representation)
         params = net.init(jax.random.key(0))
         params = copy_layers(net, params, path, strict=strict)
-        return layout.place_params(params)
+        if layers is not None:
+            keep = set(layers)
+            params = {ln: bl for ln, bl in params.items() if ln in keep}
+        return layout.place_params(params) if layout is not None \
+            else params
     return _load_serving_params_streamed(net, path, layout,
-                                         strict=strict)
+                                         strict=strict, layers=layers)
 
 
 def _parse_bounds(key: str, shape) -> Tuple[slice, ...]:
@@ -528,16 +540,21 @@ def _device_put_streamed(value, sharding) -> jax.Array:
 
 
 def _load_serving_params_streamed(net: Net, path: str, layout, *,
-                                  strict: bool = False) -> Params:
+                                  strict: bool = False,
+                                  layers=None) -> Params:
     """The mesh body of load_serving_params: copy_layers semantics
     (match by layer name + blob position, shape-checked, filler init
-    for absent layers) with per-shard streaming placement."""
+    for absent layers) with per-shard streaming placement.  `layers`
+    restricts to a stage's layer subset (see load_serving_params)."""
     import jax
     values = _param_blob_values(path)
     out: Params = {}
     init_params = None
     copied = 0
+    keep = None if layers is None else set(layers)
     for lname, specs in net.param_layout.items():
+        if keep is not None and lname not in keep:
+            continue
         out[lname] = {}
         blobs = values.get(lname)
         for i, (bname, shape, _) in enumerate(specs):
@@ -569,7 +586,10 @@ def _load_serving_params_streamed(net: Net, path: str, layout, *,
                 continue
             out[lname][bname] = _device_put_streamed(v, sh)
             copied += 1
-    if copied == 0:
+    if copied == 0 and keep is None:
+        # a stage filter may legally match zero blobs (a stage of
+        # param-less layers); a whole-net load that copies nothing is
+        # always a wrong-file error
         raise ValueError(f"no blobs matched from {path}")
     return out
 

@@ -90,11 +90,13 @@ def build_argparser() -> argparse.ArgumentParser:
       help="serving bind address (loopback by default; the unauth'd "
            "/v1/reload endpoint makes wider binds an explicit opt-in)")
     a("-serveMesh", dest="serveMesh", default="",
-      help="serving mesh spec dp[,tp[,sp[,ep]]] (same grammar as "
-           "-mesh): mesh-parallel forward with params tp/ep-sharded "
-           "and the batch dp-sharded, serving nets bigger than one "
-           "device; env equivalents COS_SERVE_MESH (same spec) and "
-           "COS_SERVE_TP=N (tp-only shorthand)")
+      help="serving mesh spec dp[,tp[,sp[,ep]]] or key=value with "
+           "pp=N (same grammar as -mesh): mesh-parallel forward with "
+           "params tp/ep-sharded and the batch dp-sharded, serving "
+           "nets bigger than one device; pp=N cuts the forward into "
+           "N roofline-balanced stages, each an independent HBM "
+           "paging unit; env equivalents COS_SERVE_MESH (same spec) "
+           "and COS_SERVE_TP=N (tp-only shorthand)")
     a("-serveReplicas", dest="serveReplicas", type=int, default=0,
       help="fleet mode: N replica serving processes behind a "
            "least-outstanding router with retry + rolling hot-swap "

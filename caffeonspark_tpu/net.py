@@ -664,7 +664,8 @@ class Net:
     def apply(self, params: Params, inputs: Dict[str, Array], *,
               train: Optional[bool] = None, rng: Optional[Array] = None,
               net_state: Optional[Dict] = None,
-              qscales: Optional[Dict] = None
+              qscales: Optional[Dict] = None,
+              layers: Optional[Sequence[str]] = None
               ) -> Tuple[Dict[str, Array], Dict]:
         """Forward pass. Returns (all blobs, updated_param_blobs).
 
@@ -678,7 +679,14 @@ class Net:
         time max-abs scales for quantized-resident serving weights
         (serving/quant.py): an op receiving an int8 param finds its
         dequant scale via Ctx.qscale and runs the dequant-free kernel
-        path.  None (every training/eval caller) is inert."""
+        path.  None (every training/eval caller) is inert.
+
+        `layers` restricts the pass to a subset of compute_layers (run
+        in net order) — the pipeline-stage body used by parallel/pp.py
+        and serving/forward.py.  The caller supplies the stage's
+        boundary blobs via `inputs` and must keep any layer named by
+        `fused_bias_lrn` together with its producing conv (one stage),
+        since the fused kernel pulls the conv's bias out of `params`."""
         if train is None:
             train = self.state.phase == Phase.TRAIN
         blobs: Dict[str, Array] = dict(inputs)
@@ -689,7 +697,11 @@ class Net:
                     bias_lrn=self._bias_lrn_set,
                     qscales=qscales)
         cast = (self.compute_dtype != self.dtype)
-        for lp in self.compute_layers:
+        subset = None if layers is None else set(layers)
+        compute = (self.compute_layers if subset is None else
+                   [lp for lp in self.compute_layers
+                    if lp.name in subset])
+        for lp in compute:
             op = L.get_op(lp.type)
             ctx.layer_name = lp.name
             ctx.variant = self.layer_variants.get(lp.name)

@@ -162,6 +162,11 @@ class PromWriter:
             self.sample("model_resident", "gauge",
                         "1 = model resident in HBM",
                         1.0 if st.get("resident") else 0.0, ml)
+            for sg in (st.get("stages") or []):
+                self.sample("stage_resident", "gauge",
+                            "1 = pipeline stage resident in HBM",
+                            1.0 if sg.get("resident") else 0.0,
+                            dict(ml, stage=str(sg.get("stage"))))
             for k in ("requests", "rows", "evictions", "page_ins"):
                 if st.get(k) is not None:
                     self.sample(f"model_{k}_total", "counter",

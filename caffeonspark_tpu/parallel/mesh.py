@@ -21,7 +21,7 @@ ride ICI neighbors first.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import jax
 import numpy as np
@@ -32,15 +32,40 @@ AXES = ("pp", "ep", "sp", "tp", "dp")
 
 def parse_mesh_spec(spec: str) -> Dict[str, int]:
     """'dp[,tp[,sp[,ep]]]' → build_mesh kwargs; rejects extra dims
-    instead of silently dropping them.  Shared by the training CLI
-    (-mesh) and the serving CLI (-serveMesh)."""
-    dims = [int(x) for x in spec.split(",")]
+    instead of silently dropping them.  Any token may instead be a
+    named 'axis=N' dim ('pp=4', 'tp=2,pp=2', '2,2,pp=2') — the only
+    spelling for the pp axis, which has no positional slot.  Shared by
+    the training CLI (-mesh) and the serving CLI (-serveMesh)."""
     names = ["dp", "tp", "sp", "ep"]
-    if len(dims) > len(names):
-        raise ValueError(
-            f"mesh spec {spec!r} has {len(dims)} dims; expected at most "
-            f"{len(names)} ({','.join(names)})")
-    return dict(zip(names, dims))
+    out: Dict[str, int] = {}
+    pos = 0
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if "=" in tok:
+            name, _, val = tok.partition("=")
+            name = name.strip()
+            if name not in AXES:
+                raise ValueError(
+                    f"mesh spec {spec!r}: unknown axis {name!r} "
+                    f"(axes: {','.join(AXES)})")
+            dim = int(val)
+        else:
+            if pos >= len(names):
+                raise ValueError(
+                    f"mesh spec {spec!r} has more than {len(names)} "
+                    f"positional dims ({','.join(names)})")
+            name = names[pos]
+            pos += 1
+            dim = int(tok)
+        if name in out:
+            raise ValueError(
+                f"mesh spec {spec!r}: axis {name!r} given twice")
+        if dim < 1:
+            raise ValueError(
+                f"mesh spec {spec!r}: axis {name!r} must be >= 1, "
+                f"got {dim}")
+        out[name] = dim
+    return out
 
 
 def distributed_init(coordinator: Optional[str] = None,
@@ -220,11 +245,41 @@ class MeshLayout:
         self.shapes = {ln: {bn: s for bn, s, _ in blobs}
                        for ln, blobs in net.param_layout.items()}
         validate_param_specs(self.param_specs, self.shapes, mesh)
+        # -- pipeline stages (pp axis) ---------------------------------
+        # pp > 1 cuts the net into contiguous stages (the roofline-
+        # balanced partitioner shared with PipelineSolver) and pins
+        # each stage's params to the submesh of its pp row: every
+        # downstream consumer of param_sharding — place_params, the
+        # zero-gather streaming loader, the serving registry — then
+        # places or pages a stage's blobs straight onto that stage's
+        # devices with no further routing logic.
+        self.pp = 1
+        self.stages: List[List[str]] = [
+            [lp.name for lp in net.compute_layers]]
+        self.stage_of_layer: Dict[str, int] = {}
+        self.stage_meshes: List[Mesh] = [mesh]
+        if int(mesh.shape.get("pp", 1)) > 1:
+            from .pp import partition_layers   # lazy: avoids cycle
+            self.stages = partition_layers(
+                net, int(mesh.shape.get("pp", 1)))
+            self.pp = len(self.stages)
+            self.stage_meshes = [Mesh(mesh.devices[k], AXES[1:])
+                                 for k in range(self.pp)]
+        for k, names in enumerate(self.stages):
+            for nme in names:
+                self.stage_of_layer[nme] = k
+
+        def _owner(ln: str) -> Mesh:
+            return self.stage_meshes[self.stage_of_layer.get(ln, 0)] \
+                if self.pp > 1 else mesh
+
         self.param_sharding = {
-            ln: {bn: NamedSharding(mesh, spec)
+            ln: {bn: NamedSharding(_owner(ln), spec)
                  for bn, spec in blobs.items()}
             for ln, blobs in self.param_specs.items()}
         self.repl = replicated(mesh)
+        self.stage_repl = ([replicated(m) for m in self.stage_meshes]
+                           if self.pp > 1 else [self.repl])
 
     # -- inputs ---------------------------------------------------------
     def input_specs(self, net=None) -> Dict[str, P]:
@@ -244,7 +299,10 @@ class MeshLayout:
         return out
 
     def input_shardings(self, net=None) -> Dict[str, NamedSharding]:
-        return {name: NamedSharding(self.mesh, spec)
+        # staged layouts feed inputs to stage 0's devices only — the
+        # remaining stages receive activations, never inputs
+        m = self.stage_meshes[0] if self.pp > 1 else self.mesh
+        return {name: NamedSharding(m, spec)
                 for name, spec in self.input_specs(net).items()}
 
     # -- placement ------------------------------------------------------
@@ -283,14 +341,20 @@ class MeshLayout:
             for ln, blobs in self.param_specs.items()
             for bn, spec in blobs.items()
             if any(ax is not None for ax in spec))
-        return {"axes": axes or {"dp": 1},
-                "devices": int(self.mesh.devices.size),
-                "sharded_params": sharded}
+        out = {"axes": axes or {"dp": 1},
+               "devices": int(self.mesh.devices.size),
+               "sharded_params": sharded}
+        if self.pp > 1:
+            out["pp_stages"] = [len(s) for s in self.stages]
+        return out
 
     def signature(self) -> str:
         """Stable topology+layout signature: distinct meshes (or
         distinct param layouts under one mesh) must never share a
-        compiled-program cache namespace (serving/aot.py)."""
+        compiled-program cache namespace (serving/aot.py).  Staged
+        layouts append the pp stage boundaries — a staged and an
+        unstaged program of the same net (or two cuts of it) compile
+        to different executables and must never collide."""
         axes = ",".join(f"{ax}{self.mesh.shape.get(ax, 1)}"
                         for ax in self.mesh.axis_names)
         specs = ";".join(
@@ -298,7 +362,11 @@ class MeshLayout:
             for ln in sorted(self.param_specs)
             for bn, spec in sorted(self.param_specs[ln].items())
             if any(ax is not None for ax in spec))
-        return f"mesh({axes})|{specs}"
+        sig = f"mesh({axes})|{specs}"
+        if self.pp > 1:
+            cuts = ",".join(str(len(s)) for s in self.stages)
+            sig += f"|pp[{cuts}]"
+        return sig
 
 
 def lockstep_steps(total_records: int, batch_per_step: int,

@@ -4,10 +4,11 @@ Not present in the reference (SURVEY §2.7: pipeline parallel — no);
 provided as a TPU-native extension for models too large for one chip's
 HBM.  Design:
 
-  * the layer graph is cut into contiguous stages balanced by
-    parameter + activation cost (`partition_layers` — activation sizes
-    come from the net's static shape inference), each stage's params
-    pinned to one device;
+  * the layer graph is cut into contiguous stages balanced by the
+    roofline byte model (`partition_layers` costs every layer via
+    `analysis/roofline.analyze_net` — the same per-layer FLOPs/bytes
+    model the autotuner prunes with), each stage's params pinned to
+    one device;
   * forward runs per-stage jitted functions with explicit inter-stage
     `device_put` (the activation hop rides ICI on real hardware);
   * backward chains `jax.vjp` through the stages in reverse — stage s's
@@ -29,7 +30,6 @@ HBM.  Design:
 
 from __future__ import annotations
 
-import math
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -37,47 +37,120 @@ import jax
 import jax.numpy as jnp
 
 from ..net import Net, Params
-from ..ops import layers as L
 from ..solver import OptState, Solver, learning_rate
 
 Array = jax.Array
 
 
-def partition_layers(net: Net, num_stages: int, *,
-                     act_weight: float = 1.0) -> List[List[str]]:
-    """Contiguous stages balanced by parameter + activation cost, ≥1
-    layer per stage.  Activation cost (top-blob elements, from the
-    net's static shape inference) matters as much as parameter count:
-    early conv layers are param-light but activation-heavy, and a
-    param-only balance starves the later stages' devices of work while
-    overloading stage 0's memory with stashed activations."""
-    costs = []
-    for lp in net.compute_layers:
-        n = sum(math.prod(s) for _, s, _ in
-                net.param_layout.get(lp.name, []))
-        a = sum(math.prod(s) for s in
-                net._top_shapes.get(lp.name, {}).values())
-        costs.append((lp.name, max(n + act_weight * a, 1)))
-    n = len(costs)
-    num_stages = min(num_stages, n)
-    total = sum(c for _, c in costs)
+def layer_costs(net: Net) -> Dict[str, float]:
+    """Per-layer pipeline-balance cost from the one roofline byte model
+    (`analysis/roofline.analyze_net`) — partitioning and the autotuner
+    must not disagree about what a layer costs.  Bytes (not FLOPs) are
+    the balance currency: on TPU the stage hop rides ICI and the math
+    mostly hides behind HBM traffic, so the byte model's per-layer
+    `bytes` row (activations in+out, params read, optimizer traffic)
+    is the quantity whose per-stage max we minimize."""
+    from ..analysis.roofline import analyze_net
+    nbytes = jnp.dtype(net.dtype).itemsize
+    rows = analyze_net(net, act_bytes=nbytes, param_bytes=nbytes)
+    return {r["layer"]: max(float(r["bytes"]), 1.0) for r in rows}
+
+
+def partition_layers(net: Net, num_stages: int) -> List[List[str]]:
+    """Contiguous stages balanced by the roofline byte model
+    (`layer_costs`), ≥1 layer per stage.  Byte cost covers both sides
+    of the old ad-hoc param+activation heuristic: early conv layers are
+    param-light but activation-heavy, and a param-only balance starves
+    the later stages' devices of work while overloading stage 0's
+    memory with stashed activations.
+
+    Cuts between a bias-fused LRN and its producing conv are forbidden:
+    the fused kernel pulls the conv's bias out of the same stage's
+    params (net.apply's `fused_bias_lrn` coupling), so the pair must be
+    co-staged."""
+    names = [lp.name for lp in net.compute_layers]
+    costs = layer_costs(net)
+    seq = [costs.get(nme, 1.0) for nme in names]
+    n = len(seq)
+    idx = {nme: i for i, nme in enumerate(names)}
+    forbidden: Set[int] = set()
+    for lrn, conv in getattr(net, "fused_bias_lrn", {}).items():
+        if lrn in idx and conv in idx:
+            lo, hi = sorted((idx[conv], idx[lrn]))
+            forbidden.update(range(lo + 1, hi + 1))
+    allowed = [i for i in range(1, n) if i not in forbidden]
+    num_stages = max(1, min(num_stages, len(allowed) + 1))
+    total = sum(seq)
     cum = []
     acc = 0.0
-    for _, c in costs:
+    for c in seq:
         acc += c
         cum.append(acc)
     cuts: List[int] = []
     prev = 0
     for s in range(1, num_stages):
         ideal = total * s / num_stages
-        i = prev + 1
-        while i < n - (num_stages - s) and cum[i - 1] < ideal:
-            i += 1
-        cuts.append(i)
-        prev = i
+        # candidates: allowed cuts past the previous one, keeping
+        # enough allowed cuts after this pick for the remaining stages
+        cands = [i for i in allowed if i > prev]
+        keep = len(cands) - (num_stages - s - 1)
+        cands = cands[:keep] if keep > 0 else cands[:1]
+        # closest-to-ideal of {last below, first at-or-above}: the
+        # first-≥-ideal rule alone can overshoot badly when one heavy
+        # layer straddles the boundary
+        pick = cands[-1]
+        for j, i in enumerate(cands):
+            if cum[i - 1] >= ideal:
+                pick = i
+                if j > 0 and (ideal - cum[cands[j - 1] - 1]
+                              < cum[i - 1] - ideal):
+                    pick = cands[j - 1]
+                break
+        cuts.append(pick)
+        prev = pick
     bounds = [0] + cuts + [n]
-    return [[costs[i][0] for i in range(bounds[s], bounds[s + 1])]
+    return [[names[i] for i in range(bounds[s], bounds[s + 1])]
             for s in range(num_stages)]
+
+
+def stage_blob_routing(net: Net, stages: Sequence[Sequence[str]], *,
+                       extra_outputs: Sequence[str] = ()
+                       ) -> Tuple[List[Set[str]], List[Set[str]]]:
+    """Per-stage boundary blobs: (stage_in, stage_out) — for each stage
+    the blobs it consumes from upstream (or net inputs) and the blobs
+    it must export downstream.  In-place layers (relu on its own
+    bottom) re-produce a blob, so producers are resolved BEFORE a
+    stage's own tops are recorded — otherwise the in-place version
+    would mask the true upstream stage.  Loss blobs and
+    `extra_outputs` (a serving request's fetch list) exit whichever
+    stage finally produces them."""
+    by_name = {lp.name: lp for lp in net.compute_layers}
+    input_names = set(net.input_names())
+    produced_by: Dict[str, int] = {b: -1 for b in input_names}
+    stage_in: List[Set[str]] = []
+    stage_out: List[Set[str]] = [set() for _ in stages]
+    for s, names in enumerate(stages):
+        ins: Set[str] = set()
+        within: Set[str] = set()
+        for nme in names:
+            for b in by_name[nme].bottom:
+                if b not in within:
+                    ins.add(b)
+            for t in by_name[nme].top:
+                within.add(t)
+        for b in ins:
+            src = produced_by.get(b)
+            if src is not None and 0 <= src < s:
+                stage_out[src].add(b)
+        for nme in names:
+            for t in by_name[nme].top:
+                produced_by[t] = s
+        stage_in.append(ins)
+    for b in list(net.loss_weights) + list(extra_outputs):
+        src = produced_by.get(b, -1)
+        if src >= 0:
+            stage_out[src].add(b)
+    return stage_in, stage_out
 
 
 def schedule_1f1b(num_stages: int, num_microbatches: int
@@ -285,37 +358,10 @@ class PipelineSolver:
             for nme in names:
                 self.stage_of_layer[nme] = i
 
-        # --- blob routing: per stage, which blobs come in / go out ------
-        by_name = {lp.name: lp for lp in net.compute_layers}
-        input_names = set(net.input_names())
-        produced_by: Dict[str, int] = {b: -1 for b in input_names}
-        self.stage_in: List[Set[str]] = []
-        self.stage_out: List[Set[str]] = [set() for _ in self.stages]
-        for s, names in enumerate(self.stages):
-            ins: Set[str] = set()
-            within: Set[str] = set()
-            for nme in names:
-                for b in by_name[nme].bottom:
-                    if b not in within:
-                        ins.add(b)
-                for t in by_name[nme].top:
-                    within.add(t)
-            # resolve producers BEFORE recording this stage's tops —
-            # in-place layers (relu on its own bottom) re-produce a blob
-            # and would otherwise mask the true upstream stage
-            for b in ins:
-                src = produced_by.get(b)
-                if src is not None and 0 <= src < s:
-                    self.stage_out[src].add(b)
-            for nme in names:
-                for t in by_name[nme].top:
-                    produced_by[t] = s
-            self.stage_in.append(ins)
-        # loss blobs exit whichever stage finally produces them
-        for b, w in net.loss_weights.items():
-            src = produced_by.get(b, -1)
-            if src >= 0:
-                self.stage_out[src].add(b)
+        # blob routing: per stage, which blobs come in / go out (shared
+        # with the serving StagedForward via stage_blob_routing)
+        self.stage_in, self.stage_out = stage_blob_routing(
+            net, self.stages)
 
         self._stage_fns = None
         self._update_fns = None
@@ -362,33 +408,19 @@ class PipelineSolver:
         if self._stage_fns is not None:
             return self._stage_fns
         net = self.net
-        by_name = {lp.name: lp for lp in net.compute_layers}
         fns = []
         for s, names in enumerate(self.stages):
             def stage_fn(sparams, acts, rng, *, _names=tuple(names),
                          _out=tuple(sorted(self.stage_out[s]))):
-                blobs = dict(acts)
-                # thread the net's ReLU→LRN fusion set: a bare Ctx
-                # would silently drop the fused relu from pipeline
-                # training (the LRN op keys fuse_relu off this set)
-                ctx = L.Ctx(train=True, rng=rng,
-                            fused_relu_lrn=net.fused_relu_lrn)
-                for nme in _names:
-                    lp = by_name[nme]
-                    op = L.get_op(lp.type)
-                    ctx.layer_name = nme
-                    lparams = []
-                    if nme in net.param_layout:
-                        pd = sparams[nme]
-                        lparams = [pd[bn] for bn, _, _ in
-                                   net.param_layout[nme]]
-                    tops = op.apply(ctx, lp, lparams,
-                                    [blobs[b] for b in lp.bottom])
-                    for t, v in zip(lp.top, tops):
-                        blobs[t] = v
+                # net.apply(layers=...) is the stage body: it threads
+                # the full layer context (ReLU→LRN fusion, deferred
+                # bias, autotune variants and per-layer dtype casts) a
+                # hand-rolled Ctx loop used to drop silently
+                blobs, state_out = net.apply(sparams, acts, train=True,
+                                             rng=rng, layers=_names)
                 # fwd_state: BatchNorm running-stat updates for this
                 # stage's layers (merged into params by train_step)
-                return ({b: blobs[b] for b in _out}, ctx.state_out)
+                return ({b: blobs[b] for b in _out}, state_out)
 
             fns.append(jax.jit(stage_fn))
         self._stage_fns = fns

@@ -182,6 +182,16 @@ class _Handler(JsonHandler):
                 out["models"] = {
                     "resident": reg.resident_models(),
                     "paged_out": reg.paged_out_models()}
+                # pipeline-staged models: per-stage residency bitmap
+                # (model_stats reads under the table lock — no
+                # page-in, no LRU touch)
+                stages = {
+                    n: [1 if s["resident"] else 0
+                        for s in st["stages"]]
+                    for n, st in reg.model_stats().items()
+                    if "stages" in st}
+                if stages:
+                    out["models"]["stages_resident"] = stages
             # replica topology rides along so the router / operators
             # see sharded replicas without a /metrics round-trip
             mesh = getattr(svc, "mesh_info", lambda: None)()

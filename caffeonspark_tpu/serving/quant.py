@@ -129,11 +129,17 @@ def quant_spec(net, weight_dtype: str) -> Dict[str, Dict[str, str]]:
     return out
 
 
-def spec_nbytes(net, spec: Dict[str, Dict[str, str]]) -> int:
+def spec_nbytes(net, spec: Dict[str, Dict[str, str]], *,
+                layers=None) -> int:
     """Logical resident bytes of one model version under `spec`
-    (storage dtype per blob; scales are noise and ignored)."""
+    (storage dtype per blob; scales are noise and ignored).  `layers`
+    restricts the count to a pipeline stage's layer subset — the unit
+    the stage-granular LRU accounts in."""
     total = 0
+    keep = None if layers is None else set(layers)
     for lname, specs in net.param_layout.items():
+        if keep is not None and lname not in keep:
+            continue
         for bname, shape, _ in specs:
             kind = spec.get(lname, {}).get(bname, F32)
             itemsize = 1 if kind in (INT8, INT8_IP) else \
@@ -212,13 +218,18 @@ def _to_bf16(a: np.ndarray) -> np.ndarray:
 
 
 def build_host_cache(net, params,
-                     spec: Dict[str, Dict[str, str]]) -> HostCache:
+                     spec: Dict[str, Dict[str, str]], *,
+                     layers=None) -> HostCache:
     """Device params → compressed host cache (the paging source).
     Works shard by shard; for an unpartitioned blob the 'shard' is the
     whole array (one key), so dense and mesh layouts share one code
-    path and one cache format."""
+    path and one cache format.  `layers` caches only a pipeline
+    stage's subset (the stage-granular page-in unit)."""
     cache: HostCache = {}
+    keep = None if layers is None else set(layers)
     for lname, specs in net.param_layout.items():
+        if keep is not None and lname not in keep:
+            continue
         blobs = params[lname]
         entry: Dict[str, HostBlob] = {}
         for bname, shape, _ in specs:
@@ -242,19 +253,23 @@ def cache_nbytes(cache: HostCache) -> int:
                for hb in bl.values())
 
 
-def place_from_cache(cache: HostCache,
+def place_from_cache(cache: HostCache, *, layers=None
                      ) -> Tuple[dict, Dict[str, dict]]:
     """Page a cached model into device memory: every blob streams
     shard-by-shard to the placement it was captured from
     (`jax.make_array_from_callback` hands each device its own host
     buffer — a view, no assembly, no gather).  Returns (params,
     scales): params in STORAGE dtype (int8/bf16/f32), scales as f32
-    device scalars for the int8 blobs."""
+    device scalars for the int8 blobs.  `layers` pages in only a
+    pipeline stage's subset."""
     import jax
     import jax.numpy as jnp
     params: dict = {}
     scales: Dict[str, dict] = {}
+    keep = None if layers is None else set(layers)
     for lname, bl in cache.items():
+        if keep is not None and lname not in keep:
+            continue
         pb: dict = {}
         for bname, hb in bl.items():
             if hb.sharding is not None:

@@ -32,6 +32,17 @@ Memory management (the multi-model tentpole):
     idiom — never a dense host gather, never a file re-read).
     Programs are cached per net digest and are params-agnostic, so
     page-in never compiles (RecompileGuard-verifiable).
+  * **Stage-granular residency** (pp>1 layouts): the paging unit is
+    the PIPELINE STAGE, not the model.  Each stage carries its own
+    byte account, LRU clock, and page lock; eviction sheds cold
+    stages of a model whose hot stages keep serving, and a cold
+    staged `load` installs a params=None version, pages stage 0
+    synchronously, then streams the tail from a background pager —
+    the model starts answering while later stages are still paging.
+    A flush that needs a not-yet-resident stage pins its version via
+    `staged_view`'s waiter; a publish superseding the pin raises
+    StaleVersionError and the flush re-runs whole against the new
+    version, so `never mixed` survives concurrent stage paging.
 
 The registry is constructible without a training run: it builds the
 TEST-phase net directly from the NetParameter (no Solver, no feed
@@ -54,12 +65,26 @@ from ..metrics import PipelineMetrics
 from ..obs.recorder import record as record_event
 from ..net import Net, Params
 from ..proto import NetParameter, NetState, Phase, SolverParameter
+from ..tools.chaos import make_injector
 from . import quant
 from .forward import BlobForward, build_serving_layout
 
 _LOG = logging.getLogger(__name__)
 
 DEFAULT_MODEL = "default"
+
+# bounded retry for a stage page-in interrupted by a storage fault
+# (COS_FAULT_FLAKY_STORAGE) — the stage is merged only after a fully
+# successful stream, so a mid-stream fault can never serve a
+# half-paged stage
+STAGE_STREAM_RETRIES = 6
+
+
+class StaleVersionError(RuntimeError):
+    """A stage waiter outlived its pinned model version (a publish
+    superseded it mid-flush).  The service catches this and re-runs
+    the flush against the new version — never-mixed is preserved
+    because no output of the stale version was ever returned."""
 
 
 def build_serving_net(net_param: NetParameter,
@@ -102,10 +127,37 @@ class ModelVersion(NamedTuple):
     nbytes: int = 0
 
 
+class _StageState:
+    """Residency bookkeeping for ONE pipeline stage of one model —
+    the registry's paging unit.  Unstaged models have exactly one
+    (the whole net), which reduces every code path to the pre-pp
+    behavior."""
+
+    __slots__ = ("nbytes", "resident", "loading", "last_used",
+                 "page_ins", "evictions", "lock")
+
+    def __init__(self, nbytes: int):
+        self.nbytes = nbytes
+        self.resident = False
+        # True while a page-in is in flight: the bytes are claimed in
+        # the budget (two concurrent page-ins must not each pass the
+        # check alone and jointly overshoot) but the stage is not yet
+        # servable and not yet evictable
+        self.loading = False
+        self.last_used = 0          # LRU clock tick
+        self.page_ins = 0
+        self.evictions = 0
+        # serializes the (device-side) page-in per stage so two
+        # concurrent requests for the same cold stage place it once;
+        # NEVER held while the table lock is wanted by eviction math
+        self.lock = threading.Lock()
+
+
 class _ModelEntry:
     """Registry-internal state for one named model."""
 
-    def __init__(self, name: str, net: Net, layout=None):
+    def __init__(self, name: str, net: Net, layout=None,
+                 weight_dtype: str = "f32"):
         self.name = name
         self.net = net
         self.layout = layout
@@ -118,10 +170,32 @@ class _ModelEntry:
         self.evictions = 0
         self.page_ins = 0
         self.quant_fallback: Optional[str] = None
-        # serializes the (device-side) page-in per model so two
-        # concurrent requests for the same cold model place it once;
-        # NEVER held while the table lock is wanted by eviction math
-        self.page_lock = threading.Lock()
+        # stage table: a pp>1 layout's partition, else one stage
+        # spanning the whole net.  quant_spec is structure-only, so
+        # per-stage byte accounting is exact before any load.
+        self.quant_spec = (quant.quant_spec(net, weight_dtype)
+                           if weight_dtype != "f32" else {})
+        if layout is not None and getattr(layout, "pp", 1) > 1:
+            self.stages: List[List[str]] = [list(s)
+                                            for s in layout.stages]
+        else:
+            self.stages = [[lp.name for lp in net.compute_layers]]
+        self.stage_state = [
+            _StageState(quant.spec_nbytes(net, self.quant_spec,
+                                          layers=s))
+            for s in self.stages]
+        self.pager: Optional[threading.Thread] = None
+        # entry-level page serialization (the pre-pp surface; staged
+        # paging serializes per stage via _StageState.lock)
+        self.page_lock = self.stage_state[0].lock
+
+    @property
+    def staged(self) -> bool:
+        return len(self.stage_state) > 1
+
+    def stage_param_layers(self, k: int) -> List[str]:
+        return [ln for ln in self.stages[k]
+                if ln in self.net.param_layout]
 
 
 class ModelRegistry:
@@ -153,7 +227,11 @@ class ModelRegistry:
         self.quant_tol = quant.serve_quant_tol()
         self._quant_check = os.environ.get(
             "COS_SERVE_QUANT_CHECK", "1") != "0"
-        default = _ModelEntry(DEFAULT_MODEL, net, layout)
+        # fault plan resolved once (COS003): stage page-in streams go
+        # through the flaky-storage injector like every other reader
+        self._chaos = make_injector()
+        default = _ModelEntry(DEFAULT_MODEL, net, layout,
+                              weight_dtype=self.weight_dtype)
         self._entries[DEFAULT_MODEL] = default
         # single-model compatibility surface (the pre-plural API)
         self.net = net
@@ -196,7 +274,8 @@ class ModelRegistry:
         with self._lock:
             if name in self._entries:
                 raise ValueError(f"model {name!r} already registered")
-            e = _ModelEntry(name, net, layout)
+            e = _ModelEntry(name, net, layout,
+                            weight_dtype=self.weight_dtype)
             self._entries[name] = e
         return e
 
@@ -223,6 +302,12 @@ class ModelRegistry:
         with self._lock:
             return name in self._entries
 
+    def is_staged(self, model: Optional[str] = None) -> bool:
+        """True when `model` serves as pipeline stages (pp>1 layout):
+        its residency, paging, and flush snapshotting are per stage
+        (`staged_view`), not whole-model."""
+        return self._entry(model).staged
+
     # -- publish / load -------------------------------------------------
     def load(self, model_path: str,
              model: Optional[str] = None) -> ModelVersion:
@@ -237,6 +322,10 @@ class ModelRegistry:
         publish-time quantization, and drift gate are all skipped
         (they ran when the sidecar was written)."""
         entry = self._entry(model)
+        if entry.staged:
+            # pipeline-staged model: page-in is per stage — the first
+            # resident stages start answering while the tail streams
+            return self._load_staged(entry, model_path)
         if self.weight_dtype != "f32" and entry.layout is None:
             sidecar = model_path + checkpoint.QUANT_SIDECAR_SUFFIX
             if os.path.exists(sidecar):
@@ -268,8 +357,16 @@ class ModelRegistry:
         if spec:
             cache = quant.build_host_cache(entry.net, params, spec)
             qparams, scales = quant.place_from_cache(cache)
+            # the drift gate runs the whole-model forward; a staged
+            # entry's programs are per stage and the gate would force
+            # an extra full compile — the quant path itself is gated
+            # by the unstaged tests, so skip it here with a log line
+            if entry.staged and self._quant_check:
+                _LOG.debug("model %s: staged — skipping publish-time "
+                           "drift gate", entry.name)
             drift = (self._drift(entry, params, qparams, scales, wd)
-                     if self._quant_check else None)
+                     if self._quant_check and not entry.staged
+                     else None)
             if drift is not None and drift > self.quant_tol:
                 _LOG.warning(
                     "model %s: %s residency drifts %.4f > tol %.4f "
@@ -301,8 +398,15 @@ class ModelRegistry:
             self._make_room_locked(entry, nbytes)
             entry.current = mv
             entry.host_cache = cache
+            self._mark_stages_resident_locked(entry, mv, spec)
             entry.resident = True
-            self._touch_locked(entry)
+            if entry.staged:
+                # a publish installs every stage at once; trim the
+                # tail back under the budget (stage 0 is protected so
+                # the model can always start answering)
+                self._make_room_locked(entry, 0, keep_stage=0)
+                entry.resident = all(st.resident
+                                     for st in entry.stage_state)
             self._gauge_resident_locked()
         _LOG.info("model registry: %s version %d <- %s (%s, %.1f MB "
                   "resident)", entry.name, mv.version, path, wd,
@@ -343,8 +447,8 @@ class ModelRegistry:
             self._make_room_locked(entry, nbytes)
             entry.current = mv
             entry.host_cache = cache if self.hbm_budget_bytes else None
+            self._mark_stages_resident_locked(entry, mv, spec)
             entry.resident = True
-            self._touch_locked(entry)
             self._gauge_resident_locked()
         _LOG.info("model registry: %s version %d <- %s (quant "
                   "sidecar, %s)", entry.name, mv.version, sidecar, wd)
@@ -389,115 +493,417 @@ class ModelRegistry:
                         float(np.max(np.abs(g - r))) / denom)
         return worst
 
-    # -- LRU paging -----------------------------------------------------
+    # -- LRU paging (stage-granular) ------------------------------------
     def _touch_locked(self, entry: _ModelEntry) -> None:
         self._clock += 1
         entry.last_used = self._clock
 
+    def _touch_stage_locked(self, entry: _ModelEntry, k: int) -> None:
+        self._clock += 1
+        entry.last_used = self._clock
+        entry.stage_state[k].last_used = self._clock
+
+    def _mark_stages_resident_locked(self, entry: _ModelEntry,
+                                     mv: ModelVersion, spec) -> None:
+        """A publish installed every stage's params at once: account
+        them all resident.  Stages are touched LAST-first so the LRU
+        sheds the tail before the head — stage 0 is what lets a model
+        start answering, so it is the most valuable byte-for-byte."""
+        if entry.staged:
+            for k in reversed(range(len(entry.stage_state))):
+                st = entry.stage_state[k]
+                st.nbytes = quant.spec_nbytes(entry.net, spec,
+                                              layers=entry.stages[k])
+                st.resident = True
+                self._touch_stage_locked(entry, k)
+        else:
+            st = entry.stage_state[0]
+            st.nbytes = mv.nbytes
+            st.resident = True
+            self._touch_stage_locked(entry, 0)
+
     def _resident_bytes_locked(self) -> int:
-        return sum(e.current.nbytes for e in self._entries.values()
-                   if e.resident and e.current is not None)
+        return sum(st.nbytes for e in self._entries.values()
+                   for st in e.stage_state
+                   if st.resident or st.loading)
 
     def _gauge_resident_locked(self) -> None:
         if self.metrics is not None:
             self.metrics.gauge("resident_bytes",
                                self._resident_bytes_locked())
 
-    def _make_room_locked(self, keep: _ModelEntry, need: int) -> None:
-        """Evict least-recently-used models (never `keep`) until
-        `need` more bytes fit the budget.  Eviction only drops the
+    def _stage_cached_locked(self, e: _ModelEntry, k: int) -> bool:
+        """Can stage k of `e` page back in without a file re-read?"""
+        if e.host_cache is None:
+            return False
+        return all(ln in e.host_cache for ln in e.stage_param_layers(k))
+
+    def _make_room_locked(self, keep: _ModelEntry, need: int,
+                          keep_stage: Optional[int] = None) -> None:
+        """Evict least-recently-used STAGES until `need` more bytes
+        fit the budget.  The residency unit is the (model, stage)
+        pair: an unstaged model is one whole-net stage (the pre-pp
+        behavior, byte for byte), a staged model sheds cold stages
+        individually while its hot ones keep serving.  `keep` is
+        protected entirely when `keep_stage` is None (a publish
+        installing the whole model); with `keep_stage=k` only that
+        stage is protected, so a fits-one-stage budget pages one
+        stage in by paging a sibling out.  Eviction only drops the
         REGISTRY's device references — a flush that captured the
         version keeps its arrays alive until it completes, so answers
         in flight stay correct; HBM frees when the last holder lets
-        go.  A model with no host cache cannot be evicted (nothing to
-        page back from)."""
+        go.  A stage with no host-cache coverage cannot be evicted
+        for an UNSTAGED model (nothing to page back from); staged
+        models re-stream from the checkpoint file."""
         budget = self.hbm_budget_bytes
         if not budget:
             return
         while self._resident_bytes_locked() + need > budget:
-            victims = [e for e in self._entries.values()
-                       if e.resident and e is not keep
-                       and e.host_cache is not None]
+            victims = []
+            for e in self._entries.values():
+                for k, st in enumerate(e.stage_state):
+                    if not st.resident or st.loading \
+                            or st.nbytes <= 0:
+                        continue
+                    if e is keep and (keep_stage is None
+                                      or k == keep_stage):
+                        continue
+                    if not e.staged and \
+                            not self._stage_cached_locked(e, k):
+                        continue
+                    victims.append((e, k, st))
             if not victims:
-                if self._resident_bytes_locked() + need > budget:
-                    _LOG.warning(
-                        "HBM budget %.1f MB cannot hold %s "
-                        "(%.1f MB) even after evicting every other "
-                        "model — serving it anyway over budget",
-                        budget / 2**20, keep.name, need / 2**20)
+                _LOG.warning(
+                    "HBM budget %.1f MB cannot hold %s "
+                    "(%.1f MB) even after evicting every other "
+                    "model — serving it anyway over budget",
+                    budget / 2**20, keep.name, need / 2**20)
                 return
-            victim = min(victims, key=lambda e: e.last_used)
-            self._evict_locked(victim)
+            e, k, _ = min(victims, key=lambda v: v[2].last_used)
+            self._evict_stage_locked(e, k)
 
-    def _evict_locked(self, victim: _ModelEntry) -> None:
-        assert victim.current is not None
-        _LOG.info("model registry: paging OUT %s (%.1f MB, LRU)",
-                  victim.name, victim.current.nbytes / 2**20)
-        record_event("registry", "evicted", model=victim.name,
-                     mb=round(victim.current.nbytes / 2**20, 3))
-        victim.current = victim.current._replace(params=None,
-                                                 scales=None)
-        victim.resident = False
-        victim.evictions += 1
+    def _evict_stage_locked(self, e: _ModelEntry, k: int) -> None:
+        st = e.stage_state[k]
+        mv = e.current
+        assert mv is not None
+        if e.staged:
+            _LOG.info("model registry: paging OUT %s stage %d "
+                      "(%.1f MB, LRU)", e.name, k, st.nbytes / 2**20)
+            drop = set(e.stage_param_layers(k))
+            params = ({ln: bl for ln, bl in (mv.params or {}).items()
+                       if ln not in drop} or None)
+            scales = ({ln: bl for ln, bl in (mv.scales or {}).items()
+                       if ln not in drop} or None)
+        else:
+            _LOG.info("model registry: paging OUT %s (%.1f MB, LRU)",
+                      e.name, st.nbytes / 2**20)
+            params, scales = None, None
+        record_event("registry", "evicted", model=e.name,
+                     mb=round(st.nbytes / 2**20, 3), stage=k)
+        e.current = mv._replace(params=params, scales=scales)
+        st.resident = False
+        st.evictions += 1
+        e.evictions += 1
+        e.resident = False
         if self.metrics is not None:
             self.metrics.incr("evictions")
-            self.metrics.incr(f"evictions_{victim.name}")
+            self.metrics.incr(f"evictions_{e.name}")
 
-    def _ensure_resident(self, entry: _ModelEntry) -> ModelVersion:
-        """Return a RESIDENT version tuple for `entry`, paging it in
-        from the compressed host cache if it was evicted.  The
-        returned tuple is captured under the table lock, so even an
-        eviction racing in right after cannot hand a caller
-        params=None — the capture keeps the device arrays alive."""
+    def _ensure_stage(self, entry: _ModelEntry, k: int,
+                      pin: Optional[int] = None) -> ModelVersion:
+        """Make stage k resident and return the version tuple that
+        holds it.  With `pin` set the caller has snapshotted a
+        version for a flush: a publish superseding it mid-page-in
+        raises StaleVersionError (the flush re-runs against the new
+        version — never-mixed is preserved because nothing of the
+        stale version was returned).  Unpinned callers just want
+        \"the current version's stage k\" and retry transparently."""
+        while True:
+            try:
+                return self._ensure_stage_once(entry, k, pin)
+            except StaleVersionError:
+                if pin is not None:
+                    raise
+
+    def _ensure_stage_once(self, entry: _ModelEntry, k: int,
+                           pin: Optional[int]) -> ModelVersion:
+        st = entry.stage_state[k]
         with self._lock:
             mv = entry.current
             if mv is None:
                 raise RuntimeError(
                     f"model registry: {entry.name!r} is empty — load "
                     "a snapshot (-model/-weights) before serving")
-            if entry.resident:
-                self._touch_locked(entry)
+            if pin is not None and mv.version != pin:
+                raise StaleVersionError(
+                    f"model {entry.name}: version {pin} superseded "
+                    f"by {mv.version}")
+            if st.resident:
+                self._touch_stage_locked(entry, k)
                 return mv
         # page-in: device work OUTSIDE the table lock (COS005 — the
         # lock must never be held over a blocking device transfer);
-        # the per-entry lock collapses concurrent cold requests for
-        # the same model into one placement
-        with entry.page_lock:
+        # the per-stage lock collapses concurrent cold requests for
+        # the same stage into one placement while OTHER stages page
+        # concurrently
+        with st.lock:
             with self._lock:
-                if entry.resident and entry.current is not None:
-                    self._touch_locked(entry)
-                    return entry.current
+                mv = entry.current
+                if mv is None or (pin is not None
+                                  and mv.version != pin):
+                    raise StaleVersionError(
+                        f"model {entry.name}: version superseded "
+                        f"while waiting on stage {k}")
+                if st.resident:
+                    self._touch_stage_locked(entry, k)
+                    return mv
+                version, path = mv.version, mv.path
+                self._make_room_locked(entry, st.nbytes,
+                                       keep_stage=k)
+                # claim the bytes while the placement is in flight:
+                # a CONCURRENT page-in of a sibling stage must see
+                # them in the budget, or each passes the check alone
+                # and together they overshoot
+                st.loading = True
                 cache = entry.host_cache
-                need = entry.current.nbytes
-                self._make_room_locked(entry, need)
-            if cache is None:
-                raise RuntimeError(
-                    f"model {entry.name!r} was evicted with no host "
-                    "cache — cannot page back in")
-            t0 = time.monotonic()
-            params, scales = quant.place_from_cache(cache)
-            import jax
-            jax.block_until_ready(
-                [a for bl in params.values() for a in bl.values()])
-            wall = time.monotonic() - t0
+            try:
+                layers = entry.stage_param_layers(k)
+                t0 = time.monotonic()
+                cache_sub: Optional[quant.HostCache] = None
+                if cache is not None and all(ln in cache
+                                             for ln in layers):
+                    params_sub, scales_sub = quant.place_from_cache(
+                        cache, layers=layers)
+                elif not entry.staged:
+                    raise RuntimeError(
+                        f"model {entry.name!r} was evicted with no "
+                        "host cache — cannot page back in")
+                else:
+                    params_sub, scales_sub, cache_sub = \
+                        self._stream_stage(entry, k, path, layers)
+                import jax
+                jax.block_until_ready(
+                    [a for bl in params_sub.values()
+                     for a in bl.values()])
+                wall = time.monotonic() - t0
+            except BaseException:
+                with self._lock:
+                    st.loading = False
+                raise
             with self._lock:
-                mv = entry.current._replace(
-                    params=params, scales=scales or None)
+                st.loading = False
+                mv = entry.current
+                if mv is None or mv.version != version:
+                    # a publish won the race: the freshly placed
+                    # arrays are dropped, nothing of the stale
+                    # version is ever merged or served
+                    raise StaleVersionError(
+                        f"model {entry.name}: version {version} "
+                        f"superseded during stage {k} page-in")
+                merged_p = dict(mv.params or {})
+                merged_p.update(params_sub)
+                merged_s = dict(mv.scales or {})
+                merged_s.update(scales_sub or {})
+                mv = mv._replace(params=merged_p,
+                                 scales=merged_s or None)
                 entry.current = mv
-                entry.resident = True
+                st.resident = True
+                st.page_ins += 1
                 entry.page_ins += 1
-                self._touch_locked(entry)
+                if cache_sub:
+                    hc = dict(entry.host_cache or {})
+                    hc.update(cache_sub)
+                    entry.host_cache = hc
+                entry.resident = all(s.resident
+                                     for s in entry.stage_state)
+                self._touch_stage_locked(entry, k)
+                # re-enforce the budget AFTER the merge: a sibling
+                # page-in that raced this one may have pushed the
+                # resident set over (each reserved alone under the
+                # warn-and-serve rule); trimming here restores the
+                # invariant once the in-flight placements land
+                self._make_room_locked(entry, 0, keep_stage=k)
+                entry.resident = all(s.resident
+                                     for s in entry.stage_state)
                 self._gauge_resident_locked()
             if self.metrics is not None:
                 self.metrics.add("page_in", wall)
                 self.metrics.add(f"page_in_{entry.name}", wall)
-            _LOG.info("model registry: paged IN %s (%.1f MB, "
-                      "%.1f ms)", entry.name, mv.nbytes / 2**20,
-                      wall * 1e3)
+            if entry.staged:
+                _LOG.info("model registry: paged IN %s stage %d "
+                          "(%.1f MB, %.1f ms)", entry.name, k,
+                          st.nbytes / 2**20, wall * 1e3)
+                mb = st.nbytes
+            else:
+                _LOG.info("model registry: paged IN %s (%.1f MB, "
+                          "%.1f ms)", entry.name, mv.nbytes / 2**20,
+                          wall * 1e3)
+                mb = mv.nbytes
             record_event("registry", "paged_in", model=entry.name,
-                         mb=round(mv.nbytes / 2**20, 3),
-                         wall_ms=round(wall * 1e3, 1))
+                         mb=round(mb / 2**20, 3),
+                         wall_ms=round(wall * 1e3, 1), stage=k)
             return mv
+
+    def _stream_stage(self, entry: _ModelEntry, k: int, path: str,
+                      layers: List[str]):
+        """Zero-gather stream of ONE stage's blobs from the
+        checkpoint straight to that stage's devices
+        (checkpoint.load_serving_params' blob-subset filter over the
+        PR 9 per-shard placement path).  Storage faults
+        (COS_FAULT_FLAKY_STORAGE) retry the WHOLE stage with backoff:
+        the caller merges only after a fully successful stream, so a
+        mid-stream fault can never publish a half-paged stage."""
+        last: Optional[BaseException] = None
+        for attempt in range(STAGE_STREAM_RETRIES):
+            try:
+                self._chaos.storage_fault()
+                f32 = checkpoint.load_serving_params(
+                    entry.net, path, layout=entry.layout,
+                    layers=layers)
+                # second probe models a fault AFTER bytes moved (the
+                # mid-stream case): the freshly placed arrays are
+                # discarded wholesale and the stream restarts
+                self._chaos.storage_fault()
+                break
+            except OSError as e:
+                last = e
+                record_event("registry", "stage_retry",
+                             model=entry.name, stage=k,
+                             attempt=attempt, error=str(e))
+                _LOG.warning(
+                    "model registry: %s stage %d page-in hit a "
+                    "storage fault (attempt %d/%d): %s", entry.name,
+                    k, attempt + 1, STAGE_STREAM_RETRIES, e)
+                time.sleep(min(0.02 * 2 ** attempt, 0.25))
+        else:
+            raise RuntimeError(
+                f"model {entry.name!r} stage {k}: page-in failed "
+                f"after {STAGE_STREAM_RETRIES} storage-fault "
+                "retries") from last
+        cache_sub: Optional[quant.HostCache] = None
+        if entry.quant_spec or self.hbm_budget_bytes:
+            # keep a host-side compressed copy so the NEXT cycle of
+            # this stage pages in without a file re-read
+            cache_sub = quant.build_host_cache(
+                entry.net, f32, entry.quant_spec, layers=layers)
+            if any(ln in entry.quant_spec for ln in layers):
+                params_sub, scales_sub = quant.place_from_cache(
+                    cache_sub, layers=layers)
+                # the transient f32 placements die here; the stage's
+                # resident bytes are the compressed ones
+                return params_sub, scales_sub, cache_sub
+        return f32, {}, cache_sub
+
+    def _load_staged(self, entry: _ModelEntry,
+                     path: str) -> ModelVersion:
+        """Cold staged load: install a params=None version, page
+        stage 0 SYNCHRONOUSLY, then stream the tail stages from a
+        background pager — the model starts executing its first
+        resident stages while later stages are still paging
+        (requests block per stage via staged_view's waiter)."""
+        wd = self.weight_dtype if entry.quant_spec else "f32"
+        total = quant.spec_nbytes(entry.net, entry.quant_spec)
+        # per-stage byte sizes are known statically from the spec —
+        # set them BEFORE any page-in so the LRU reserves the right
+        # amount for a stage it has never seen (a 0-byte reservation
+        # would let every first page-in land over budget unnoticed)
+        per_stage = [quant.spec_nbytes(entry.net, entry.quant_spec,
+                                       layers=entry.stages[k])
+                     for k in range(len(entry.stage_state))]
+        with self._lock:
+            entry.version += 1
+            version = entry.version
+            entry.current = ModelVersion(version, path, None, None,
+                                         wd, total)
+            entry.host_cache = None
+            entry.resident = False
+            for st, nb in zip(entry.stage_state, per_stage):
+                st.resident = False
+                st.nbytes = nb
+        _LOG.info("model registry: %s version %d <- %s (%s, %d "
+                  "stages, %.1f MB total — staging in)", entry.name,
+                  version, path, wd, len(entry.stage_state),
+                  total / 2**20)
+        record_event("registry", "published", model=entry.name,
+                     version=version, weight_dtype=wd,
+                     mb=round(total / 2**20, 3),
+                     stages=len(entry.stage_state))
+        mv = self._ensure_stage(entry, 0)
+        t = threading.Thread(target=self._page_tail,
+                             args=(entry, version), daemon=True,
+                             name=f"cos-pager-{entry.name}")
+        entry.pager = t
+        t.start()
+        return mv
+
+    def _page_tail(self, entry: _ModelEntry, version: int) -> None:
+        """Background pager: stream stages 1..S-1 of `version` while
+        stage 0 is already serving.  A supersede just stops this
+        pager — the superseding publish owns its own tail."""
+        for k in range(1, len(entry.stage_state)):
+            with self._lock:
+                mv = entry.current
+                if mv is None or mv.version != version:
+                    return
+            try:
+                self._ensure_stage(entry, k, pin=version)
+            except StaleVersionError:
+                return
+            except Exception:   # noqa: BLE001 — pager must not die
+                _LOG.exception(
+                    "model registry: background page-in of %s stage "
+                    "%d failed", entry.name, k)
+                return
+
+    def _ensure_resident(self, entry: _ModelEntry) -> ModelVersion:
+        """Return a version tuple with EVERY stage resident, paging
+        in whatever was evicted.  Unstaged models have one whole-net
+        stage, so this is exactly the pre-pp page-in path.  Staged
+        callers that can overlap compute with paging should prefer
+        staged_view()."""
+        mv: Optional[ModelVersion] = None
+        for k in range(len(entry.stage_state)):
+            mv = self._ensure_stage(entry, k)
+        assert mv is not None
+        return mv
+
+    def staged_view(self, model: Optional[str] = None):
+        """Snapshot for ONE flush of a staged model: (version,
+        stage_wait).  Unstaged models — and staged models with every
+        stage resident — return (resident version, None): the single
+        immutable capture, never mixed, the pre-pp contract.
+        Otherwise the returned version may hold only SOME stages'
+        params and `stage_wait(k)` blocks until stage k of THAT
+        PINNED version is resident, returning its (params, scales)
+        sub-dicts; if a publish supersedes the pinned version
+        mid-flush it raises StaleVersionError and the service
+        re-runs the flush against the new version — no output of
+        the stale version is ever returned."""
+        entry = self._entry(model)
+        if not entry.staged:
+            return self._ensure_resident(entry), None
+        with self._lock:
+            mv = entry.current
+            if mv is None:
+                raise RuntimeError(
+                    f"model registry: {entry.name!r} is empty — load "
+                    "a snapshot (-model/-weights) before serving")
+            if all(st.resident for st in entry.stage_state):
+                for k in range(len(entry.stage_state)):
+                    self._touch_stage_locked(entry, k)
+                return mv, None
+            version = mv.version
+
+        def stage_wait(k: int, _v: int = version):
+            mv2 = self._ensure_stage(entry, k, pin=_v)
+            within = set(entry.stages[k])
+            params = {ln: bl for ln, bl in (mv2.params or {}).items()
+                      if ln in within}
+            scales = {ln: bl for ln, bl in (mv2.scales or {}).items()
+                      if ln in within}
+            return params, scales
+
+        return mv, stage_wait
 
     # -- read side ------------------------------------------------------
     def current(self, model: Optional[str] = None) -> ModelVersion:
@@ -536,14 +942,26 @@ class ModelRegistry:
                 out[n] = {
                     "version": e.version,
                     "resident": e.resident,
-                    "resident_bytes": (mv.nbytes if e.resident
-                                       and mv is not None else 0),
+                    # resident stages' bytes: equals mv.nbytes for a
+                    # fully resident unstaged model, a partial sum
+                    # for a staged model mid-page-in
+                    "resident_bytes": (
+                        sum(st.nbytes for st in e.stage_state
+                            if st.resident) if mv is not None else 0),
                     "weight_dtype": (mv.weight_dtype if mv is not None
                                      else self.weight_dtype),
                     "evictions": e.evictions,
                     "page_ins": e.page_ins,
                     "path": mv.path if mv is not None else None,
                 }
+                if e.staged:
+                    out[n]["stages"] = [
+                        {"stage": k, "layers": len(e.stages[k]),
+                         "resident": st.resident,
+                         "mb": round(st.nbytes / 2**20, 3),
+                         "page_ins": st.page_ins,
+                         "evictions": st.evictions}
+                        for k, st in enumerate(e.stage_state)]
                 if e.quant_fallback:
                     out[n]["quant_fallback"] = e.quant_fallback
             return out

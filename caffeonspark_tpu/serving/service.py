@@ -41,7 +41,8 @@ from .batcher import (FlushLanes, MicroBatcher, PendingResult,
                       QueueFullError, ServingStopped)
 from .retry import RetryPolicy, retry_call
 from .forward import fetch_rows
-from .registry import DEFAULT_MODEL, ModelRegistry
+from .registry import (DEFAULT_MODEL, ModelRegistry,
+                       StaleVersionError)
 
 _LOG = logging.getLogger(__name__)
 
@@ -289,7 +290,14 @@ class InferenceService:
         model when None); True iff all compiled."""
         name = model or DEFAULT_MODEL
         sm = self._models[name]
+        # staged models: current() pages EVERY stage in first (joins
+        # the cold-start tail), so a budget-free warmup measures the
+        # microbatch choice too; under a budget that evicts stages the
+        # fresh staged_view hands back the waiter path instead
         mv = self.registry.current(name)
+        stage_wait = None
+        if self.registry.is_staged(name):
+            mv, stage_wait = self.registry.staged_view(name)
         try:
             c, h, w = sm.source.image_dims()
         except Exception as e:       # noqa: BLE001 — geometry-less
@@ -300,15 +308,17 @@ class InferenceService:
                               np.zeros((c, h, w), np.float32))
         fwd = self.registry.forward_for(name)(
             sm.blob_names, weight_dtype=mv.weight_dtype)
+        kw = ({"stage_wait": stage_wait} if stage_wait is not None
+              else {})
         lane = self.lanes.lane(name)
         for bucket in lane.buckets:
             t0 = time.monotonic()
             batch = sm.source.next_batch([dummy] * bucket)
             batch = sm.source.apply_device_stage(batch)
             if mv.weight_dtype == "f32":
-                out = fwd(mv.params, batch)
+                out = fwd(mv.params, batch, **kw)
             else:
-                out = fwd(mv.params, mv.scales or {}, batch)
+                out = fwd(mv.params, mv.scales or {}, batch, **kw)
             fetch_rows(out, sm.blob_names, ["_warmup"] * bucket,
                        real=1, bs=bucket)
             sm.metrics.add("warmup_compile", time.monotonic() - t0)
@@ -422,7 +432,14 @@ class InferenceService:
         one version (paged in first if the LRU evicted it; the page-in
         stalls only THIS model's lane)."""
         sm = self._models[model]
-        mv = self.registry.current(model)
+        stage_wait = None
+        if self.registry.is_staged(model):
+            # staged snapshot: may hold only SOME stages' params — the
+            # waiter blocks per stage and pins the version, so a cold
+            # model starts answering from its first resident stages
+            mv, stage_wait = self.registry.staged_view(model)
+        else:
+            mv = self.registry.current(model)
         m = sm.metrics
         buf: List[ImageRecord] = list(records)  # coerced at submit()
         ids = [str(r[0]) if r[0] != "" else str(i)
@@ -439,15 +456,31 @@ class InferenceService:
             batch = sm.source.next_batch(buf)
             m.add("pack", time.monotonic() - t0)
             batch = sm.source.apply_device_stage(batch)
-        fwd = self.registry.forward_for(model)(
-            sm.blob_names, weight_dtype=mv.weight_dtype)
         t0 = time.monotonic()
         with self._tracer.span("serve.fwd") as sp:
             sp.set("bucket", bucket).set("model", model)
-            if mv.weight_dtype == "f32":
-                out = fwd(mv.params, batch)
-            else:
-                out = fwd(mv.params, mv.scales or {}, batch)
+            for attempt in (0, 1):
+                fwd = self.registry.forward_for(model)(
+                    sm.blob_names, weight_dtype=mv.weight_dtype)
+                kw = ({"stage_wait": stage_wait}
+                      if stage_wait is not None else {})
+                try:
+                    if mv.weight_dtype == "f32":
+                        out = fwd(mv.params, batch, **kw)
+                    else:
+                        out = fwd(mv.params, mv.scales or {}, batch,
+                                  **kw)
+                    break
+                except StaleVersionError:
+                    # a publish superseded the pinned version while a
+                    # stage waiter blocked; nothing of the stale
+                    # version was returned, so re-running the WHOLE
+                    # flush against the new version preserves
+                    # never-mixed
+                    if attempt:
+                        raise
+                    m.incr("stale_retries")
+                    mv, stage_wait = self.registry.staged_view(model)
             rows = fetch_rows(out, sm.blob_names, ids, real=real,
                               bs=bucket)
         m.add("fwd", time.monotonic() - t0)

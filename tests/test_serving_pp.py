@@ -1,0 +1,455 @@
+"""Pipeline-parallel serving (stage-granular HBM paging): roofline-
+balanced partitions, AOT namespace separation, staged-vs-unstaged
+byte parity, stage-granular eviction under a fits-one-stage budget
+with never-mixed pinned, supersede StaleVersionError, and the
+flaky-storage stage-stream drill.
+
+All mesh cases run on the 8 virtual CPU devices the conftest forces
+(`--xla_force_host_platform_device_count=8`)."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from caffeonspark_tpu import checkpoint
+from caffeonspark_tpu.config import Config
+from caffeonspark_tpu.net import Net
+from caffeonspark_tpu.parallel import MeshLayout, build_mesh
+from caffeonspark_tpu.parallel.pp import layer_costs, partition_layers
+from caffeonspark_tpu.proto import (NetParameter, NetState, Phase,
+                                    SolverParameter)
+from caffeonspark_tpu.serving import Client, InferenceService
+from caffeonspark_tpu.serving import aot
+from caffeonspark_tpu.serving.registry import (ModelRegistry,
+                                               StaleVersionError,
+                                               build_serving_net)
+from caffeonspark_tpu.solver import Solver
+
+NET_TMPL = """
+name: "ppnet"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param {{ source: "{root}/unused_lmdb" batch_size: 8
+    channels: 1 height: 12 width: 12 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param {{ num_output: 4 kernel_size: 3
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "relu" type: "ReLU" bottom: "conv1" top: "conv1" }}
+layer {{ name: "fc_big" type: "InnerProduct" bottom: "conv1"
+  top: "fc_big" inner_product_param {{ num_output: 1024
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "fc_mid" type: "InnerProduct" bottom: "fc_big"
+  top: "fc_mid" inner_product_param {{ num_output: 256
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "fc_mid" top: "ip"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+  bottom: "label" top: "loss" }}
+"""
+
+SOLVER_TMPL = """
+net: "{net}"
+base_lr: 0.01
+momentum: 0.9
+lr_policy: "fixed"
+max_iter: 20
+random_seed: 5
+"""
+
+
+@pytest.fixture(scope="module")
+def pp_model(tmp_path_factory):
+    """Written prototxts + a briefly-trained caffemodel + the TEST
+    net and a second (perturbed) param set for hot-swap cases."""
+    td = tmp_path_factory.mktemp("pp_serving")
+    net_path = td / "net.prototxt"
+    net_path.write_text(NET_TMPL.format(root=td))
+    solver_path = td / "solver.prototxt"
+    solver_path.write_text(SOLVER_TMPL.format(net=net_path))
+    s = Solver(SolverParameter.from_text(
+        SOLVER_TMPL.format(net=net_path)),
+        NetParameter.from_text(NET_TMPL.format(root=td)))
+    params, st = s.init()
+    import jax.numpy as jnp
+    step = s.jit_train_step()
+    rng = np.random.RandomState(7)
+    for i in range(2):
+        batch = {"data": jnp.asarray(
+            rng.rand(8, 1, 12, 12).astype(np.float32) * 255),
+            "label": jnp.asarray(
+                rng.randint(0, 10, 8).astype(np.float32))}
+        params, st, _ = step(params, st, batch, s.step_rng(i))
+    model = str(td / "m.caffemodel")
+    checkpoint.save_caffemodel(model, s.train_net, params)
+    net = build_serving_net(NetParameter.from_text(
+        NET_TMPL.format(root=td)))
+    return {"solver": str(solver_path), "model": model, "net": net,
+            "net_param": NetParameter.from_text(
+                NET_TMPL.format(root=td))}
+
+
+def _feed(bs=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"data": rng.rand(bs, 1, 12, 12).astype(np.float32),
+            "label": np.zeros(bs, np.float32)}
+
+
+def _staged_layout(net, pp, ndev=4):
+    return MeshLayout(net, build_mesh(pp=pp,
+                                      devices=jax.devices()[:ndev]))
+
+
+# ------------------------------------------------- partition balance
+
+@pytest.mark.parametrize("zoo_name,k", [
+    ("lenet", 2), ("lenet", 4),
+    ("caffenet", 2), ("caffenet", 4),
+    ("googlenet", 2), ("googlenet", 4)])
+def test_partition_balanced_by_roofline(zoo_name, k):
+    """partition_layers balances stages by the roofline byte model
+    (analysis/roofline.analyze_net is THE per-layer cost source).
+    The achievable optimum is bounded below by the single heaviest
+    layer (a layer cannot split); the contiguous greedy must land
+    within 1.5x of max(ideal, heaviest layer) on every zoo net
+    (measured worst today: caffenet pp=2 at 1.36x)."""
+    from caffeonspark_tpu import models
+    net = Net(getattr(models, zoo_name)(batch_size=8),
+              NetState(phase=Phase.TEST))
+    costs = layer_costs(net)
+    stages = partition_layers(net, k)
+    assert len(stages) == k
+    assert [ln for st in stages for ln in st] == \
+        [lp.name for lp in net.compute_layers]
+    total = sum(costs.values())
+    ideal = total / k
+    heaviest = max(costs.values())
+    worst = max(sum(costs[ln] for ln in st) for st in stages)
+    assert worst <= 1.5 * max(ideal, heaviest), (
+        f"{zoo_name} pp={k}: worst stage {worst / total:.3f} of "
+        f"total vs bound {max(ideal, heaviest) / total:.3f}")
+
+
+FUSED_STEM_NET = """
+name: "fusednet"
+input: "data" input_dim: 8 input_dim: 1 input_dim: 12 input_dim: 12
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "norm1" type: "LRN" bottom: "conv1" top: "norm1"
+  lrn_param { local_size: 3 } }
+layer { name: "ip" type: "InnerProduct" bottom: "norm1" top: "ip"
+  inner_product_param { num_output: 10 } }
+"""
+
+
+def test_partition_respects_fused_bias_lrn(monkeypatch):
+    """A net whose LRN pulls the producing conv's bias (fused
+    conv->relu->LRN stem, COS_FUSE_BIAS_RELU_LRN=1) must never be
+    cut between the conv and its LRN."""
+    monkeypatch.setenv("COS_FUSE_BIAS_RELU_LRN", "1")
+    net = Net(NetParameter.from_text(FUSED_STEM_NET),
+              NetState(phase=Phase.TEST))
+    assert net.fused_bias_lrn, \
+        "stem should fuse bias+relu+LRN under the env knob"
+    for k in (2, 4, 8):
+        stages = partition_layers(net, k)
+        stage_of = {ln: s for s, st in enumerate(stages)
+                    for ln in st}
+        for lrn, conv in net.fused_bias_lrn.items():
+            assert stage_of[lrn] == stage_of[conv], (
+                f"pp={k} cut between {conv} and fused LRN {lrn}")
+
+
+# ------------------------------------------------- AOT namespaces
+
+def test_aot_namespace_staged_vs_unstaged(pp_model):
+    """Staged and unstaged programs are different executables: the
+    pp axis and stage boundaries ride in MeshLayout.signature(), so
+    no two of {single-device, pp=2, pp=4} share an AOT namespace."""
+    net, np_ = pp_model["net"], pp_model["net_param"]
+    sig2 = _staged_layout(net, 2).signature()
+    sig4 = _staged_layout(net, 4).signature()
+    assert "pp[" in sig2 and "pp[" in sig4 and sig2 != sig4
+    keys = {aot.aot_cache_key(np_, (8,), ("ip",), ms)
+            for ms in (None, sig2, sig4)}
+    assert len(keys) == 3, "pp namespaces collide"
+
+
+# ------------------------------------------------- parity
+
+def test_staged_parity_byte_equal(pp_model, recompile_guard):
+    """pp=2 and pp=4 staged forwards are byte-equal to the
+    single-device forward, and the staged programs never recompile
+    once warm (per-stage jit caches watched by the guard)."""
+    net, model = pp_model["net"], pp_model["model"]
+    reg0 = ModelRegistry(net)
+    mv0 = reg0.load(model)
+    feed = _feed()
+    ref = reg0.forward(("ip",))(mv0.params, feed)
+    for pp, ndev in ((2, 4), (4, 4)):
+        lay = _staged_layout(net, pp, ndev)
+        assert lay.pp == pp
+        reg = ModelRegistry(net, lay)
+        mv = reg.load(model)
+        reg._entry(None).pager.join(30)
+        mv, waiter = reg.staged_view()
+        assert waiter is None, "all stages should be resident"
+        fwd = reg.forward(("ip",))
+        out = fwd(mv.params, feed)
+        assert np.array_equal(np.asarray(out["ip"]),
+                              np.asarray(ref["ip"])), \
+            f"pp={pp} staged output != single-device output"
+        recompile_guard.watch(f"pp{pp}", fwd)
+        recompile_guard.mark_steady()
+        out2 = fwd(mv.params, feed)
+        assert np.array_equal(np.asarray(out2["ip"]),
+                              np.asarray(ref["ip"]))
+        recompile_guard.check()
+
+
+# ------------------------------------------------- stage-granular LRU
+
+def test_eviction_under_fits_one_stage_budget(pp_model,
+                                              recompile_guard):
+    """A budget that fits only the biggest stage still serves: the
+    LRU pages one stage in by paging a sibling out (stage-granular
+    residency), the waiter path answers byte-equal, and page-in
+    never compiles once warm."""
+    net, model = pp_model["net"], pp_model["model"]
+    reg0 = ModelRegistry(net)
+    ref = reg0.forward(("ip",))(reg0.load(model).params, _feed())
+    lay = _staged_layout(net, 2)
+    reg = ModelRegistry(net, lay)
+    reg.load(model)
+    e = reg._entry(None)
+    e.pager.join(30)
+    budget = max(st.nbytes for st in e.stage_state) + 4096
+    assert budget < sum(st.nbytes for st in e.stage_state), \
+        "test net's stages must not both fit the budget"
+
+    reg2 = ModelRegistry(net, lay, hbm_budget_bytes=budget)
+    reg2.load(model)
+    e2 = reg2._entry(None)
+    e2.pager.join(30)
+    assert not all(st.resident for st in e2.stage_state), \
+        "budget should keep at most one stage resident"
+    fwd = reg2.forward(("ip",))
+    # warm both stage programs through one waiter-path flush, then
+    # pin the guard: subsequent page-in cycles must be placement-only
+    mv, w = reg2.staged_view()
+    assert w is not None
+    out = fwd(mv.params, _feed(), stage_wait=w)
+    assert np.array_equal(np.asarray(out["ip"]),
+                          np.asarray(ref["ip"]))
+    recompile_guard.watch("pp-evict", fwd)
+    recompile_guard.mark_steady()
+    evictions_before = e2.evictions
+    for _ in range(4):
+        mv, w = reg2.staged_view()
+        out = fwd(mv.params, _feed(),
+                  **({"stage_wait": w} if w is not None else {}))
+        assert np.array_equal(np.asarray(out["ip"]),
+                              np.asarray(ref["ip"]))
+        recompile_guard.check()
+    assert e2.evictions > evictions_before, \
+        "page-in cycles under a one-stage budget must evict"
+    stats = reg2.model_stats()["default"]
+    assert [s["stage"] for s in stats["stages"]] == [0, 1]
+    assert any(s["evictions"] for s in stats["stages"])
+
+
+def test_never_mixed_under_concurrent_paging(pp_model):
+    """Hot-swap under stage-granular paging: every flush answers
+    from exactly ONE version.  Concurrent publishes + waiter-path
+    flushes under a fits-one-stage budget must yield outputs
+    byte-equal to either pure-v1 or pure-v2 — a mixed-stage output
+    would match neither."""
+    net, model = pp_model["net"], pp_model["model"]
+    reg0 = ModelRegistry(net)
+    mv1 = reg0.load(model)
+    p1 = {ln: dict(bl) for ln, bl in mv1.params.items()}
+    p2 = {ln: {bn: a * 1.5 for bn, a in bl.items()}
+          for ln, bl in p1.items()}
+    feed = _feed()
+    f0 = reg0.forward(("ip",))
+    ref1 = np.asarray(f0(p1, feed)["ip"])
+    ref2 = np.asarray(f0(p2, feed)["ip"])
+    assert not np.array_equal(ref1, ref2)
+
+    lay = _staged_layout(net, 2)
+    probe = ModelRegistry(net, lay)
+    probe.load(model)
+    pe = probe._entry(None)
+    pe.pager.join(30)
+    budget = max(st.nbytes for st in pe.stage_state) + 4096
+    reg = ModelRegistry(net, lay, hbm_budget_bytes=budget)
+    reg.publish(p1)
+    fwd = reg.forward(("ip",))
+    stop = threading.Event()
+    pub_err = []
+
+    def publisher():
+        flip = False
+        while not stop.is_set():
+            try:
+                reg.publish(p2 if flip else p1)
+            except Exception as ex:   # noqa: BLE001
+                pub_err.append(ex)
+                return
+            flip = not flip
+
+    t = threading.Thread(target=publisher, daemon=True)
+    t.start()
+    mixed = []
+    try:
+        for _ in range(12):
+            # the service's retry-once loop in miniature
+            for attempt in (0, 1, 2):
+                mv, w = reg.staged_view()
+                kw = {"stage_wait": w} if w is not None else {}
+                try:
+                    got = np.asarray(fwd(mv.params, feed, **kw)["ip"])
+                    break
+                except StaleVersionError:
+                    if attempt == 2:
+                        raise
+            if not (np.array_equal(got, ref1)
+                    or np.array_equal(got, ref2)):
+                mixed.append(got)
+    finally:
+        stop.set()
+        t.join(30)
+    assert not pub_err, pub_err
+    assert not mixed, "a flush mixed two versions' stages"
+
+
+def test_stale_version_error_on_supersede(pp_model):
+    """A pinned stage waiter must refuse to serve after a publish
+    superseded its version — the flush re-runs whole, never mixed."""
+    net, model = pp_model["net"], pp_model["model"]
+    lay = _staged_layout(net, 2)
+    probe = ModelRegistry(net, lay)
+    probe.load(model)
+    pe = probe._entry(None)
+    pe.pager.join(30)
+    budget = max(st.nbytes for st in pe.stage_state) + 4096
+    reg = ModelRegistry(net, lay, hbm_budget_bytes=budget)
+    reg.load(model)
+    reg._entry(None).pager.join(30)
+    mv, w = reg.staged_view()
+    assert w is not None, "one-stage budget must leave a cold stage"
+    reg.load(model)          # supersede the pinned version
+    with pytest.raises(StaleVersionError):
+        for k in range(2):
+            w(k)
+
+
+# ------------------------------------------------- cold-start overlap
+
+def test_cold_load_serves_before_tail_resident(pp_model):
+    """A cold staged load returns once stage 0 is resident; the tail
+    pages in the background and the waiter path serves correct
+    answers the whole time (first-stages-execute-while-paging)."""
+    net, model = pp_model["net"], pp_model["model"]
+    reg0 = ModelRegistry(net)
+    ref = reg0.forward(("ip",))(reg0.load(model).params, _feed())
+    lay = _staged_layout(net, 4)
+    reg = ModelRegistry(net, lay)
+    mv = reg.load(model)
+    e = reg._entry(None)
+    assert e.stage_state[0].resident, \
+        "load() must return with stage 0 resident"
+    # serve immediately — the waiter blocks per stage as needed
+    mv, w = reg.staged_view()
+    kw = {"stage_wait": w} if w is not None else {}
+    out = reg.forward(("ip",))(mv.params, _feed(), **kw)
+    assert np.array_equal(np.asarray(out["ip"]),
+                          np.asarray(ref["ip"]))
+    e.pager.join(30)
+    assert all(st.resident for st in e.stage_state)
+    from caffeonspark_tpu.obs.recorder import get_recorder
+    ev = [ev for ev in get_recorder().events()
+          if ev["source"] == "registry" and ev["event"] == "paged_in"
+          and ev.get("stage") is not None]
+    assert {e2["stage"] for e2 in ev} >= {0, 1, 2, 3}
+
+
+# ------------------------------------------------- chaos drill
+
+def test_flaky_storage_stage_stream_drill(pp_model, monkeypatch):
+    """COS_FAULT_FLAKY_STORAGE on stage page-in: a fault mid-stream
+    retries the WHOLE stage (merge-after-success — a half-paged
+    stage is never served), client requests see ZERO failures, and
+    the recorder trail carries the stage_retry events."""
+    monkeypatch.setenv("COS_FAULT_FLAKY_STORAGE", "0.3")
+    monkeypatch.setenv("COS_FAULT_SEED", "11")
+    net, model = pp_model["net"], pp_model["model"]
+    reg0 = ModelRegistry(net)
+    ref = reg0.forward(("ip",))(reg0.load(model).params, _feed())
+    lay = _staged_layout(net, 4)
+    reg = ModelRegistry(net, lay)   # injector resolves the knob here
+    assert reg._chaos.plan.flaky_storage > 0
+    reg.load(model)
+    e = reg._entry(None)
+    e.pager.join(60)
+    assert all(st.resident for st in e.stage_state), \
+        "retries must converge to a fully resident model"
+    mv, w = reg.staged_view()
+    kw = {"stage_wait": w} if w is not None else {}
+    out = reg.forward(("ip",))(mv.params, _feed(), **kw)
+    assert np.array_equal(np.asarray(out["ip"]),
+                          np.asarray(ref["ip"])), \
+        "a retried stream must serve byte-identical params"
+    assert reg._chaos.injected["storage_faults"] > 0, \
+        "the drill never injected a fault — raise the probability"
+    from caffeonspark_tpu.obs.recorder import get_recorder
+    retries = [ev for ev in get_recorder().events()
+               if ev["source"] == "registry"
+               and ev["event"] == "stage_retry"]
+    assert retries, "no stage_retry events recorded"
+    assert all("stage" in ev and "attempt" in ev for ev in retries)
+
+
+# ------------------------------------------------- service end-to-end
+
+def test_service_staged_end_to_end(pp_model):
+    """-serveMesh pp=2 through the full service: byte-equal rows vs
+    the single-device service at the same flush shape, stages block
+    in models_summary, and the staged forward under the service's
+    own recompile guard."""
+    solver, model = pp_model["solver"], pp_model["model"]
+
+    def _records(n):
+        return [(f"{i:08d}", float(i % 3), 1, 12, 12, False,
+                 np.random.RandomState(i).rand(1, 12, 12)
+                 .astype(np.float32) * 255.0) for i in range(n)]
+
+    recs = _records(8)
+    svc0 = InferenceService(Config(["-conf", solver,
+                                    "-model", model]),
+                            blob_names=("ip",)).start()
+    try:
+        ref = Client(svc0).predict(recs)
+    finally:
+        svc0.stop()
+    svc = InferenceService(Config(["-conf", solver, "-model", model,
+                                   "-serveMesh", "pp=2",
+                                   "-devices", "4"]),
+                           blob_names=("ip",)).start()
+    try:
+        assert svc.registry.is_staged()
+        got = Client(svc).predict(recs)
+        for a, b in zip(ref, got):
+            assert np.array_equal(np.asarray(a["ip"]),
+                                  np.asarray(b["ip"]))
+        ms = svc.models_summary()["default"]
+        assert [s["stage"] for s in ms["stages"]] == [0, 1]
+        assert all(s["resident"] for s in ms["stages"])
+    finally:
+        svc.stop()
