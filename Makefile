@@ -10,7 +10,8 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   smoke tpu-tests bench-evidence bench-ingest bench-steploop \
   bench-serving bench-serving-sharded bench-serving-multimodel \
   bench-serving-pp \
-  bench-gradsync bench-syncmode bench-autotune bench-deploy \
+  bench-gradsync bench-syncmode bench-scaling bench-autotune \
+  bench-deploy \
   bench-obs bench-tail bench-prodday prodday-smoke chaos \
   chaos-deploy onchip-artifacts docs clean
 
@@ -88,6 +89,17 @@ bench-syncmode:
 	mkdir -p bench_evidence
 	$(CPU_ENV) $(PY) scripts/bench_syncmode.py \
 	  --out bench_evidence/bench_syncmode.json
+
+# multi-host scaling: 4 NodeAgent daemons each spawning 2 ranks of an
+# 8-process cluster (coordinator via agent:// rendezvous), two-tier
+# hier vs flat bucket exchange under the calibrated asymmetric comm
+# floor (gigabit prices time-dilated to this box's base step), with a
+# floor=0 rate-equality control; ALWAYS exits 0 with one JSON document
+# on stdout (bench.py contract)
+bench-scaling:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_scaling.py \
+	  --out bench_evidence/bench_scaling.json
 
 # per-layer autotuner: untuned vs COS_AUTOTUNE plan on the worst-MFU
 # zoo net (googlenet) under the injected HBM-bandwidth floor; the
@@ -226,6 +238,8 @@ bench-evidence:
 	-BENCH_MODEL=resnet50 $(PY) bench.py
 	-$(CPU_ENV) $(PY) scripts/bench_autotune.py \
 	  --out bench_evidence/bench_autotune.json
+	-$(CPU_ENV) $(PY) scripts/bench_scaling.py \
+	  --out bench_evidence/bench_scaling.json
 	-$(CPU_ENV) $(PY) scripts/bench_serving.py --multimodel \
 	  --out bench_evidence/bench_serving_multimodel.json
 	-$(CPU_ENV) $(PY) scripts/bench_serving.py --pp 2 \

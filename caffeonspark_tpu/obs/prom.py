@@ -177,6 +177,10 @@ class PromWriter:
                             st["p99_ms"], ml)
         for rname, st in (summary.get("replicas") or {}).items():
             rl = dict(base, replica=sanitize(rname))
+            # multi-host fleets label every replica sample with the
+            # NodeAgent host carrying it; local fleets stay unlabeled
+            if st.get("host"):
+                rl["host"] = sanitize(st["host"])
             self.sample("replica_up", "gauge",
                         "1 = replica routable (state=ok)",
                         1.0 if st.get("state") == "ok" else 0.0,
@@ -196,6 +200,13 @@ class PromWriter:
                     self.sample(fam, "gauge",
                                 "router-observed replica latency (ms)",
                                 st[k], rl)
+        # NodeAgent heartbeat view (Fleet._agents_once): one gauge per
+        # host so an alert fires the moment an agent stops answering
+        for hname, st in (summary.get("hosts") or {}).items():
+            self.sample("host_up", "gauge",
+                        "1 = NodeAgent heartbeat answering",
+                        1.0 if st.get("up") else 0.0,
+                        dict(base, host=sanitize(hname)))
 
     # -- rendering -----------------------------------------------------
     def render(self) -> str:

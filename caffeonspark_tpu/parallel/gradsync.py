@@ -182,6 +182,30 @@ class GradSyncPlan(NamedTuple):
             return last
         return max(last, total - int(hide_bytes))
 
+    def tier_wire_bytes(self, local_size: int = 1,
+                        hide_bytes: Optional[int] = None
+                        ) -> Tuple[int, int]:
+        """(intra_host, inter_host) modeled exposed wire bytes per
+        step — the two-tier split behind the asymmetric comm floor
+        (COS_FAULT_COMM_INTRA_NS_PER_BYTE, scripts/bench_scaling.py).
+        Flat modes put every exposed byte on the slow inter-host link:
+        (0, exposed).  `hier` is the FireCaffe-style two-tier
+        exchange: the inter-host leg carries the post-reduce-scatter
+        1/local slice (exactly `exposed_wire_bytes`), and the
+        intra-host reduce-scatter + all-gather together move ~2× the
+        exposed single-link bytes over the fast local links — 0 when
+        the host holds a single rank (nothing to reduce locally).
+        With local_size=1 or a zero intra price this reduces to the
+        single-tier model, so the existing floor maths are
+        unchanged."""
+        inter = self.exposed_wire_bytes(local_size=local_size,
+                                        hide_bytes=hide_bytes)
+        if self.mode != "hier" or max(1, int(local_size)) <= 1:
+            return (0, inter)
+        intra = 2 * self.exposed_wire_bytes(local_size=1,
+                                            hide_bytes=hide_bytes)
+        return (intra, inter)
+
     @property
     def n_messages(self) -> int:
         """Wire messages per step (per-message latency floor term)."""

@@ -72,9 +72,18 @@ def distributed_init(coordinator: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None) -> None:
     """Multi-host bootstrap (the address-exchange / rank-assignment
-    analog).  No-op for single-process runs."""
+    analog).  No-op for single-process runs.
+
+    `coordinator` is normally `host:port`; the `agent://host:port`
+    form instead asks the NodeAgent at that address for the rendezvous
+    (GET /v1/coordinator) — the LEAD agent allocates one coordinator
+    port and hands every rank the same answer, so a cross-host job
+    needs no hand-picked port, only the lead agent's address."""
     if coordinator is None:
         return
+    if coordinator.startswith("agent://"):
+        from ..tools.nodeagent import resolve_coordinator
+        coordinator = resolve_coordinator(coordinator)
     # CPU backends need the gloo collectives implementation for real
     # cross-process collectives (the default CPU client rejects
     # "multiprocess computations"): the multihost failure drills and

@@ -78,6 +78,7 @@ loop.
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import time
@@ -118,6 +119,10 @@ class SyncPolicy(NamedTuple):
     round_timeout_s: float
     heartbeat_timeout_s: float
     wire_dtype: str
+    # COS_SYNC_STORE: where the ParamStore lives.  "" = the shared-
+    # filesystem default (<output>/.sync); an http(s):// URL selects
+    # the NodeAgent blob transport (no shared filesystem needed)
+    store: str = ""
 
     @property
     def elastic(self) -> bool:
@@ -146,6 +151,8 @@ class SyncPolicy(NamedTuple):
             out["round_timeout_s"] = self.round_timeout_s
             out["heartbeat_timeout_s"] = self.heartbeat_timeout_s
             out["wire_dtype"] = self.wire_dtype
+            if self.store:
+                out["store"] = self.store
         return out
 
 
@@ -170,7 +177,8 @@ def resolve_policy(mode: Optional[str] = None) -> SyncPolicy:
         round_timeout_s=_env_num("COS_SYNC_ROUND_TIMEOUT_S", 30.0),
         heartbeat_timeout_s=_env_num("COS_SYNC_HEARTBEAT_TIMEOUT_S",
                                      10.0),
-        wire_dtype=wire)
+        wire_dtype=wire,
+        store=os.environ.get("COS_SYNC_STORE", "").strip())
 
 
 # ---------------------------------------------------------------------------
@@ -304,6 +312,14 @@ class ParamStore:
                 return _decode_wire(npz)
         return self._retry(r, f"read {name}")
 
+    # -- transport seams (overridden by HttpParamStore) ----------------
+    def _list_names(self) -> List[str]:
+        """Every object name in the store (the directory listing)."""
+        return os.listdir(self.root)
+
+    def _delete(self, name: str):
+        os.unlink(os.path.join(self.root, name))
+
     # -- heartbeats / membership ---------------------------------------
     def heartbeat(self, it: int, *, done: bool = False,
                   force: bool = False):
@@ -323,7 +339,7 @@ class ParamStore:
         """Every rank ever seen: rank -> {iter, ts, done, live}."""
         now = time.time()
         out: Dict[int, dict] = {}
-        for name in os.listdir(self.root):
+        for name in self._list_names():
             if not (name.startswith("hb_rank")
                     and name.endswith(".json")):
                 continue
@@ -351,7 +367,7 @@ class ParamStore:
     def round_ranks(self, rnd: int) -> List[int]:
         prefix = f"round_{rnd:08d}_rank"
         out = []
-        for name in os.listdir(self.root):
+        for name in self._list_names():
             if name.startswith(prefix) and name.endswith(".npz"):
                 out.append(int(name[len(prefix):-len(".npz")]))
         return sorted(out)
@@ -394,16 +410,16 @@ class ParamStore:
         """Best-effort cleanup: keep the last two globals and the last
         three rounds' contributions (a detached straggler may still be
         reading slightly-old files; anything older is garbage)."""
-        for name in os.listdir(self.root):
+        for name in self._list_names():
             try:
                 if name.startswith("global_v") and name.endswith(".npz"):
                     v = int(name[len("global_v"):-len(".npz")])
                     if v <= version - 2:
-                        os.unlink(os.path.join(self.root, name))
+                        self._delete(name)
                 elif name.startswith("round_"):
                     rnd = int(name[len("round_"):len("round_") + 8])
                     if rnd <= version - 3:
-                        os.unlink(os.path.join(self.root, name))
+                        self._delete(name)
             except (OSError, ValueError):
                 continue
 
@@ -437,6 +453,107 @@ class ParamStore:
     def unlock_global(self):
         try:
             os.unlink(os.path.join(self.root, "global.lock"))
+        except OSError:
+            pass
+
+
+class HttpParamStore(ParamStore):
+    """ParamStore over a NodeAgent's blob API — the no-shared-
+    filesystem transport (COS_SYNC_STORE=http://agent:port).  Only the
+    I/O primitives change: every read/write/list/delete becomes an
+    HTTP round-trip to /v1/blob*, the merge lock becomes POST
+    /v1/lock (the agent runs the same O_EXCL + stale-break-by-rename
+    protocol server-side), and everything above — heartbeats, round
+    membership, versioned globals, GC — is inherited untouched.  The
+    retry loop (and with it COS_FAULT_FLAKY_STORAGE injection) stays
+    CLIENT-side in the inherited `_retry`, so flaky-storage semantics
+    are identical to the shared-filesystem path by construction."""
+
+    def __init__(self, url: str, rank: int, policy: SyncPolicy,
+                 chaos=None):
+        # deliberately no super().__init__: the root is a URL, there
+        # is no local directory to create
+        self.root = url.rstrip("/")
+        self.rank = int(rank)
+        self.policy = policy
+        self.chaos = chaos
+        self._last_hb = 0.0
+
+    # -- HTTP primitives -----------------------------------------------
+    def _call(self, path: str, *, data=None, method=None, raw=False):
+        import http.client
+        from ..tools.nodeagent import agent_call
+        try:
+            return agent_call(self.root, path, data=data,
+                              method=method, raw=raw, timeout=10.0)
+        except http.client.HTTPException as e:
+            # normalize mid-response deaths to the OSError the
+            # inherited retry loop (and every caller) already absorbs
+            raise OSError(f"agent transport: {e}") from e
+
+    def _put_bytes(self, name: str, payload: bytes):
+        self._call(f"/v1/blob/{name}", data=payload, method="PUT")
+
+    def _get_bytes(self, name: str) -> Optional[bytes]:
+        return self._call(f"/v1/blob/{name}", raw=True)
+
+    # -- transport seams -----------------------------------------------
+    def _list_names(self) -> List[str]:
+        doc = self._retry(lambda: self._call("/v1/blobs"),
+                          "list blobs")
+        return list((doc or {}).get("names") or [])
+
+    def _delete(self, name: str):
+        self._call(f"/v1/blob/{name}", method="DELETE")
+
+    def _write_json(self, name: str, obj: dict):
+        payload = json.dumps(obj).encode()
+        self._retry(lambda: self._put_bytes(name, payload),
+                    f"write {name}")
+
+    def _read_json(self, name: str) -> Optional[dict]:
+        def r():
+            data = self._get_bytes(name)
+            return None if data is None else json.loads(data)
+        return self._retry(r, f"read {name}")
+
+    def _write_npz(self, name: str, flat: HostFlat):
+        payload = _encode_wire(flat, self.policy.wire_dtype)
+        buf = io.BytesIO()
+        np.savez(buf, **payload)
+        raw = buf.getvalue()
+        self._retry(lambda: self._put_bytes(name, raw),
+                    f"write {name}")
+
+    def _read_npz(self, name: str) -> HostFlat:
+        def r():
+            data = self._get_bytes(name)
+            if data is None:
+                # same shape as the fs path reading a missing file:
+                # an OSError the retry loop (and read_round) absorbs
+                raise FileNotFoundError(f"{self.root}/{name}")
+            with np.load(io.BytesIO(data)) as npz:
+                return _decode_wire(npz)
+        return self._retry(r, f"read {name}")
+
+    # -- async merge lock ----------------------------------------------
+    def lock_global(self) -> bool:
+        """Same contract as the fs lock: try-acquire, never block.  The
+        stale-break runs server-side (the agent renames a lock older
+        than `stale_s` away); an unreachable agent reads as 'lock
+        busy' — the caller's bounded retry loop already handles both."""
+        try:
+            doc = self._call("/v1/lock",
+                             data={"name": "global.lock",
+                                   "owner": self.rank,
+                                   "stale_s": self.LOCK_STALE_S})
+        except OSError:
+            return False
+        return bool((doc or {}).get("acquired"))
+
+    def unlock_global(self):
+        try:
+            self._call("/v1/unlock", data={"name": "global.lock"})
         except OSError:
             pass
 
@@ -711,10 +828,18 @@ def make_sync(policy: SyncPolicy, output_dir: str, rank: int,
               chaos=None, store_root: Optional[str] = None
               ) -> Optional[_SyncBase]:
     """Sync object for a trainer process, or None for lockstep (the
-    default stays byte-identical by never constructing anything)."""
+    default stays byte-identical by never constructing anything).  The
+    store root resolves explicit arg > COS_SYNC_STORE (policy.store) >
+    the shared-filesystem default; an http(s):// root selects the
+    NodeAgent blob transport."""
     if not policy.elastic:
         return None
-    root = store_root or os.path.join(output_dir, ".sync")
-    store = ParamStore(root, rank, policy, chaos=chaos)
+    root = (store_root or policy.store
+            or os.path.join(output_dir, ".sync"))
+    if root.startswith(("http://", "https://")):
+        store: ParamStore = HttpParamStore(root, rank, policy,
+                                           chaos=chaos)
+    else:
+        store = ParamStore(root, rank, policy, chaos=chaos)
     cls = LocalSGDSync if policy.mode == "local_sgd" else AsyncSync
     return cls(policy, store, rank, chaos=chaos)

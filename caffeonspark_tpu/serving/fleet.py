@@ -24,6 +24,13 @@ goes over the same HTTP surface operators script against.
     fleet.stop()
 
 Knob: COS_SERVE_REPLICAS (the `-serveReplicas` CLI default).
+
+Multi-host: with `agents=[...]` (or COS_AGENTS=url,url,...) the fleet
+becomes a host-aware scheduler — replicas are spawned through NodeAgent
+daemons (`tools/nodeagent.py`) instead of forked locally, replica i's
+home is agents[i % n], a dead replica respawns on the first LIVE agent
+(failover after COS_FAULT_HOST_KILL), and agent heartbeats feed the
+`hosts` block of metrics_summary (the `cos_host_up` gauge).
 """
 
 from __future__ import annotations
@@ -40,6 +47,8 @@ from typing import Dict, List, Optional
 
 from ..metrics import PipelineMetrics
 from ..obs.recorder import record as record_event
+from ..tools.nodeagent import (AGENT_ERRORS, AgentProc, agent_call,
+                               agent_env_overlay, agent_urls_from_env)
 from ..tools.supervisor import terminate_processes
 from .batcher import _env_int
 from .retry import RetryPolicy
@@ -98,6 +107,7 @@ class ReplicaProcess:
         self.serve_args = list(serve_args)
         self.env = dict(env) if env else None
         self.host = host
+        self.host_name = ""         # NodeAgent host name ("" = local)
         self.proc: Optional[subprocess.Popen] = None
         self.port: Optional[int] = None
         self._port_ready = threading.Event()
@@ -206,6 +216,93 @@ class ReplicaProcess:
             terminate_processes([self.proc], grace=grace)
 
 
+class AgentReplicaProcess(ReplicaProcess):
+    """A replica scheduled onto a NodeAgent instead of forked locally.
+    Only `spawn()` changes: the serve argv goes to an agent's POST
+    /v1/spawn (trying the agent list round-robin from this replica's
+    home index — the failover that lands a respawn on a SURVIVING
+    host after COS_FAULT_HOST_KILL), `self.proc` becomes the
+    Popen-mimicking `AgentProc`, and the boot port is discovered by
+    polling the agent's proc record (the agent tails the child's
+    stdout) instead of reading a local pipe.  Everything else —
+    wait_ready, alive, kill, terminate, the monitor's restart
+    bookkeeping — is inherited untouched."""
+
+    def __init__(self, name: str, serve_args: List[str],
+                 env: Optional[Dict[str, str]] = None,
+                 agents: Optional[List[str]] = None,
+                 agent_index: int = 0):
+        super().__init__(name, serve_args, env=env)
+        self.agents = [u.rstrip("/") for u in (agents or [])]
+        if not self.agents:
+            raise ValueError(f"{name}: AgentReplicaProcess needs at "
+                             "least one agent URL")
+        self._agent_i = agent_index % len(self.agents)
+        self.agent_url: Optional[str] = None
+
+    def spawn(self) -> "AgentReplicaProcess":
+        from urllib.parse import urlsplit
+        evt = threading.Event()
+        self._port_ready = evt
+        self.port = None
+        self.t_spawn = time.monotonic()
+        self.t_ready = None
+        self.booted_model = _model_from_args(self.serve_args)
+        overlay = agent_env_overlay(self.env)
+        last: Optional[BaseException] = None
+        for k in range(len(self.agents)):
+            url = self.agents[(self._agent_i + k) % len(self.agents)]
+            bind = urlsplit(url).hostname or "127.0.0.1"
+            cmd = [sys.executable, "-m",
+                   "caffeonspark_tpu.caffe_on_spark", "-serve",
+                   "-serveHost", bind, "-servePort", "0",
+                   "-serveReplicas", "1"] + self.serve_args
+            try:
+                doc = agent_call(url, "/v1/spawn",
+                                 data={"argv": cmd, "env": overlay,
+                                       "name": self.name},
+                                 timeout=15.0)
+            except AGENT_ERRORS as e:
+                last = e
+                continue
+            self._agent_i = (self._agent_i + k) % len(self.agents)
+            self.agent_url = url
+            self.host_name = str(doc.get("host") or "")
+            self.host = bind
+            proc = AgentProc(url, doc["proc"], pid=doc.get("pid"))
+            self.proc = proc
+            threading.Thread(target=self._poll_agent_port,
+                             args=(proc, evt),
+                             name=f"cos-fleet-{self.name}-agentport",
+                             daemon=True).start()
+            return self
+        # every agent unreachable: raise rather than fabricate a dead
+        # proc — Fleet.start tears down, and the monitor's try/except
+        # retries next pass (hosts may be coming back)
+        raise RuntimeError(f"{self.name}: no live NodeAgent among "
+                           f"{self.agents}") from last
+
+    def _poll_agent_port(self, proc: AgentProc,
+                         evt: threading.Event) -> None:
+        """The agent's stdout tail discovers the replica's boot line;
+        surface the port here with the same staleness guard as the
+        local pipe reader (`proc`/`evt` are this spawn's own)."""
+        try:
+            while self.proc is proc:
+                info = proc.info()
+                port = info.get("port")
+                if port and self.proc is proc:
+                    self.port = int(port)
+                    break
+                if not info.get("alive"):
+                    break
+                time.sleep(0.05)
+        except AGENT_ERRORS:
+            pass
+        finally:
+            evt.set()
+
+
 class Fleet:
     """Replica processes + router + restart-on-death monitor."""
 
@@ -215,10 +312,19 @@ class Fleet:
                  startup_timeout_s: float = 180.0,
                  poll_interval_s: float = 0.25,
                  max_restarts: int = 10,
-                 metrics: Optional[PipelineMetrics] = None):
+                 metrics: Optional[PipelineMetrics] = None,
+                 agents: Optional[List[str]] = None):
         self.serve_args = list(serve_args)
         self.n = replicas or serve_replicas()
         self.env = dict(env) if env else {}
+        # multi-host: NodeAgent endpoints to schedule replicas onto
+        # (explicit arg > COS_AGENTS env; empty = fork locally).
+        # Replica i's HOME agent is agents[i % n] — spread by default,
+        # failover handled inside AgentReplicaProcess.spawn
+        self.agents = ([u.rstrip("/") for u in agents] if agents
+                       else agent_urls_from_env())
+        self._agent_state: Dict[str, dict] = {}   # url -> host/up/ts
+        self._agents_next_poll = 0.0
         self.startup_timeout_s = startup_timeout_s
         self.poll_interval_s = poll_interval_s
         self.max_restarts = max_restarts
@@ -258,18 +364,25 @@ class Fleet:
                 # so per-replica chaos (COS_FAULT_REPLICA_SLOW) can
                 # target one replica; respawns reuse this env dict,
                 # keeping the index stable across restarts
-                self.replicas[name] = ReplicaProcess(
-                    name, self.serve_args,
-                    env=dict(self.env,
-                             COS_REPLICA_INDEX=str(i))).spawn()
+                renv = dict(self.env, COS_REPLICA_INDEX=str(i))
+                if self.agents:
+                    rep: ReplicaProcess = AgentReplicaProcess(
+                        name, self.serve_args, env=renv,
+                        agents=self.agents, agent_index=i)
+                else:
+                    rep = ReplicaProcess(name, self.serve_args,
+                                         env=renv)
+                self.replicas[name] = rep.spawn()
                 self.router.add_replica(name, "http://unbound",
-                                        state=STARTING)
+                                        state=STARTING,
+                                        host=rep.host_name)
             for name, rep in self.replicas.items():
                 if not rep.wait_ready(self.startup_timeout_s):
                     raise RuntimeError(
                         f"fleet: {name} failed to become healthy "
                         f"within {self.startup_timeout_s}s")
-                self.router.update_url(name, rep.url)
+                self.router.update_url(name, rep.url,
+                                       host=rep.host_name or None)
                 self.router.set_state(name, OK)
                 if rep.t_ready and rep.t_spawn:
                     self.metrics.add("replica_startup",
@@ -301,11 +414,40 @@ class Fleet:
     def _monitor_loop(self):
         while not self._stop_evt.wait(self.poll_interval_s):
             try:
+                self._agents_once()
                 self._monitor_once()
             except Exception as e:   # noqa: BLE001 — keep monitoring
                 # a failed spawn (fork pressure, vanished binary) must
                 # not kill the only restart path for the whole fleet
                 _LOG.warning("fleet monitor pass failed: %s", e)
+
+    def _agents_once(self):
+        """Throttled NodeAgent heartbeat poll: tracks each agent's
+        host name + liveness (what `cos_host_up` renders) and records
+        host up/down transitions on the flight recorder — the
+        host-level half of a kill-a-host incident timeline."""
+        if not self.agents:
+            return
+        now = time.monotonic()
+        if now < self._agents_next_poll:
+            return
+        self._agents_next_poll = now + 1.0
+        for url in self.agents:
+            prev = self._agent_state.get(url) or {}
+            try:
+                doc = agent_call(url, "/healthz", timeout=2.0)
+                host = str(doc.get("host") or url)
+                up = True
+            except AGENT_ERRORS:
+                host = prev.get("host") or url
+                up = False
+            if prev.get("up") != up:
+                record_event("fleet", "host_up" if up else "host_down",
+                             host=host, agent=url)
+                if not up:
+                    self.metrics.incr("host_down_events")
+            self._agent_state[url] = {"host": host, "up": up,
+                                      "ts": round(time.time(), 3)}
 
     def _monitor_once(self):
         for name, rep in list(self.replicas.items()):
@@ -328,7 +470,9 @@ class Fleet:
                          rep.restart_count, self.max_restarts)
             record_event("fleet", "replica_died", replica=name,
                          rc=rep.proc.returncode,
-                         restart=rep.restart_count)
+                         restart=rep.restart_count,
+                         **({"host": rep.host_name}
+                            if rep.host_name else {}))
             self.metrics.incr("replica_restarts")
             self.router.note_restart(name)
             t0 = time.monotonic()
@@ -350,15 +494,19 @@ class Fleet:
                 # live roll the committed default is the only version
                 # a rejoining replica may serve.
                 self._heal_respawn_model(rep)
-                # new ephemeral port: point the router at it
-                # BEFORE reopening routing
-                self.router.update_url(name, rep.url)
+                # new ephemeral port (and possibly a new HOST, after
+                # a host kill): point the router at it BEFORE
+                # reopening routing
+                self.router.update_url(name, rep.url,
+                                       host=rep.host_name or None)
                 self.router.set_state(name, OK)
                 self.metrics.add("replica_rejoin",
                                  time.monotonic() - t0)
                 record_event("fleet", "replica_rejoined",
                              replica=name, url=rep.url,
-                             wall_s=round(time.monotonic() - t0, 3))
+                             wall_s=round(time.monotonic() - t0, 3),
+                             **({"host": rep.host_name}
+                                if rep.host_name else {}))
             else:
                 _LOG.error("fleet: restarted %s failed to become "
                            "healthy", name)
@@ -585,4 +733,11 @@ class Fleet:
         out = self.router.metrics_summary()
         out["fleet"] = {"replicas": self.n,
                         "restarts": self._restarts}
+        if self.agents:
+            # the agent-heartbeat view: host -> up?, what the prom
+            # writer renders as cos_host_up{host=...}
+            out["hosts"] = {st["host"]: {"up": st["up"],
+                                         "agent": url,
+                                         "ts": st["ts"]}
+                            for url, st in self._agent_state.items()}
         return out

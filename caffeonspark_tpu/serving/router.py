@@ -173,12 +173,14 @@ class _Replica:
     pick must read every replica's outstanding count atomically)."""
 
     __slots__ = ("name", "url", "state", "outstanding", "requests",
-                 "failures", "restarts", "drain_intent", "lat")
+                 "failures", "restarts", "drain_intent", "lat", "host")
 
-    def __init__(self, name: str, url: str, state: str = STARTING):
+    def __init__(self, name: str, url: str, state: str = STARTING,
+                 host: str = ""):
         self.name = name
         self.url = url.rstrip("/")
         self.state = state
+        self.host = host            # NodeAgent host name ("" = local)
         self.outstanding = 0
         self.requests = 0
         self.failures = 0
@@ -227,21 +229,25 @@ class Router:
 
     # -- replica table ------------------------------------------------
     def add_replica(self, name: str, url: str,
-                    state: str = STARTING) -> None:
+                    state: str = STARTING, host: str = "") -> None:
         with self._lock:
-            self._replicas[name] = _Replica(name, url, state)
+            self._replicas[name] = _Replica(name, url, state, host)
 
     def remove_replica(self, name: str) -> None:
         with self._lock:
             self._replicas.pop(name, None)
 
-    def update_url(self, name: str, url: str) -> None:
-        """A restarted replica comes back on a fresh ephemeral port;
-        keep its counters (requests/restarts) across the move."""
+    def update_url(self, name: str, url: str,
+                   host: Optional[str] = None) -> None:
+        """A restarted replica comes back on a fresh ephemeral port
+        (and, after a host kill, possibly on a DIFFERENT host); keep
+        its counters (requests/restarts) across the move."""
         with self._lock:
             rep = self._replicas.get(name)
             if rep is not None:
                 rep.url = url.rstrip("/")
+                if host is not None:
+                    rep.host = host
 
     def set_state(self, name: str, state: str) -> None:
         with self._lock:
@@ -873,7 +879,11 @@ class Router:
                     # operator can see WHY a hedge fired (and which
                     # replica is the straggler) from /metrics alone
                     "lat_ewma_ms": round(r.lat.ewma_ms, 3),
-                    "lat_p95_ms": round(r.lat.pct_ms(0.95), 3)}
+                    "lat_p95_ms": round(r.lat.pct_ms(0.95), 3),
+                    # which NodeAgent host carries it ("" = local
+                    # subprocess) — the /metrics replica table's host
+                    # column in multi-host fleets
+                    **({"host": r.host} if r.host else {})}
                 for n, r in self._replicas.items()}
             if self.hedge_pct > 0:
                 out["hedge"] = {

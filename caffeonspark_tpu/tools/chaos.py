@@ -45,6 +45,21 @@ Knobs (all default off; see docs/tuning.md for the full table):
   COS_FAULT_COMM_LAT_US        floor for the gradsync bench — see
   COS_FAULT_COMM_LOCAL         `GradSyncPlan.exposed_wire_bytes` and
   COS_FAULT_COMM_HIDE_BYTES    scripts/bench_gradsync.py
+  COS_FAULT_COMM_INTRA_NS_PER_BYTE
+                               per-byte cost of the INTRA-host leg of a
+                               two-tier (`hier`) exchange; with it the
+                               floor is asymmetric — fast NVLink/ICI
+                               inside a host, slow Ethernet between
+                               hosts (COS_FAULT_COMM_NS_PER_BYTE prices
+                               only inter-host bytes once this is set;
+                               see `GradSyncPlan.tier_wire_bytes` and
+                               scripts/bench_scaling.py)
+  COS_FAULT_HOST_KILL          "host:marker" — the NodeAgent named
+                               `host` SIGKILLs every child process
+                               TREE and dies, once (the kill-a-host
+                               drill: the fleet must respawn on a
+                               surviving agent with zero failed client
+                               requests)
 
 Serving/deploy faults (the continuous-deployment drills,
 caffeonspark_tpu/deploy/ — all one-shot via a marker file, the
@@ -93,24 +108,35 @@ from ..utils.envutils import env_num as _env_float
 
 
 class CommFloor(NamedTuple):
-    """Injected comm-floor model knobs (scripts/bench_gradsync.py)."""
+    """Injected comm-floor model knobs (scripts/bench_gradsync.py,
+    scripts/bench_scaling.py).  `ns_per_byte` prices the inter-host
+    link; `intra_ns_per_byte` (default 0 = free) prices the intra-host
+    leg of a two-tier exchange, making the floor asymmetric the way a
+    real cluster is (fast ICI/NVLink inside a host, slow Ethernet
+    between hosts)."""
     ns_per_byte: float
     lat_us: float
     local: int
     hide_bytes: Optional[int]
+    intra_ns_per_byte: float = 0.0
 
     @property
     def active(self) -> bool:
-        return self.ns_per_byte > 0
+        return self.ns_per_byte > 0 or self.intra_ns_per_byte > 0
 
     def sleep_seconds(self, gs_plan) -> float:
         """Modeled exposed wire time per solver step for a
-        GradSyncPlan (the sleep mini_cluster charges per step)."""
+        GradSyncPlan (the sleep mini_cluster charges per step).  The
+        plan's `tier_wire_bytes` splits exposed bytes into (intra,
+        inter); flat modes put everything on the inter-host link, so
+        with `intra_ns_per_byte` unset this reduces exactly to the
+        original single-tier model."""
         if not self.active or gs_plan is None:
             return 0.0
-        exposed = gs_plan.exposed_wire_bytes(
+        intra_b, inter_b = gs_plan.tier_wire_bytes(
             local_size=self.local, hide_bytes=self.hide_bytes)
-        return (exposed * self.ns_per_byte
+        return (inter_b * self.ns_per_byte
+                + intra_b * self.intra_ns_per_byte
                 + gs_plan.n_messages * self.lat_us * 1e3) / 1e9
 
 
@@ -130,6 +156,9 @@ class FaultPlan(NamedTuple):
     reload_fail_rank: Optional[Tuple[int, str]] = None  # (k, marker)
     # serving straggler: replica `idx` answers predicts factor× slower
     replica_slow: Optional[Tuple[int, float]] = None    # (idx, factor)
+    # multi-host: the NodeAgent named `host` kills its whole process
+    # tree and dies, once (marker-latched)
+    host_kill: Optional[Tuple[str, str]] = None      # (host, marker)
 
     @property
     def active(self) -> bool:
@@ -137,7 +166,8 @@ class FaultPlan(NamedTuple):
                     or self.slow_rank or self.flaky_exchange
                     or self.flaky_storage or self.comm.active
                     or self.canary_kill or self.snapshot_truncate
-                    or self.reload_fail_rank or self.replica_slow)
+                    or self.reload_fail_rank or self.replica_slow
+                    or self.host_kill)
 
     @property
     def slow_factor(self) -> float:
@@ -180,6 +210,9 @@ class FaultPlan(NamedTuple):
                 "local": self.comm.local,
                 "hide_bytes": self.comm.hide_bytes,
             }
+            if self.comm.intra_ns_per_byte:
+                out["comm_floor"]["intra_ns_per_byte"] = \
+                    self.comm.intra_ns_per_byte
         if self.canary_kill:
             out["canary_kill"] = {"after_requests": self.canary_kill[0]}
         if self.snapshot_truncate:
@@ -189,6 +222,8 @@ class FaultPlan(NamedTuple):
         if self.replica_slow:
             out["replica_slow"] = {"replica": self.replica_slow[0],
                                    "factor": self.replica_slow[1]}
+        if self.host_kill:
+            out["host_kill"] = {"host": self.host_kill[0]}
         return out
 
 
@@ -241,7 +276,17 @@ def resolve(rank: int = 0) -> FaultPlan:
         ns_per_byte=_env_float("COS_FAULT_COMM_NS_PER_BYTE", 0.0),
         lat_us=_env_float("COS_FAULT_COMM_LAT_US", 0.0),
         local=int(_env_float("COS_FAULT_COMM_LOCAL", 1) or 1),
-        hide_bytes=int(float(hide)) if hide else None)
+        hide_bytes=int(float(hide)) if hide else None,
+        intra_ns_per_byte=_env_float(
+            "COS_FAULT_COMM_INTRA_NS_PER_BYTE", 0.0))
+    hk = os.environ.get("COS_FAULT_HOST_KILL", "")
+    host_kill = None
+    if hk:
+        h_, marker = hk.split(":", 1)
+        if not h_ or not marker:
+            raise ValueError(f"COS_FAULT_HOST_KILL={hk!r}: expected "
+                             "'host:marker' with both parts non-empty")
+        host_kill = (h_, marker)
     return FaultPlan(
         rank=rank,
         step_delay_s=_env_float("COS_FAULT_STEP_DELAY_MS", 0.0) / 1e3,
@@ -255,7 +300,8 @@ def resolve(rank: int = 0) -> FaultPlan:
         snapshot_truncate=(
             os.environ.get("COS_FAULT_SNAPSHOT_TRUNCATE", "") or None),
         reload_fail_rank=_count_marker("COS_FAULT_RELOAD_FAIL_RANK"),
-        replica_slow=replica_slow)
+        replica_slow=replica_slow,
+        host_kill=host_kill)
 
 
 class ChaosInjector:
@@ -269,7 +315,7 @@ class ChaosInjector:
         self._rng = random.Random(plan.seed)
         self.injected = {"exchange_faults": 0, "storage_faults": 0,
                          "canary_kills": 0, "snapshot_truncations": 0,
-                         "reload_failures": 0}
+                         "reload_failures": 0, "host_kills": 0}
 
     @staticmethod
     def _fire_once(marker: str) -> bool:
@@ -372,6 +418,22 @@ class ChaosInjector:
             print(f"FAULT INJECTION: truncated snapshot {p} "
                   f"({size} -> {max(1, size // 3)} bytes)", flush=True)
         return True
+
+    def host_kill_due(self, host: str) -> bool:
+        """COS_FAULT_HOST_KILL: True (once) when the plan names `host`
+        — the NodeAgent's tick thread then SIGKILLs every child
+        process tree and takes the whole host down.  Marker-latched so
+        a relaunched agent with the same name does not re-die."""
+        hk = self.plan.host_kill
+        if hk is None or hk[0] != host:
+            return False
+        if self._fire_once(hk[1]):
+            self.injected["host_kills"] += 1
+            print(f"FAULT INJECTION: killing host {host} "
+                  "process tree", flush=True)
+            _record("chaos", "host_kill", host=host)
+            return True
+        return False
 
     def reload_fail_due(self, replica_index: int) -> bool:
         """COS_FAULT_RELOAD_FAIL_RANK: True (once) when a rolling

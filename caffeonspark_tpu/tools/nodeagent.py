@@ -1,0 +1,762 @@
+"""NodeAgent: the per-host daemon of the multi-host layer.
+
+One agent runs on every host (`python -m caffeonspark_tpu.tools
+.nodeagent -host hostA`) and exposes a small HTTP API; `Fleet` and the
+elastic supervisor then become host-aware SCHEDULERS that address
+`host:port` agent endpoints instead of forking local subprocesses:
+
+  GET  /healthz                   liveness + host name (the heartbeat
+                                  the fleet's `cos_host_up` gauge eats)
+  POST /v1/spawn                  {argv, env, name} -> {proc, pid};
+                                  the child runs in its own session
+                                  (process GROUP) and its stdout is
+                                  watched for the standard boot JSON
+                                  line, so a serving replica's
+                                  ephemeral port is discoverable
+  GET  /v1/procs[/<id>]           alive / returncode / port / pid
+  POST /v1/procs/<id>/signal      {signal: TERM|KILL, ...} delivered to
+                                  the child's whole process tree
+  GET  /v1/coordinator            lead-agent rendezvous: allocates ONE
+                                  host:port for `jax.distributed
+                                  .initialize` and hands the same
+                                  answer to every caller
+  PUT/GET/DELETE /v1/blob/<name>  the network ParamStore transport —
+  GET  /v1/blobs                  writes land via tmp + os.replace, the
+                                  same atomic-rename publish as the
+                                  shared-filesystem store
+  POST /v1/lock | /v1/unlock      {name, owner, stale_s}: O_EXCL lock
+                                  with rename-based stale-break, the
+                                  server-side twin of
+                                  `ParamStore.lock_global`
+  POST /v1/faults                 {env: {COS_FAULT_*: v}}: the scripted
+                                  mid-run knob flip (`apply_fault_env`)
+                                  — how a drill schedules
+                                  COS_FAULT_HOST_KILL on a live agent
+
+Multi-process-per-"host" emulation: N agents on one box, each with a
+distinct `-host` name and fault regime, so every cross-host behavior —
+respawn-on-surviving-host, two-tier gradient exchange under an
+asymmetric comm floor, the no-shared-filesystem ParamStore — is
+exercised by ordinary CPU tests.
+
+`COS_FAULT_HOST_KILL=<host>:<marker>` is honored by the agent's tick
+thread: when the plan names THIS host, the agent dumps its flight
+recorder, SIGKILLs every child process group, and dies (os._exit when
+standalone; in-process agents close their server so pollers see the
+host go dark).  One-shot via the marker file, like every other knob.
+
+`AgentProc` is the client-side Popen look-alike (poll / wait /
+send_signal / returncode) so `terminate_processes`, the fleet monitor,
+and the supervisor's rank bookkeeping work on remote children
+unchanged; an unreachable agent reads as returncode -9 ("host lost").
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import itertools
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..obs.recorder import maybe_dump, record
+
+# transport failures a caller treats as "host unreachable" (URLError
+# subclasses OSError; HTTPException covers mid-response socket deaths)
+AGENT_ERRORS = (OSError, http.client.HTTPException)
+
+# returncode AgentProc reports when the agent itself stops answering:
+# the child is unobservable, which a scheduler must treat as dead
+HOST_LOST_RC = -9
+
+_BLOB_NAME = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
+# -- client side ---------------------------------------------------------
+def agent_call(base_url: str, path: str, *, data: Any = None,
+               method: Optional[str] = None, timeout: float = 10.0,
+               raw: bool = False) -> Any:
+    """One HTTP round-trip to a NodeAgent.  `data` may be a JSON-able
+    object or raw bytes (blob PUTs).  Returns the decoded JSON body —
+    or bytes when `raw` — and None for a 404 (absent blob/proc), so
+    callers distinguish "not there" from "host unreachable" (which
+    raises an AGENT_ERRORS member like every transport failure)."""
+    url = base_url.rstrip("/") + path
+    body = None
+    if data is not None:
+        body = (bytes(data) if isinstance(data, (bytes, bytearray))
+                else json.dumps(data).encode())
+    req = urllib.request.Request(
+        url, data=body, method=method or ("POST" if body is not None
+                                          else "GET"))
+    if body is not None:
+        req.add_header("Content-Type",
+                       "application/octet-stream"
+                       if isinstance(data, (bytes, bytearray))
+                       else "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            payload = resp.read()
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        detail = b""
+        try:
+            detail = e.read()[:200]
+        except OSError:
+            pass
+        raise OSError(f"agent {url}: HTTP {e.code} {detail!r}") from e
+    return payload if raw else json.loads(payload or b"{}")
+
+
+def agent_urls_from_env(raw: Optional[str] = None) -> List[str]:
+    """COS_AGENTS (or an explicit comma list) -> normalized agent URLs.
+    Bare host:port entries get the http:// scheme."""
+    raw = os.environ.get("COS_AGENTS", "") if raw is None else raw
+    out: List[str] = []
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "://" not in tok:
+            tok = "http://" + tok
+        out.append(tok.rstrip("/"))
+    return out
+
+
+def agent_env_overlay(extra: Optional[dict] = None) -> Dict[str, str]:
+    """Env a scheduler forwards with a spawn request.  The agent's own
+    environ is the child's base (it lives on the agent's host), so only
+    the knobs the SCHEDULING process owns ride along — chaos/sync/obs
+    and backend-selection keys — plus PYTHONPATH to this checkout so an
+    agent started from anywhere can exec `-m caffeonspark_tpu...`."""
+    keep = ("COS_", "JAX_", "XLA_", "PALLAS_")
+    out = {k: v for k, v in os.environ.items() if k.startswith(keep)}
+    pkg_parent = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    out["PYTHONPATH"] = pkg_parent + (
+        os.pathsep + os.environ["PYTHONPATH"]
+        if os.environ.get("PYTHONPATH") else "")
+    out.update({str(k): str(v) for k, v in (extra or {}).items()})
+    return out
+
+
+class AgentProc:
+    """Popen look-alike for a child living on a NodeAgent.  Implements
+    exactly the surface `terminate_processes`, the fleet monitor, and
+    the supervisor use: poll() / wait(timeout) / send_signal() /
+    terminate() / kill() / .pid / .returncode.  Signals are delivered
+    to the child's whole process TREE (its session group) — a remote
+    kill must not orphan grandchildren the scheduler can't see."""
+
+    def __init__(self, agent_url: str, proc_id: str,
+                 pid: Optional[int] = None):
+        self.agent_url = agent_url.rstrip("/")
+        self.proc_id = proc_id
+        self.pid = pid
+        self.returncode: Optional[int] = None
+
+    def info(self) -> dict:
+        doc = agent_call(self.agent_url, f"/v1/procs/{self.proc_id}",
+                         timeout=5.0)
+        if doc is None:
+            raise OSError(f"agent {self.agent_url}: "
+                          f"unknown proc {self.proc_id}")
+        return doc
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        try:
+            doc = self.info()
+        except AGENT_ERRORS:
+            self.returncode = HOST_LOST_RC
+            return self.returncode
+        if doc.get("alive"):
+            return None
+        rc = doc.get("returncode")
+        self.returncode = HOST_LOST_RC if rc is None else int(rc)
+        return self.returncode
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise subprocess.TimeoutExpired(self.proc_id, timeout)
+            time.sleep(0.05)
+        return self.returncode  # type: ignore[return-value]
+
+    def send_signal(self, sig: int) -> None:
+        if self.returncode is not None:
+            return
+        try:
+            name = signal.Signals(sig).name
+        except ValueError:
+            name = "SIGTERM"
+        try:
+            agent_call(self.agent_url,
+                       f"/v1/procs/{self.proc_id}/signal",
+                       data={"signal": name}, timeout=5.0)
+        except AGENT_ERRORS:
+            # agent gone -> the whole host (and the child) is gone
+            self.returncode = HOST_LOST_RC
+
+    def terminate(self) -> None:
+        self.send_signal(signal.SIGTERM)
+
+    def kill(self) -> None:
+        self.send_signal(signal.SIGKILL)
+
+
+def spawn_via_agents(agents: Sequence[str], argv: Sequence[str], *,
+                     env: Optional[dict] = None, name: str = "",
+                     start_index: int = 0
+                     ) -> Tuple[str, str, AgentProc]:
+    """Spawn `argv` on the first LIVE agent, trying `agents` round-robin
+    from `start_index` — this failover is the respawn-on-a-surviving-
+    host path after COS_FAULT_HOST_KILL.  Returns (agent_url,
+    host_name, AgentProc); raises RuntimeError only when every agent is
+    unreachable (the all-hosts-down case)."""
+    last: Optional[BaseException] = None
+    n = max(1, len(agents))
+    for k in range(len(agents)):
+        url = agents[(start_index + k) % n]
+        try:
+            doc = agent_call(url, "/v1/spawn",
+                             data={"argv": list(argv),
+                                   "env": dict(env or {}),
+                                   "name": name}, timeout=15.0)
+        except AGENT_ERRORS as e:
+            last = e
+            continue
+        return url, str(doc.get("host", "")), \
+            AgentProc(url, doc["proc"], pid=doc.get("pid"))
+    raise RuntimeError(
+        f"no live NodeAgent among {list(agents)}") from last
+
+
+def resolve_coordinator(spec: str, *, timeout_s: float = 30.0) -> str:
+    """`agent://host:port` -> the `host:port` coordinator address the
+    LEAD agent hands out (GET /v1/coordinator).  Every rank of a
+    cross-host job asks the same agent and gets the same answer — the
+    rendezvous that replaces a hand-picked `-server` address.  Retries
+    until the agent answers (ranks race the agent's boot)."""
+    if not spec.startswith("agent://"):
+        return spec
+    base = "http://" + spec[len("agent://"):].rstrip("/")
+    deadline = time.monotonic() + timeout_s
+    last: Optional[BaseException] = None
+    while time.monotonic() < deadline:
+        try:
+            doc = agent_call(base, "/v1/coordinator", timeout=5.0)
+            if doc and doc.get("coordinator"):
+                return str(doc["coordinator"])
+        except AGENT_ERRORS as e:
+            last = e
+        time.sleep(0.2)
+    raise RuntimeError(
+        f"coordinator rendezvous via {spec} timed out") from last
+
+
+# -- server side ---------------------------------------------------------
+class _ProcRec:
+    __slots__ = ("proc_id", "name", "proc", "port", "t_spawn", "tail",
+                 "reaped")
+
+    def __init__(self, proc_id: str, name: str,
+                 proc: subprocess.Popen):
+        self.proc_id = proc_id
+        self.name = name
+        self.proc = proc
+        self.port: Optional[int] = None
+        self.t_spawn = time.monotonic()
+        self.tail: "deque[str]" = deque(maxlen=50)
+        self.reaped = False
+
+
+class _AgentHandler(BaseHTTPRequestHandler):
+    server_version = "CosNodeAgent/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: D102 — quiet by design
+        pass
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n > 0 else b""
+
+    def _route(self, method: str) -> None:
+        agent = self.server.agent  # type: ignore[attr-defined]
+        try:
+            body = self._body() if method in ("POST", "PUT") else b""
+            code, payload, raw = agent.handle(method, self.path, body)
+        except Exception as e:  # noqa: BLE001 — keep the daemon up
+            code, payload, raw = 500, {
+                "error": f"{type(e).__name__}: {e}"}, False
+        data = (payload if raw
+                else json.dumps(payload).encode() + b"\n")
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type",
+                             "application/octet-stream" if raw
+                             else "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        except OSError:
+            pass                # client vanished mid-answer
+
+    def do_GET(self):
+        self._route("GET")
+
+    def do_POST(self):
+        self._route("POST")
+
+    def do_PUT(self):
+        self._route("PUT")
+
+    def do_DELETE(self):
+        self._route("DELETE")
+
+
+class NodeAgent:
+    """The daemon.  `start()` binds the HTTP server and launches the
+    serve + tick threads; in-process use (tests, emulation harnesses)
+    constructs one per emulated host.  All handler work runs on the
+    HTTP server's threads; `self._lock` guards only the proc table and
+    the coordinator slot — spawns, signals, and file I/O happen outside
+    it (COS005 discipline)."""
+
+    def __init__(self, host_name: str, *, http_host: str = "127.0.0.1",
+                 port: int = 0, blob_dir: Optional[str] = None,
+                 tick_s: float = 0.25, die_on_host_kill: bool = False):
+        self.host_name = host_name
+        self.http_host = http_host
+        self._want_port = port
+        self.port: Optional[int] = None
+        self.blob_dir = blob_dir or tempfile.mkdtemp(
+            prefix=f"cos-agent-{host_name}-")
+        os.makedirs(self.blob_dir, exist_ok=True)
+        self.tick_s = tick_s
+        self._die_on_kill = die_on_host_kill
+        self._lock = threading.Lock()
+        self._procs: Dict[str, _ProcRec] = {}
+        self._ids = itertools.count(1)
+        self._coordinator = ""
+        self._stop = threading.Event()
+        self._t0 = time.monotonic()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._threads: List[threading.Thread] = []
+        from .chaos import make_injector
+        self._chaos = make_injector(0)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.http_host}:{self.port}"
+
+    def start(self) -> "NodeAgent":
+        srv = ThreadingHTTPServer((self.http_host, self._want_port),
+                                  _AgentHandler)
+        srv.daemon_threads = True
+        srv.agent = self  # type: ignore[attr-defined]
+        self._server = srv
+        self.port = srv.server_address[1]
+        for target, tag in ((srv.serve_forever, "serve"),
+                            (self._tick_loop, "tick")):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"agent-{self.host_name}-{tag}")
+            t.start()
+            self._threads.append(t)
+        record("nodeagent", "start", host=self.host_name,
+               port=self.port)
+        return self
+
+    def stop(self) -> None:
+        """Graceful teardown: TERM every child tree, KILL stragglers,
+        then close the server."""
+        self._stop.set()
+        with self._lock:
+            recs = list(self._procs.values())
+        for rec in recs:
+            self._kill_tree(rec, signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        for rec in recs:
+            while rec.proc.poll() is None \
+                    and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if rec.proc.poll() is None:
+                self._kill_tree(rec, signal.SIGKILL)
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    # -- request dispatch ----------------------------------------------
+    def handle(self, method: str, path: str,
+               body: bytes) -> Tuple[int, Any, bool]:
+        path = path.split("?", 1)[0].rstrip("/") or "/"
+        if method == "GET":
+            if path == "/healthz":
+                return 200, self._healthz(), False
+            if path == "/v1/procs":
+                return 200, {"procs": self._proc_table()}, False
+            if path.startswith("/v1/procs/"):
+                rec = self._rec(path[len("/v1/procs/"):])
+                if rec is None:
+                    return 404, {"error": "no such proc"}, False
+                return 200, self._proc_info(rec), False
+            if path == "/v1/coordinator":
+                return 200, {"coordinator": self._get_coordinator(),
+                             "host": self.host_name}, False
+            if path == "/v1/blobs":
+                return 200, {"names": self._blob_names()}, False
+            if path.startswith("/v1/blob/"):
+                return self._blob_get(path[len("/v1/blob/"):])
+        elif method == "PUT" and path.startswith("/v1/blob/"):
+            return self._blob_put(path[len("/v1/blob/"):], body)
+        elif method == "DELETE" and path.startswith("/v1/blob/"):
+            return self._blob_delete(path[len("/v1/blob/"):])
+        elif method == "POST":
+            try:
+                req = json.loads(body or b"{}")
+            except ValueError:
+                return 400, {"error": "bad JSON body"}, False
+            if path == "/v1/spawn":
+                return self._spawn(req)
+            m = re.match(r"^/v1/procs/([^/]+)/signal$", path)
+            if m:
+                rec = self._rec(m.group(1))
+                if rec is None:
+                    return 404, {"error": "no such proc"}, False
+                return self._signal(rec, req)
+            if path == "/v1/faults":
+                return self._faults(req)
+            if path == "/v1/lock":
+                return self._lock_acquire(req)
+            if path == "/v1/unlock":
+                return self._lock_release(req)
+        return 404, {"error": f"no route {method} {path}"}, False
+
+    # -- liveness ------------------------------------------------------
+    def _healthz(self) -> dict:
+        with self._lock:
+            n = len(self._procs)
+        return {"ok": True, "agent": True, "host": self.host_name,
+                "pid": os.getpid(), "port": self.port,
+                "uptime_s": round(time.monotonic() - self._t0, 3),
+                "procs": n}
+
+    # -- process management --------------------------------------------
+    def _rec(self, proc_id: str) -> Optional[_ProcRec]:
+        with self._lock:
+            return self._procs.get(proc_id)
+
+    def _proc_table(self) -> Dict[str, dict]:
+        with self._lock:
+            recs = list(self._procs.values())
+        return {r.proc_id: self._proc_info(r) for r in recs}
+
+    @staticmethod
+    def _proc_info(rec: _ProcRec) -> dict:
+        rc = rec.proc.poll()
+        return {"proc": rec.proc_id, "name": rec.name,
+                "pid": rec.proc.pid, "alive": rc is None,
+                "returncode": rc, "port": rec.port,
+                "age_s": round(time.monotonic() - rec.t_spawn, 3),
+                "tail": list(rec.tail)}
+
+    def _spawn(self, req: dict) -> Tuple[int, Any, bool]:
+        argv = req.get("argv")
+        if not argv or not isinstance(argv, list):
+            return 400, {"error": "spawn needs a non-empty argv"}, False
+        env = dict(os.environ)
+        env.update({str(k): str(v)
+                    for k, v in (req.get("env") or {}).items()})
+        with self._lock:
+            proc_id = f"p{next(self._ids)}"
+        name = str(req.get("name") or proc_id)
+        # start_new_session: the child leads its own process group, so
+        # a tree kill (or HOST_KILL) reaps grandchildren too
+        proc = subprocess.Popen(
+            [str(a) for a in argv], env=env,
+            cwd=req.get("cwd") or None,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, start_new_session=True)
+        rec = _ProcRec(proc_id, name, proc)
+        threading.Thread(target=self._read_stdout, args=(rec,),
+                         daemon=True,
+                         name=f"agent-{self.host_name}-io-{proc_id}"
+                         ).start()
+        with self._lock:
+            self._procs[proc_id] = rec
+        record("nodeagent", "spawn", host=self.host_name,
+               proc=proc_id, pid=proc.pid, name=name)
+        return 200, {"proc": proc_id, "pid": proc.pid,
+                     "host": self.host_name}, False
+
+    @staticmethod
+    def _read_stdout(rec: _ProcRec) -> None:
+        """Tail the child's stdout; the first JSON line carrying a
+        `port` (the serving boot line) makes the replica's ephemeral
+        port visible through /v1/procs/<id>."""
+        try:
+            for line in rec.proc.stdout:  # type: ignore[union-attr]
+                line = line.rstrip("\n")
+                rec.tail.append(line)
+                if rec.port is None and line.lstrip().startswith("{"):
+                    try:
+                        doc = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(doc, dict) and doc.get("port"):
+                        rec.port = int(doc["port"])
+        except (OSError, ValueError):
+            pass
+
+    def _kill_tree(self, rec: _ProcRec, sig: int) -> None:
+        try:
+            os.killpg(os.getpgid(rec.proc.pid), sig)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                rec.proc.send_signal(sig)
+            except (ProcessLookupError, OSError):
+                pass
+
+    def _signal(self, rec: _ProcRec,
+                req: dict) -> Tuple[int, Any, bool]:
+        name = str(req.get("signal", "TERM")).upper()
+        if not name.startswith("SIG"):
+            name = "SIG" + name
+        sig = getattr(signal, name, None)
+        if not isinstance(sig, signal.Signals):
+            return 400, {"error": f"unknown signal {name}"}, False
+        self._kill_tree(rec, sig)
+        record("nodeagent", "signal", host=self.host_name,
+               proc=rec.proc_id, signal=name)
+        return 200, {"ok": True, "proc": rec.proc_id,
+                     "signal": name,
+                     "alive": rec.proc.poll() is None}, False
+
+    # -- coordinator rendezvous ----------------------------------------
+    def _get_coordinator(self) -> str:
+        with self._lock:
+            if self._coordinator:
+                return self._coordinator
+        # allocate outside the lock (socket ops never run under it);
+        # first allocation wins the CAS below, losers adopt it
+        s = socket.socket()
+        try:
+            s.bind((self.http_host, 0))
+            addr = f"{self.http_host}:{s.getsockname()[1]}"
+        finally:
+            s.close()
+        with self._lock:
+            if not self._coordinator:
+                self._coordinator = addr
+                record("nodeagent", "coordinator",
+                       host=self.host_name, address=addr)
+            return self._coordinator
+
+    # -- blob store (the network ParamStore transport) -----------------
+    def _blob_path(self, name: str) -> Optional[str]:
+        if not _BLOB_NAME.match(name) or ".." in name:
+            return None
+        return os.path.join(self.blob_dir, name)
+
+    def _blob_names(self) -> List[str]:
+        try:
+            names = os.listdir(self.blob_dir)
+        except OSError:
+            return []
+        return sorted(n for n in names if not n.startswith("tmp."))
+
+    def _blob_get(self, name: str) -> Tuple[int, Any, bool]:
+        path = self._blob_path(name)
+        if path is None:
+            return 400, {"error": f"bad blob name {name!r}"}, False
+        try:
+            with open(path, "rb") as f:
+                return 200, f.read(), True
+        except FileNotFoundError:
+            return 404, {"error": "no such blob"}, False
+
+    def _blob_put(self, name: str,
+                  body: bytes) -> Tuple[int, Any, bool]:
+        path = self._blob_path(name)
+        if path is None:
+            return 400, {"error": f"bad blob name {name!r}"}, False
+        # same atomic-rename publish as the filesystem ParamStore: a
+        # reader sees the old blob or the new one, never a torn write
+        tmp = os.path.join(self.blob_dir,
+                           f"tmp.{os.getpid()}.{threading.get_ident()}")
+        with open(tmp, "wb") as f:
+            f.write(body)
+        os.replace(tmp, path)
+        return 200, {"ok": True, "name": name,
+                     "bytes": len(body)}, False
+
+    def _blob_delete(self, name: str) -> Tuple[int, Any, bool]:
+        path = self._blob_path(name)
+        if path is None:
+            return 400, {"error": f"bad blob name {name!r}"}, False
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        return 200, {"ok": True, "name": name}, False
+
+    def _lock_acquire(self, req: dict) -> Tuple[int, Any, bool]:
+        """Server-side twin of `ParamStore.lock_global`: O_EXCL create
+        wins; a holder older than `stale_s` is broken by RENAME (never
+        unlink — two breakers racing an unlink could each 'break' a
+        different holder's lock) and the CALLER retries."""
+        name = str(req.get("name") or "global.lock")
+        path = self._blob_path(name)
+        if path is None:
+            return 400, {"error": f"bad lock name {name!r}"}, False
+        stale_s = float(req.get("stale_s") or 10.0)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            try:
+                age = time.time() - os.path.getmtime(path)
+            except OSError:
+                return 200, {"acquired": False, "name": name}, False
+            if age > stale_s:
+                broken = (f"{path}.broken.{os.getpid()}."
+                          f"{next(self._ids)}")
+                try:
+                    os.rename(path, broken)
+                    os.unlink(broken)
+                    record("nodeagent", "lock_stale_break",
+                           host=self.host_name, name=name,
+                           age_s=round(age, 3))
+                except OSError:
+                    pass
+            return 200, {"acquired": False, "name": name}, False
+        with os.fdopen(fd, "w") as f:
+            json.dump({"owner": req.get("owner"),
+                       "ts": round(time.time(), 6)}, f)
+        return 200, {"acquired": True, "name": name}, False
+
+    def _lock_release(self, req: dict) -> Tuple[int, Any, bool]:
+        name = str(req.get("name") or "global.lock")
+        path = self._blob_path(name)
+        if path is None:
+            return 400, {"error": f"bad lock name {name!r}"}, False
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        return 200, {"ok": True, "name": name}, False
+
+    # -- fault plumbing ------------------------------------------------
+    def _faults(self, req: dict) -> Tuple[int, Any, bool]:
+        from .chaos import ChaosInjector, apply_fault_env
+        plan = apply_fault_env(dict(req.get("env") or {}), rank=0)
+        self._chaos = ChaosInjector(plan)
+        return 200, {"ok": True, "host": self.host_name,
+                     "faults": plan.describe()}, False
+
+    def _tick_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._reap()
+                self._maybe_host_kill()
+            except Exception:  # noqa: BLE001 — the tick must survive
+                pass
+            self._stop.wait(self.tick_s)
+
+    def _reap(self) -> None:
+        with self._lock:
+            recs = list(self._procs.values())
+        for rec in recs:
+            rc = rec.proc.poll()
+            if rc is not None and not rec.reaped:
+                rec.reaped = True
+                record("nodeagent", "proc_exit", host=self.host_name,
+                       proc=rec.proc_id, name=rec.name, rc=rc)
+
+    def _maybe_host_kill(self) -> None:
+        if not self._chaos.host_kill_due(self.host_name):
+            return
+        with self._lock:
+            recs = list(self._procs.values())
+        record("nodeagent", "host_kill", host=self.host_name,
+               procs=[r.proc_id for r in recs])
+        maybe_dump("host_kill")
+        for rec in recs:
+            self._kill_tree(rec, signal.SIGKILL)
+        # reap the corpses (poll() collects the zombie) — the tick
+        # loop is about to stop and nothing else would
+        deadline = time.monotonic() + 5.0
+        for rec in recs:
+            while rec.proc.poll() is None \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+        self._stop.set()
+        if self._die_on_kill:
+            os._exit(3)         # the standalone daemon dies with its host
+        # in-process (emulated) agent: go dark so health pollers see
+        # the host down, but leave the owning test process alive
+        srv, self._server = self._server, None
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="nodeagent",
+        description="CaffeOnSpark-TPU per-host NodeAgent daemon")
+    ap.add_argument("-host", dest="host", default="host0",
+                    help="this host's name (labels, HOST_KILL match)")
+    ap.add_argument("-httpHost", dest="http_host", default="127.0.0.1")
+    ap.add_argument("-port", dest="port", type=int, default=0,
+                    help="agent API port (0 = ephemeral)")
+    ap.add_argument("-blobDir", dest="blob_dir", default="",
+                    help="blob-store directory (default: a tempdir)")
+    ap.add_argument("-tick", dest="tick_s", type=float, default=0.25)
+    a = ap.parse_args(argv)
+    agent = NodeAgent(a.host, http_host=a.http_host, port=a.port,
+                      blob_dir=a.blob_dir or None, tick_s=a.tick_s,
+                      die_on_host_kill=True)
+    agent.start()
+    # the boot line: same contract as -serve, so a parent discovers
+    # the ephemeral port from the first stdout JSON line
+    print(json.dumps({"agent": agent.host_name, "port": agent.port,
+                      "pid": os.getpid(), "url": agent.url}),
+          flush=True)
+
+    def _on_term(signum, frame):  # noqa: ARG001
+        record("nodeagent", "sigterm", host=agent.host_name)
+        maybe_dump("sigterm")
+        agent.stop()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    try:
+        while not agent._stop.wait(1.0):
+            pass
+    except KeyboardInterrupt:
+        agent.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

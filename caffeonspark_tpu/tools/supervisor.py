@@ -156,21 +156,46 @@ class Supervisor:
         self.passthrough = passthrough
         self.procs: List[subprocess.Popen] = []
 
+    def _agent_list(self) -> List[str]:
+        raw = getattr(self.args, "agents", None) or ""
+        if not raw:
+            return []
+        from .nodeagent import agent_urls_from_env
+        return agent_urls_from_env(raw)
+
     def _launch(self, rank: int, snapshot: Optional[Tuple[str, str]]
                 ) -> subprocess.Popen:
         a = self.args
         port = getattr(self, "attempt_port", a.port)
-        host = (a.server.rsplit(":", 1)[0] if a.server
-                else "127.0.0.1")
+        if a.server and a.server.startswith("agent://"):
+            # NodeAgent rendezvous: the rank resolves the coordinator
+            # itself (mesh.distributed_init) — no attempt-port math,
+            # the lead agent hands every rank the same address
+            server = a.server
+        else:
+            host = (a.server.rsplit(":", 1)[0] if a.server
+                    else "127.0.0.1")
+            server = f"{host}:{port}"
         cmd = [sys.executable, "-m", "caffeonspark_tpu.mini_cluster",
                "-solver", a.solver, "-output", a.output,
-               "-server", f"{host}:{port}",
+               "-server", server,
                "-cluster", str(a.cluster), "-rank", str(rank)]
         if a.train:
             cmd += ["-train", a.train]
         if snapshot:
             cmd += ["-snapshot", snapshot[0], "-weights", snapshot[1]]
         cmd += self.passthrough
+        agents = self._agent_list()
+        if agents:
+            # host-aware launch: rank r's home agent is agents[r % n],
+            # with failover to the next live one — the AgentProc the
+            # spawn returns walks/talks like a local Popen, so every
+            # poll/teardown path below is unchanged
+            from .nodeagent import agent_env_overlay, spawn_via_agents
+            _, _, proc = spawn_via_agents(
+                agents, cmd, env=agent_env_overlay(),
+                name=f"rank{rank}", start_index=rank)
+            return proc
         return subprocess.Popen(cmd)
 
     def _teardown(self):
@@ -413,7 +438,14 @@ def main(argv=None) -> int:
     ap.add_argument("-poll_interval", type=float, default=1.0)
     ap.add_argument("-server", default=None,
                     help="external coordinator HOST[:PORT] (rank-0 "
-                         "host) for multi-host pods; default local")
+                         "host) for multi-host pods, or "
+                         "agent://HOST:PORT to let that NodeAgent "
+                         "hand out the rendezvous; default local")
+    ap.add_argument("-agents", default=None,
+                    help="comma-separated NodeAgent URLs: ranks are "
+                         "spawned through the agents (rank r's home "
+                         "is agents[r %% n], failing over to live "
+                         "ones) instead of forked locally")
     ap.add_argument("-rank_base", type=int, default=0,
                     help="first global rank hosted here")
     ap.add_argument("-local_ranks", type=int, default=0,

@@ -1,0 +1,711 @@
+"""Multi-host layer (tools/nodeagent.py + the host-aware schedulers).
+
+Everything runs in the multi-process-per-"host" emulation: in-process
+`NodeAgent`s with distinct host names stand in for real hosts, so the
+cross-host behaviors — spawn/monitor/kill over HTTP, the coordinator
+rendezvous, the network ParamStore, respawn-on-a-surviving-host after
+COS_FAULT_HOST_KILL — are all exercised by ordinary CPU tests:
+
+  * agent API: healthz, spawn with boot-line port discovery, tree
+    kill (grandchildren die too), blob atomic publish, server-side
+    lock with stale-break, coordinator idempotence;
+  * `AgentProc`: the Popen surface schedulers consume, incl. the
+    host-lost convention (unreachable agent -> returncode -9);
+  * `HttpParamStore`: same rounds/global/gc/membership semantics as
+    the shared-filesystem store, flaky-storage retry PARITY (the
+    injection stays client-side), async merge-lock stale-break;
+  * two-tier comm-floor model: `tier_wire_bytes` splits intra/inter
+    exposure, `CommFloor` prices them asymmetrically and stays
+    numerically back-compatible when the intra price is 0;
+  * observability: `host` label on router/prom replica samples, the
+    `cos_host_up` gauge, host up/down + host_kill on the recorder;
+  * the kill-a-host fleet drill (slow+chaos): zero client-visible
+    failures, respawn on the surviving agent, incident reconstructed
+    from flight-recorder dumps.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from caffeonspark_tpu.obs.prom import PromWriter, parse_exposition
+from caffeonspark_tpu.obs.recorder import load_dump_dir, maybe_dump
+from caffeonspark_tpu.parallel.syncmode import (HttpParamStore,
+                                                ParamStore,
+                                                resolve_policy)
+from caffeonspark_tpu.tools import chaos
+from caffeonspark_tpu.tools.nodeagent import (AGENT_ERRORS,
+                                              HOST_LOST_RC, AgentProc,
+                                              NodeAgent, agent_call,
+                                              agent_env_overlay,
+                                              agent_urls_from_env,
+                                              resolve_coordinator,
+                                              spawn_via_agents)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def agent(tmp_path):
+    a = NodeAgent("hostA", blob_dir=str(tmp_path / "blobs"),
+                  tick_s=0.05).start()
+    yield a
+    a.stop()
+
+
+# A child that spawns a grandchild sleeper, reports the grandchild's
+# pid through the boot JSON line (as "port" — the discovery channel
+# under test), then sleeps: killing the TREE must reap both.
+_TREE_CHILD = (
+    "import json,subprocess,sys,time;"
+    "g=subprocess.Popen([sys.executable,'-c','import time;"
+    "time.sleep(120)']);"
+    "print(json.dumps({'serving':True,'port':g.pid}),flush=True);"
+    "time.sleep(120)")
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+# =========================================================================
+# client helpers
+# =========================================================================
+def test_agent_urls_from_env_normalization(monkeypatch):
+    monkeypatch.setenv("COS_AGENTS",
+                       "hostA:9001, http://b:9002/ ,,https://c:9003")
+    assert agent_urls_from_env() == [
+        "http://hostA:9001", "http://b:9002", "https://c:9003"]
+    monkeypatch.delenv("COS_AGENTS")
+    assert agent_urls_from_env() == []
+    assert agent_urls_from_env("x:1") == ["http://x:1"]
+
+
+def test_agent_env_overlay_forwards_scheduler_knobs(monkeypatch):
+    monkeypatch.setenv("COS_FAULT_STEP_DELAY_MS", "7")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("HOME_BREW_SECRET", "no")
+    env = agent_env_overlay({"COS_SYNC_MODE": "async"})
+    assert env["COS_FAULT_STEP_DELAY_MS"] == "7"
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["COS_SYNC_MODE"] == "async"
+    assert "HOME_BREW_SECRET" not in env
+    # the checkout rides along so agents exec -m caffeonspark_tpu...
+    assert REPO in env["PYTHONPATH"].split(os.pathsep)
+
+
+# =========================================================================
+# agent API
+# =========================================================================
+def test_healthz_and_unknown_route(agent):
+    doc = agent_call(agent.url, "/healthz")
+    assert doc["agent"] and doc["host"] == "hostA"
+    assert doc["port"] == agent.port
+    with pytest.raises(OSError, match="HTTP 400"):
+        agent_call(agent.url, "/v1/spawn", data={"argv": "not-a-list"})
+    with pytest.raises(OSError, match="HTTP 500"):
+        # handler catches in-route errors and answers 500, not a hang
+        agent_call(agent.url, "/v1/spawn",
+                   data={"argv": ["/no/such/binary-xyz"]})
+    assert agent_call(agent.url, "/v1/nope") is None       # 404 -> None
+
+
+def test_spawn_port_discovery_and_tree_kill(agent):
+    doc = agent_call(agent.url, "/v1/spawn",
+                     data={"argv": [sys.executable, "-c", _TREE_CHILD],
+                           "env": {}, "name": "tree"})
+    proc = AgentProc(agent.url, doc["proc"], pid=doc["pid"])
+    # boot-line discovery: the agent tails stdout for the port field
+    deadline = time.monotonic() + 20
+    gpid = None
+    while time.monotonic() < deadline and gpid is None:
+        gpid = proc.info().get("port")
+        time.sleep(0.05)
+    assert gpid, "boot JSON line never surfaced through /v1/procs"
+    assert _pid_alive(doc["pid"]) and _pid_alive(gpid)
+    assert proc.poll() is None
+    with pytest.raises(subprocess.TimeoutExpired):
+        proc.wait(timeout=0.2)
+    proc.kill()                       # delivered to the process GROUP
+    assert proc.wait(timeout=10) == -signal.SIGKILL
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and _pid_alive(gpid):
+        time.sleep(0.05)
+    assert not _pid_alive(gpid), "tree kill orphaned the grandchild"
+    # proc table keeps the corpse observable (rc, not vanished)
+    table = agent_call(agent.url, "/v1/procs")["procs"]
+    assert table[doc["proc"]]["alive"] is False
+
+
+def test_agentproc_host_lost_reads_as_dead(tmp_path):
+    a = NodeAgent("ghost", blob_dir=str(tmp_path / "b"),
+                  tick_s=0.05).start()
+    doc = agent_call(a.url, "/v1/spawn",
+                     data={"argv": [sys.executable, "-c",
+                                    "import time; time.sleep(60)"]})
+    proc = AgentProc(a.url, doc["proc"], pid=doc["pid"])
+    assert proc.poll() is None
+    a.stop()                              # the host goes dark
+    assert proc.poll() == HOST_LOST_RC
+    assert proc.returncode == HOST_LOST_RC
+    proc.kill()                           # must not raise once lost
+
+
+def test_spawn_via_agents_fails_over_to_live_host(agent):
+    dead = "http://127.0.0.1:1"           # nothing listens on :1
+    url, host, proc = spawn_via_agents(
+        [dead, agent.url],
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        name="r0")
+    assert url == agent.url and host == "hostA"
+    assert proc.poll() is None
+    proc.kill()
+    proc.wait(timeout=10)
+    with pytest.raises(RuntimeError, match="no live NodeAgent"):
+        spawn_via_agents([dead], ["true"])
+
+
+def test_coordinator_rendezvous_idempotent(agent):
+    docs = [agent_call(agent.url, "/v1/coordinator") for _ in range(3)]
+    addrs = {d["coordinator"] for d in docs}
+    assert len(addrs) == 1                # one answer for every rank
+    addr = addrs.pop()
+    host, port = addr.rsplit(":", 1)
+    assert host == "127.0.0.1" and int(port) > 0
+    # the agent:// -server form resolves to the same address
+    spec = f"agent://127.0.0.1:{agent.port}"
+    assert resolve_coordinator(spec) == addr
+    assert resolve_coordinator("10.0.0.7:555") == "10.0.0.7:555"
+    with pytest.raises(RuntimeError, match="rendezvous"):
+        resolve_coordinator("agent://127.0.0.1:1", timeout_s=0.5)
+
+
+def test_blob_roundtrip_list_delete_and_bad_names(agent):
+    assert agent_call(agent.url, "/v1/blob/absent", raw=True) is None
+    agent_call(agent.url, "/v1/blob/a.npz", data=b"\x00payload",
+               method="PUT")
+    assert agent_call(agent.url, "/v1/blob/a.npz",
+                      raw=True) == b"\x00payload"
+    agent_call(agent.url, "/v1/blob/hb_rank0.json", data=b"{}",
+               method="PUT")
+    assert agent_call(agent.url, "/v1/blobs")["names"] == [
+        "a.npz", "hb_rank0.json"]
+    agent_call(agent.url, "/v1/blob/a.npz", method="DELETE")
+    assert agent_call(agent.url, "/v1/blobs")["names"] == [
+        "hb_rank0.json"]
+    # traversal / hidden names are rejected, not resolved
+    for bad in (".dotfile", "a/b"):
+        with pytest.raises(OSError, match="HTTP 400"):
+            agent_call(agent.url, f"/v1/blob/{bad}", data=b"x",
+                       method="PUT")
+
+
+def test_agent_lock_acquire_busy_stale_break(agent):
+    def lock(stale_s=60.0):
+        return agent_call(agent.url, "/v1/lock",
+                          data={"name": "global.lock", "owner": 0,
+                                "stale_s": stale_s})["acquired"]
+
+    assert lock() is True
+    assert lock() is False                # held -> busy
+    agent_call(agent.url, "/v1/unlock", data={"name": "global.lock"})
+    assert lock() is True                 # released -> free again
+    # stale-break: backdate the holder, the next contender breaks the
+    # lock (rename+unlink) and RE-ACQUIRES on its following attempt
+    path = os.path.join(agent.blob_dir, "global.lock")
+    old = time.time() - 120
+    os.utime(path, (old, old))
+    assert lock(stale_s=10.0) is False    # the break itself
+    assert lock(stale_s=10.0) is True     # re-acquire through O_EXCL
+
+
+# =========================================================================
+# HttpParamStore: the network ParamStore transport
+# =========================================================================
+def _http_store(agent, rank, chaos_inj=None, **env):
+    os.environ.update({"COS_SYNC_MODE": "local_sgd", **env})
+    try:
+        pol = resolve_policy()
+    finally:
+        for k in ("COS_SYNC_MODE", *env):
+            os.environ.pop(k, None)
+    return HttpParamStore(agent.url, rank, pol, chaos=chaos_inj)
+
+
+def test_http_param_store_rounds_global_gc_parity(agent):
+    """The test_param_store_rounds_and_global contract, verbatim, over
+    the agent blob transport: rounds, membership, versioned global,
+    GC — nothing above the I/O primitives may behave differently."""
+    s0, s1 = _http_store(agent, 0), _http_store(agent, 1)
+    f0 = {"ip::weight": np.ones((4,), np.float32)}
+    f1 = {"ip::weight": 3 * np.ones((4,), np.float32)}
+    s0.publish_round(2, f0)
+    s1.publish_round(2, f1)
+    assert s0.round_ranks(2) == [0, 1]
+    conts = s0.read_round(2)
+    np.testing.assert_allclose(
+        (conts[0]["ip::weight"] + conts[1]["ip::weight"]) / 2, 2.0)
+    assert s0.latest_global_meta() is None
+    s0.publish_global(2, 8, [0, 1], conts[0])
+    g = s1.load_global()
+    assert g["iter"] == 8 and g["version"] == 2
+    assert g["members"] == [0, 1]
+    np.testing.assert_array_equal(g["params"]["ip::weight"],
+                                  f0["ip::weight"])
+    s0.publish_global(7, 28, [0], f0)
+    s0.publish_global(8, 32, [0], f0)
+    names = agent_call(agent.url, "/v1/blobs")["names"]
+    assert not any(n.startswith("global_v00000002") for n in names)
+    assert not any(n.startswith("round_00000002") for n in names)
+
+
+def test_http_param_store_heartbeats_membership(agent):
+    s0 = _http_store(agent, 0, COS_SYNC_HEARTBEAT_TIMEOUT_S="0.4")
+    s1 = _http_store(agent, 1, COS_SYNC_HEARTBEAT_TIMEOUT_S="0.4")
+    s0.heartbeat(5, force=True)
+    s1.heartbeat(3, force=True)
+    assert s0.live_ranks() == {0: 5, 1: 3}
+    s1.heartbeat(9, done=True)
+    assert s0.live_ranks() == {0: 5}
+    assert s0.members()[1]["done"]
+
+
+def test_http_param_store_retries_flaky_storage(monkeypatch, agent):
+    """Retry PARITY with the fs store's flaky-storage test: the
+    injection point is the CLIENT-side `_retry` the transport
+    inherits, so p=0.4 flakiness is absorbed identically — same
+    knobs, same rounds, same survival."""
+    monkeypatch.setenv("COS_FAULT_FLAKY_STORAGE", "0.4")
+    monkeypatch.setenv("COS_FAULT_SEED", "7")
+    inj = chaos.ChaosInjector(chaos.resolve(0))
+    s = _http_store(agent, 0, chaos_inj=inj)
+    x = {"ip::weight": np.ones((8,), np.float32)}
+    for rnd in range(6):
+        s.publish_round(rnd, x)
+        got = s.read_round(rnd)[0]
+        np.testing.assert_array_equal(got["ip::weight"],
+                                      x["ip::weight"])
+    assert inj.injected["storage_faults"] > 0
+
+
+def test_http_merge_lock_stale_break_semantics(agent):
+    """The async merge lock over HTTP: held -> False; a holder that
+    died mid-merge (stale mtime) is broken server-side and the NEXT
+    attempt re-acquires — ParamStore.lock_global's exact contract."""
+    s0, s1 = _http_store(agent, 0), _http_store(agent, 1)
+    assert s0.lock_global() is True
+    assert s1.lock_global() is False      # held, fresh -> busy
+    path = os.path.join(agent.blob_dir, "global.lock")
+    old = time.time() - (ParamStore.LOCK_STALE_S + 60)
+    os.utime(path, (old, old))
+    assert s1.lock_global() is False      # this attempt BREAKS it
+    assert s1.lock_global() is True       # ... and this one wins it
+    s1.unlock_global()
+    assert s0.lock_global() is True
+    # an unreachable agent reads as "busy", never an exception
+    dead = _http_store(agent, 2)
+    dead.root = "http://127.0.0.1:1"
+    assert dead.lock_global() is False
+
+
+def test_make_sync_routes_http_store(monkeypatch, tmp_path, agent):
+    from caffeonspark_tpu.parallel.syncmode import make_sync
+    monkeypatch.setenv("COS_SYNC_MODE", "local_sgd")
+    monkeypatch.setenv("COS_SYNC_STORE", agent.url)
+    pol = resolve_policy()
+    assert pol.describe()["store"] == agent.url
+    sync = make_sync(pol, str(tmp_path), 0)
+    assert isinstance(sync.store, HttpParamStore)
+    assert sync.store.root == agent.url
+    monkeypatch.delenv("COS_SYNC_STORE")
+    sync = make_sync(resolve_policy(), str(tmp_path), 0)
+    assert type(sync.store) is ParamStore
+
+
+# =========================================================================
+# two-tier comm-floor model
+# =========================================================================
+def test_tier_wire_bytes_splits_intra_inter():
+    from caffeonspark_tpu.parallel.gradsync import build_plan
+    from caffeonspark_tpu.net import Net, NetState, Phase
+    from caffeonspark_tpu.proto import NetParameter
+    from tests.test_gradsync import NET
+    net = Net(NetParameter.from_text(NET), NetState(phase=Phase.TRAIN))
+    flat = build_plan(net, "bucket", bucket_mb=0.5)
+    # non-hier: nothing is intra-host, all exposure rides the fabric
+    assert flat.tier_wire_bytes() == (0, flat.exposed_wire_bytes())
+    assert flat.tier_wire_bytes(local_size=4) == \
+        (0, flat.exposed_wire_bytes(local_size=4))
+    hier = build_plan(net, "hier", bucket_mb=0.5)
+    # hier with one rank per host degenerates to the flat exchange
+    assert hier.tier_wire_bytes(local_size=1) == \
+        (0, hier.exposed_wire_bytes(local_size=1))
+    intra, inter = hier.tier_wire_bytes(local_size=4, hide_bytes=0)
+    # inter-host: the 1/local-sized shard exchange; intra-host: the
+    # reduce-scatter + all-gather passes (2x the full exposure)
+    assert inter == hier.exposed_wire_bytes(local_size=4,
+                                            hide_bytes=0)
+    assert intra == 2 * hier.exposed_wire_bytes(local_size=1,
+                                                hide_bytes=0)
+    assert inter < intra
+
+
+def test_comm_floor_asymmetric_and_back_compat():
+    from caffeonspark_tpu.parallel.gradsync import build_plan
+    from caffeonspark_tpu.net import Net, NetState, Phase
+    from caffeonspark_tpu.proto import NetParameter
+    from tests.test_gradsync import NET
+    net = Net(NetParameter.from_text(NET), NetState(phase=Phase.TRAIN))
+    hier = build_plan(net, "hier", bucket_mb=0.5)
+    floor = chaos.CommFloor(ns_per_byte=8.0, lat_us=0.0, local=4,
+                            hide_bytes=0, intra_ns_per_byte=0.05)
+    intra, inter = hier.tier_wire_bytes(local_size=4, hide_bytes=0)
+    assert floor.active
+    assert floor.sleep_seconds(hier) == pytest.approx(
+        (inter * 8.0 + intra * 0.05) / 1e9)
+    # intra price 0: numerically identical to the pre-two-tier model
+    legacy = chaos.CommFloor(ns_per_byte=8.0, lat_us=3.0, local=4,
+                             hide_bytes=0)
+    assert legacy.sleep_seconds(hier) == pytest.approx(
+        (inter * 8.0 + hier.n_messages * 3.0 * 1e3) / 1e9)
+    # an intra-only floor still counts as active injection
+    assert chaos.CommFloor(0.0, 0.0, 1, None,
+                           intra_ns_per_byte=0.05).active
+
+
+def test_comm_floor_env_round_trip(monkeypatch):
+    monkeypatch.setenv("COS_FAULT_COMM_NS_PER_BYTE", "8")
+    monkeypatch.setenv("COS_FAULT_COMM_INTRA_NS_PER_BYTE", "0.05")
+    monkeypatch.setenv("COS_FAULT_COMM_LOCAL", "4")
+    plan = chaos.resolve(0)
+    d = plan.describe()
+    assert d["comm_floor"]["intra_ns_per_byte"] == 0.05
+    assert d["comm_floor"]["local"] == 4
+    monkeypatch.delenv("COS_FAULT_COMM_INTRA_NS_PER_BYTE")
+    d = chaos.resolve(0).describe()     # quiet when the knob is unset
+    assert "intra_ns_per_byte" not in d["comm_floor"]
+
+
+# =========================================================================
+# COS_FAULT_HOST_KILL
+# =========================================================================
+def test_host_kill_knob_parse_and_one_shot_latch(monkeypatch,
+                                                 tmp_path):
+    marker = str(tmp_path / "hk.marker")
+    monkeypatch.setenv("COS_FAULT_HOST_KILL", f"hostB:{marker}")
+    plan = chaos.resolve(0)
+    assert plan.active and plan.host_kill == ("hostB", marker)
+    assert plan.describe()["host_kill"] == {"host": "hostB"}
+    inj = chaos.ChaosInjector(plan)
+    assert not inj.host_kill_due("hostA")     # someone else's host
+    assert inj.host_kill_due("hostB")         # fires ...
+    assert inj.injected["host_kills"] == 1
+    assert not inj.host_kill_due("hostB")     # ... exactly once
+    # a later process (respawn) latches on the same marker
+    inj2 = chaos.ChaosInjector(chaos.resolve(0))
+    assert not inj2.host_kill_due("hostB")
+    monkeypatch.setenv("COS_FAULT_HOST_KILL", "hostB:")   # no marker
+    with pytest.raises(ValueError, match="HOST_KILL"):
+        chaos.resolve(0)
+
+
+def test_agent_host_kill_goes_dark_and_dumps(monkeypatch, tmp_path):
+    """The scripted host failure: POST /v1/faults schedules
+    COS_FAULT_HOST_KILL on a live agent; its tick thread dumps the
+    flight recorder, SIGKILLs every child tree, and goes dark — an
+    in-process (emulated) agent closes its server so health pollers
+    see the host down."""
+    dumps = tmp_path / "dumps"
+    dumps.mkdir()
+    monkeypatch.setenv("COS_RECORDER_DUMP", str(dumps))
+    a = NodeAgent("hostK", blob_dir=str(tmp_path / "b"),
+                  tick_s=0.05).start()
+    doc = agent_call(a.url, "/v1/spawn",
+                     data={"argv": [sys.executable, "-c",
+                                    "import time; time.sleep(60)"]})
+    child_pid = doc["pid"]
+    marker = str(tmp_path / "hk.marker")
+    out = agent_call(a.url, "/v1/faults",
+                     data={"env": {"COS_FAULT_HOST_KILL":
+                                   f"hostK:{marker}"}})
+    assert out["faults"]["host_kill"] == {"host": "hostK"}
+    deadline = time.monotonic() + 10
+    dark = False
+    while time.monotonic() < deadline and not dark:
+        try:
+            agent_call(a.url, "/healthz", timeout=1.0)
+            time.sleep(0.05)
+        except AGENT_ERRORS:
+            dark = True
+    assert dark, "agent kept answering after its host was killed"
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and _pid_alive(child_pid):
+        time.sleep(0.05)
+    assert not _pid_alive(child_pid)
+    assert os.path.exists(marker)             # the one-shot latch
+    evs = load_dump_dir(str(dumps))
+    kinds = {(e["source"], e["event"]) for e in evs}
+    assert ("nodeagent", "host_kill") in kinds
+    assert ("chaos", "host_kill") in kinds
+    a.stop()                                  # idempotent after dark
+
+
+# =========================================================================
+# observability: host labels + cos_host_up
+# =========================================================================
+def test_router_metrics_carry_host_label():
+    from caffeonspark_tpu.serving.router import Router
+    r = Router()
+    r.add_replica("replica0", "http://127.0.0.1:1", host="hostA")
+    r.add_replica("replica1", "http://127.0.0.1:2")
+    reps = r.metrics_summary()["replicas"]
+    assert reps["replica0"]["host"] == "hostA"
+    assert "host" not in reps["replica1"]     # local fleets unlabeled
+    # a post-host-kill respawn lands on a NEW host: update_url moves
+    # the label with the endpoint
+    r.update_url("replica0", "http://127.0.0.1:3", host="hostB")
+    assert r.metrics_summary()["replicas"]["replica0"]["host"] == \
+        "hostB"
+    r.update_url("replica0", "http://127.0.0.1:4")   # host unchanged
+    assert r.metrics_summary()["replicas"]["replica0"]["host"] == \
+        "hostB"
+
+
+def test_prom_renders_cos_host_up_and_host_labels():
+    w = PromWriter()
+    w.add_summary(
+        {"replicas": {"replica0": {"state": "ok", "outstanding": 0,
+                                   "host": "hostA"},
+                      "replica1": {"state": "ok", "outstanding": 0}},
+         "hosts": {"hostA": {"up": True}, "hostB": {"up": False}}})
+    text = w.render()
+    fams = parse_exposition(text)             # raises on duplicates
+    host_up = {labels["host"]: value
+               for labels, value in fams["cos_host_up"]["samples"]}
+    assert host_up == {"hostA": 1.0, "hostB": 0.0}
+    outst = {labels["replica"]: labels
+             for labels, _ in
+             fams["cos_replica_outstanding"]["samples"]}
+    assert outst["replica0"]["host"] == "hostA"
+    assert "host" not in outst["replica1"]    # local replica unlabeled
+
+
+# =========================================================================
+# the kill-a-host fleet drill (slow + chaos)
+# =========================================================================
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_host_kill_drill(tmp_path, monkeypatch):
+    """The acceptance drill: a 2-replica fleet spread over two emulated
+    hosts; COS_FAULT_HOST_KILL takes hostA (agent + replica tree) out
+    under offered load.  Zero client-visible failures, the replica
+    respawns on the SURVIVING agent, cos_host_up flips, and the whole
+    incident reconstructs from flight-recorder dumps."""
+    from caffeonspark_tpu.serving import Fleet
+    from caffeonspark_tpu.serving.router import OK
+    from tests.test_serving_fleet import (NET_TMPL, SOLVER_TMPL,
+                                          _constant_model,
+                                          _dict_record, _fleet_env)
+    dumps = tmp_path / "dumps"
+    dumps.mkdir()
+    monkeypatch.setenv("COS_RECORDER_DUMP", str(dumps))
+    net_path = tmp_path / "net.prototxt"
+    net_path.write_text(NET_TMPL.format(root=tmp_path))
+    solver_path = tmp_path / "solver.prototxt"
+    solver_path.write_text(SOLVER_TMPL.format(net=net_path))
+    model = _constant_model(tmp_path, str(solver_path), str(net_path),
+                            0.0, "m.caffemodel")
+    a = NodeAgent("hostA", blob_dir=str(tmp_path / "ba"),
+                  tick_s=0.05).start()
+    b = NodeAgent("hostB", blob_dir=str(tmp_path / "bb"),
+                  tick_s=0.05).start()
+    fleet = Fleet(["-conf", str(solver_path), "-model", model,
+                   "-features", "ip"],
+                  replicas=2, env=_fleet_env(str(tmp_path / "aot")),
+                  poll_interval_s=0.1, agents=[a.url, b.url])
+    fleet.start()
+    try:
+        # placement: replica i's home is agents[i % n]
+        reps = fleet.router.metrics_summary()["replicas"]
+        assert reps["replica0"]["host"] == "hostA"
+        assert reps["replica1"]["host"] == "hostB"
+        errors, counts = [], [0] * 3
+        stop_evt = threading.Event()
+        rec = _dict_record()
+
+        def client(i):
+            while not stop_evt.is_set():
+                try:
+                    out = fleet.router.predict({"records": [rec]})
+                    assert out["rows"][0]["ip"] == [0.0] * 10
+                    counts[i] += 1
+                except Exception as e:  # noqa: BLE001 — count them
+                    errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True) for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.8)
+        # the fault: schedule the host kill on agent A itself
+        marker = str(tmp_path / "hk.marker")
+        agent_call(a.url, "/v1/faults",
+                   data={"env": {"COS_FAULT_HOST_KILL":
+                                 f"hostA:{marker}"}})
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            reps = fleet.router.metrics_summary()["replicas"]
+            if (reps["replica0"].get("host") == "hostB"
+                    and fleet.router.states()["replica0"] == OK):
+                break
+            time.sleep(0.2)
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors[:3]        # zero failed requests
+        assert sum(counts) > 20
+        # respawned on the surviving host, healthy, correct answers
+        reps = fleet.router.metrics_summary()["replicas"]
+        assert reps["replica0"]["host"] == "hostB"
+        assert fleet.router.states()["replica0"] == OK
+        assert fleet.restarts() == 1
+        out = fleet.router.predict({"records": [rec]})
+        assert out["rows"][0]["ip"] == [0.0] * 10
+        # the host view: hostA down, hostB up (what cos_host_up eats)
+        deadline = time.monotonic() + 15
+        hosts = {}
+        while time.monotonic() < deadline:
+            hosts = fleet.metrics_summary().get("hosts") or {}
+            if hosts and not hosts.get("hostA", {}).get("up", True):
+                break
+            time.sleep(0.2)
+        assert hosts["hostA"]["up"] is False
+        assert hosts["hostB"]["up"] is True
+        w = PromWriter()
+        w.add_summary(fleet.metrics_summary())
+        ups = {labels["host"]: value
+               for labels, value in parse_exposition(
+                   w.render())["cos_host_up"]["samples"]}
+        assert ups == {"hostA": 0.0, "hostB": 1.0}
+    finally:
+        fleet.stop()
+        b.stop()
+        a.stop()
+    # incident reconstruction: the agent dumped at the kill, the
+    # scheduler's ring dumps now, load_dump_dir merges the timeline
+    maybe_dump("drill_done")
+    evs = load_dump_dir(str(dumps))
+    kinds = {(e["source"], e["event"]) for e in evs}
+    for want in (("nodeagent", "host_kill"), ("fleet", "host_down"),
+                 ("fleet", "replica_died"),
+                 ("fleet", "replica_rejoined")):
+        assert want in kinds, (want, sorted(kinds))
+    rejoin = [e for e in evs if e["event"] == "replica_rejoined"][-1]
+    assert rejoin["host"] == "hostB"
+
+
+# =========================================================================
+# cross-host training entry points (slow)
+# =========================================================================
+@pytest.mark.slow
+def test_supervisor_launches_ranks_via_agents(tmp_path):
+    """-agents turns the supervisor into a host-aware scheduler: rank
+    r's home is agents[r % n] and the returned handle is an AgentProc
+    whose Popen surface the relaunch loop consumes unchanged."""
+    import argparse
+    from caffeonspark_tpu.tools.supervisor import Supervisor
+    a = NodeAgent("hostA", blob_dir=str(tmp_path / "ba"),
+                  tick_s=0.05).start()
+    b = NodeAgent("hostB", blob_dir=str(tmp_path / "bb"),
+                  tick_s=0.05).start()
+    try:
+        args = argparse.Namespace(
+            solver="unused.prototxt", output=str(tmp_path / "out"),
+            cluster=2, server=None, port=0, train=None,
+            agents=f"{a.url},{b.url}")
+        sup = Supervisor(args, [])
+        p0 = sup._launch(0, None)
+        p1 = sup._launch(1, None)
+        assert isinstance(p0, AgentProc) and isinstance(p1, AgentProc)
+        assert p0.agent_url == a.url and p1.agent_url == b.url
+        # the spawned argv is a real mini_cluster rank command; kill
+        # them before they get far (the solver file is a decoy)
+        for p in (p0, p1):
+            p.kill()
+            p.wait(timeout=20)
+    finally:
+        b.stop()
+        a.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["local_sgd", "async"])
+def test_relaxed_convergence_digits_over_agent_store(tmp_path, mode,
+                                                     agent):
+    """The convergence gate of test_relaxed_modes_convergence_on_real_
+    digits, with the ParamStore on the agent blob transport instead of
+    a shared filesystem: relaxed sync over HTTP must still reach
+    reference accuracy on real handwritten digits."""
+    pytest.importorskip("sklearn")
+    import jax.numpy as jnp
+    from caffeonspark_tpu.parallel.syncmode import make_sync
+    from caffeonspark_tpu.proto import NetParameter, SolverParameter
+    from caffeonspark_tpu.solver import Solver
+    from tests.test_gradsync import (DIGITS_NET, DIGITS_SOLVER,
+                                     _digits_problem)
+    from tests.test_syncmode import _digits_accuracy, _digits_worker
+    X, y = _digits_problem()
+    s = Solver(SolverParameter.from_text(DIGITS_SOLVER),
+               NetParameter.from_text(DIGITS_NET))
+    p, st = s.init()
+    step = s.jit_train_step()
+    rng = np.random.RandomState(0)
+    for i in range(240):
+        idx = rng.randint(0, X.shape[0], 64)
+        p, st, _ = step(p, st, {"data": jnp.asarray(X[idx]),
+                                "label": jnp.asarray(y[idx])},
+                        s.step_rng(i))
+    ref = _digits_accuracy(p, s.train_net, X, y)
+    assert ref >= 0.93
+
+    def mk(rank):
+        os.environ.update({"COS_SYNC_MODE": mode, "COS_SYNC_K": "10",
+                           "COS_SYNC_STALENESS": "10",
+                           "COS_SYNC_ROUND_TIMEOUT_S": "20"})
+        try:
+            pol = resolve_policy()
+        finally:
+            for k in ("COS_SYNC_MODE", "COS_SYNC_K",
+                      "COS_SYNC_STALENESS",
+                      "COS_SYNC_ROUND_TIMEOUT_S"):
+                os.environ.pop(k, None)
+        return make_sync(pol, str(tmp_path), rank,
+                         store_root=agent.url)
+
+    syncs = [mk(r) for r in (0, 1)]
+    assert all(isinstance(sy.store, HttpParamStore) for sy in syncs)
+    out, err = {}, {}
+    ts = [threading.Thread(target=_digits_worker,
+                           args=(r, syncs[r], X, y, 240, 10, out,
+                                 err)) for r in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=300)
+    assert not err, err
+    assert syncs[0].counts["exchanges"] >= 10
+    if mode == "async":
+        assert max(sy.max_gap for sy in syncs) <= 10
+    acc = _digits_accuracy(*out[0], X, y)
+    assert acc >= ref - 0.03, (mode, acc, ref)
+    assert acc >= 0.90, (mode, acc)

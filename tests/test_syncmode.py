@@ -296,9 +296,14 @@ def test_local_sgd_two_ranks_average(tmp_path):
     p = {0: {"ip::w": np.full((3,), 2.0, np.float32)},
          1: {"ip::w": np.full((3,), 6.0, np.float32)}}
     out, its = {}, {}
+    # first heartbeats land BEFORE either thread runs: if rank 0's
+    # whole exchange outran rank 1's on_start, rank 0's live_ranks()
+    # saw only itself and solo-averaged (the known cross-run flake —
+    # real trainers heartbeat from iter 0, long before a boundary)
+    s0.on_start(0)
+    s1.on_start(0)
 
     def run(sync, r):
-        sync.on_start(0)
         its[r] = sync.maybe_exchange(
             4, lambda: p[r], lambda f: out.__setitem__(r, f))
 
