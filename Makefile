@@ -13,6 +13,7 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   bench-gradsync bench-syncmode bench-scaling bench-autotune \
   bench-deploy \
   bench-obs bench-tail bench-prodday prodday-smoke chaos \
+  bench-autoscale \
   chaos-deploy onchip-artifacts docs clean
 
 build: native install
@@ -166,6 +167,16 @@ bench-prodday:
 	$(CPU_ENV) $(PY) scripts/bench_prodday.py \
 	  --out bench_evidence/bench_prodday.json
 
+# fleet control plane: offered-load staircase over a real 1-replica
+# fleet, static vs SLO-driven AutoScaler (scale decisions read back
+# from the flight recorder), plus the admission-lane starvation
+# drill (interactive p99 alone vs under a batch-lane flood); ALWAYS
+# exits 0 with one JSON document on stdout (bench.py contract)
+bench-autoscale:
+	mkdir -p bench_evidence
+	$(CPU_ENV) $(PY) scripts/bench_autoscale.py \
+	  --out bench_evidence/bench_autoscale.json
+
 # tier-1-safe smoke day (<60s): scenarios/prodday_smoke.json only,
 # no deploy faults, no A/B cell
 prodday-smoke:
@@ -250,6 +261,8 @@ bench-evidence:
 	  --out bench_evidence/bench_obs.json
 	-$(CPU_ENV) $(PY) scripts/bench_tail.py \
 	  --out bench_evidence/bench_tail.json
+	-$(CPU_ENV) $(PY) scripts/bench_autoscale.py \
+	  --out bench_evidence/bench_autoscale.json
 	-$(CPU_ENV) $(PY) scripts/bench_prodday.py \
 	  --out bench_evidence/bench_prodday.json
 

@@ -207,6 +207,39 @@ class PromWriter:
                         "1 = NodeAgent heartbeat answering",
                         1.0 if st.get("up") else 0.0,
                         dict(base, host=sanitize(hname)))
+        # fleet control plane: the autoscaler's own actuation signal
+        # (cos_fleet_size is what a dashboard overlays on qdepth/p99
+        # to SEE the controller react)
+        fl = summary.get("fleet")
+        if fl:
+            if fl.get("size") is not None:
+                self.sample("fleet_size", "gauge",
+                            "replicas in the routing table",
+                            fl["size"], base)
+            if fl.get("routable") is not None:
+                self.sample("fleet_routable", "gauge",
+                            "replicas currently routable (state=ok)",
+                            fl["routable"], base)
+            for k in ("scale_ups", "scale_downs", "restarts"):
+                if fl.get(k) is not None:
+                    self.sample(f"fleet_{k}_total", "counter",
+                                f"fleet {k}", fl[k], base)
+        # admission lanes: depth gauge + outcome counters per priority
+        # class — the starvation check is cos_lane_forwarded_total
+        # {lane="batch"} rising while interactive p99 holds
+        for lname, st in (summary.get("lanes") or {}).items():
+            ll = dict(base, lane=sanitize(lname))
+            self.sample("lane_depth", "gauge",
+                        "rows queued in the admission lane",
+                        st.get("depth", 0), ll)
+            for k, v in st.items():
+                # lifetime outcome counters ride flat in the block
+                # (lanes_summary): everything but the live gauges
+                if k in ("depth", "entries") \
+                        or not isinstance(v, (int, float)):
+                    continue
+                self.sample(f"lane_{sanitize(k)}_total", "counter",
+                            f"admission lane {k}", v, ll)
 
     # -- rendering -----------------------------------------------------
     def render(self) -> str:

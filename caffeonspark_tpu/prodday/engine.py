@@ -63,6 +63,7 @@ class FleetStack:
             raise ValueError("FleetStack needs a controller or fleet")
         self.controller = controller
         self.fleet = fleet
+        self.autoscaler = None
         self._round_lock = threading.Lock()
 
     # -- lifecycle ----------------------------------------------------
@@ -73,9 +74,19 @@ class FleetStack:
             self.fleet = self.controller.fleet
         elif not self.fleet.replicas:
             self.fleet.start()
+        # COS_AS_ENABLE=1 closes the control loop for the day: the
+        # autoscaler reads the router's scrape signals and drives the
+        # Fleet scale verbs (knobs resolve inside the controller)
+        from ..serving.autoscale import AutoScaler
+        self.autoscaler = AutoScaler.from_env(self.fleet)
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         return self
 
     def stop(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+            self.autoscaler = None
         if self.controller is not None:
             self.controller.stop()
             self.fleet = None
@@ -91,7 +102,15 @@ class FleetStack:
         id rides in as the parent ctx so the request's attempt spans
         land under an id the harness can query back."""
         from ..serving.router import RouterRequestError
-        query = f"model={tenant.model}" if tenant.model else ""
+        parts = []
+        if tenant.model:
+            parts.append(f"model={tenant.model}")
+        # admission-class routing rides the query string; replicas
+        # without COS_LANES simply ignore both params
+        if getattr(tenant, "lane", None):
+            parts.append(f"lane={tenant.lane}")
+            parts.append(f"tenant={tenant.name}")
+        query = "&".join(parts)
         trace = SpanCtx(trace_id, "0" * 16) if trace_id else None
         try:
             self.fleet.router.predict(payload, query=query,

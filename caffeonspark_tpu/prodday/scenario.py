@@ -272,15 +272,17 @@ def _num(obj, key, what, path, *, default=None, lo=None, hi=None,
 
 
 class Tenant:
-    __slots__ = ("name", "weight", "model")
+    __slots__ = ("name", "weight", "model", "lane")
 
     def __init__(self, name: str, weight: float,
-                 model: Optional[str] = None):
+                 model: Optional[str] = None,
+                 lane: Optional[str] = None):
         self.name, self.weight, self.model = name, weight, model
+        self.lane = lane
 
     def to_dict(self) -> dict:
         return {"name": self.name, "weight": self.weight,
-                "model": self.model}
+                "model": self.model, "lane": self.lane}
 
 
 class LoadSpec:
@@ -372,7 +374,7 @@ def _validate_tenants(arr, path: str, what: str) -> List[Tenant]:
         tw = f"{what} tenant[{i}]"
         if not isinstance(t, dict):
             raise _err(f"{tw}: must be an object", arr, i, path)
-        _check_keys(t, {"name", "weight", "model"}, tw, path)
+        _check_keys(t, {"name", "weight", "model", "lane"}, tw, path)
         name = t.get("name")
         if not isinstance(name, str) or not name:
             raise _err(f"{tw}: 'name' must be a non-empty string",
@@ -383,7 +385,11 @@ def _validate_tenants(arr, path: str, what: str) -> List[Tenant]:
         if model is not None and not isinstance(model, str):
             raise _err(f"{tw}: 'model' must be a string or null",
                        t, "model", path)
-        out.append(Tenant(name, weight, model))
+        lane = t.get("lane")
+        if lane is not None and lane not in ("interactive", "batch"):
+            raise _err(f"{tw}: 'lane' must be \"interactive\" or "
+                       "\"batch\"", t, "lane", path)
+        out.append(Tenant(name, weight, model, lane))
     return out
 
 

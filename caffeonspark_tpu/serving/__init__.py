@@ -14,10 +14,18 @@ fixed per-dispatch cost over dynamically formed micro-batches
 docs/architecture.md §serving).
 
 The fleet layer (router.py / fleet.py / aot.py / retry.py) scales the
-single-process stack out: a least-outstanding request router over N
-replica processes with health/draining states, retry with jittered
-backoff, rolling hot-swap, restart-on-death, and AOT warm start via
-the persistent compilation cache (docs/architecture.md §fleet).
+single-process stack out: a throughput-weighted least-outstanding
+request router over N replica processes with health/draining states,
+retry with jittered backoff, rolling hot-swap, restart-on-death, and
+AOT warm start via the persistent compilation cache
+(docs/architecture.md §fleet).
+
+The control plane (autoscale.py / admission.py) closes the loop over
+that mechanics layer: an SLO-driven autoscaler that grows and drains
+the fleet from the router's own scrape, and lane-based admission
+control (interactive vs batch priority classes, per-tenant quotas,
+deadline-aware EDF shedding with Retry-After hints) in front of the
+micro-batcher (docs/architecture.md §fleet-control-plane).
 """
 
 from .batcher import (DeadlineExceeded, FlushLanes, MicroBatcher,
@@ -37,8 +45,11 @@ from .http_server import ServingHTTPServer
 from .router import (NoReplicaAvailable, RouterRequestError,
                      RouteRetryable, Router, RouterHTTPServer)
 from .fleet import Fleet, ReplicaProcess, serve_replicas
+from .admission import AdmissionController
+from .autoscale import AutoScaler
 
 __all__ = [
+    "AdmissionController", "AutoScaler",
     "BlobForward", "Client", "DEFAULT_MODEL", "DeadlineExceeded",
     "Fleet", "FlushLanes", "InferenceService", "MicroBatcher",
     "ModelRegistry", "ModelVersion", "NoReplicaAvailable",

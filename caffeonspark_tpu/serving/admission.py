@@ -1,0 +1,508 @@
+"""Priority-class admission control in front of the flush lanes.
+
+PR 12's FlushLanes isolate models from each other; this layer
+generalizes the idea one level up, to REQUEST CLASSES.  Every predict
+is admitted into one of two priority classes — `interactive` (the
+default: a caller is blocked on the answer) or `batch` (offline
+`extract_features`-scale scoring that shares the serving capacity
+pool) — and a single dispatcher forwards admitted work into the
+per-model MicroBatcher lanes in strict priority order: batch work is
+forwarded only while no interactive work waits AND the underlying
+lane sits below a watermark (one flush's worth), so a batch backlog
+can never starve interactive traffic of queue capacity.
+
+Within a class, order is EDF (earliest deadline first): the heap key
+is the request deadline, so when the class is over its depth cap the
+controller sheds the LATEST-deadline work — the request with the most
+slack to retry later — instead of blindly 429ing whichever request
+arrived after the queue filled ("RPC Considered Harmful": under
+overload, WHAT you refuse matters more than that you refuse).  A shed
+answer carries a drain estimate (queued rows / the lane's measured
+service rate) that becomes the 429's Retry-After.  Expired entries
+are answered with DeadlineExceeded at the heap head, never silently
+dropped — the batcher's salvage rule, applied before forwarding.
+
+Per-tenant quotas (`COS_LANE_TENANT_QUOTA`) bound how much of a class
+one tenant may queue, so a single runaway client cannot convert the
+whole class into its own backlog.
+
+Knobs (resolved ONCE at construction — COS003):
+
+  COS_LANES                  1 enables the controller (default 0: the
+                             service keeps the exact pre-admission
+                             submit path, byte-identical)
+  COS_LANE_INTERACTIVE_DEPTH queued-row cap, interactive (default 256)
+  COS_LANE_BATCH_DEPTH       queued-row cap, batch (default 128)
+  COS_LANE_TENANT_QUOTA      queued-row cap per tenant per class
+                             (default 0 = unlimited)
+  COS_LANE_BATCH_WATERMARK   underlying lane depth above which batch
+                             forwarding pauses (default 0 = the target
+                             lane's max_batch: one flush staged ahead)
+  COS_LANE_RETRY_AFTER_CAP_S Retry-After estimate ceiling (default 5;
+                             resolved by the service, which applies it
+                             inside drain_estimate_s)
+
+Every shed is observable: a `fleet.shed` flight-recorder event, a
+`serve.shed` trace span when the request carries a ctx, and
+`lane_shed_*` counters / the `lanes` metrics block (`cos_lane_depth`
+in the prom rendering).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..obs.recorder import record as record_event
+from ..obs.trace import get_tracer
+from .batcher import (DeadlineExceeded, QueueFullError, ServingStopped,
+                      _env_int)
+
+LANES = ("interactive", "batch")
+DEFAULT_LANE = "interactive"
+
+
+def queue_full(msg: str,
+               retry_after_s: Optional[float] = None) -> QueueFullError:
+    """QueueFullError carrying the shedding lane's drain estimate —
+    retry.retry_call and the HTTP 429 mapping both read the
+    `retry_after_s` attribute (absent/None = no hint)."""
+    err = QueueFullError(msg)
+    err.retry_after_s = retry_after_s
+    return err
+
+
+class _Entry:
+    """One admitted HTTP-request-or-submit worth of records: admitted,
+    shed, expired, and forwarded as a unit (all-or-nothing, the
+    submit_many rule)."""
+
+    __slots__ = ("records", "timeout_ms", "deadline", "model", "trace",
+                 "lane", "tenant", "seq", "event", "pendings", "error",
+                 "dead", "t_admit")
+
+    def __init__(self, records, timeout_ms, deadline, model, trace,
+                 lane, tenant, seq):
+        self.records = records
+        self.timeout_ms = timeout_ms
+        self.deadline = deadline      # time.monotonic() or None
+        self.model = model
+        self.trace = trace
+        self.lane = lane
+        self.tenant = tenant
+        self.seq = seq
+        self.event = threading.Event()
+        self.pendings: Optional[List[Any]] = None
+        self.error: Optional[BaseException] = None
+        self.dead = False             # lazily removed from the heap
+        self.t_admit = time.monotonic()
+
+    def fail(self, err: BaseException) -> None:
+        self.error = err
+        self.event.set()
+
+    def key(self) -> float:
+        return self.deadline if self.deadline is not None \
+            else float("inf")
+
+
+class AdmittedResult:
+    """Caller-side handle, PendingResult-shaped: wait() blocks first on
+    admission (forward or shed), then on the underlying flush."""
+
+    def __init__(self, entry: _Entry, index: int):
+        self._entry = entry
+        self._index = index
+
+    def wait(self, timeout: Optional[float] = None):
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        if not self._entry.event.wait(timeout):
+            raise TimeoutError("request still queued for admission")
+        if self._entry.error is not None:
+            raise self._entry.error
+        rem = None if deadline is None \
+            else max(0.0, deadline - time.monotonic())
+        return self._entry.pendings[self._index].wait(rem)
+
+    def done(self) -> bool:
+        if not self._entry.event.is_set():
+            return False
+        if self._entry.error is not None:
+            return True
+        return self._entry.pendings[self._index].done()
+
+    @property
+    def model_version(self):
+        if self._entry.pendings is None:
+            return None
+        return self._entry.pendings[self._index].model_version
+
+
+class AdmissionController:
+    """Two EDF heaps + one dispatcher thread over an InferenceService's
+    flush lanes.  All knobs resolve at construction; the per-request
+    path touches only the controller's own lock."""
+
+    def __init__(self, service, *,
+                 interactive_depth: Optional[int] = None,
+                 batch_depth: Optional[int] = None,
+                 tenant_quota: Optional[int] = None,
+                 batch_watermark: Optional[int] = None):
+        self._service = service
+        self.interactive_depth = max(1, int(
+            interactive_depth if interactive_depth is not None
+            else _env_int("COS_LANE_INTERACTIVE_DEPTH", 256)))
+        self.batch_depth = max(1, int(
+            batch_depth if batch_depth is not None
+            else _env_int("COS_LANE_BATCH_DEPTH", 128)))
+        self.tenant_quota = max(0, int(
+            tenant_quota if tenant_quota is not None
+            else _env_int("COS_LANE_TENANT_QUOTA", 0)))
+        self.batch_watermark = max(0, int(
+            batch_watermark if batch_watermark is not None
+            else _env_int("COS_LANE_BATCH_WATERMARK", 0)))
+        self._caps = {"interactive": self.interactive_depth,
+                      "batch": self.batch_depth}
+        self._tracer = get_tracer()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # heap items: (deadline_key, seq, _Entry) — seq breaks ties so
+        # entries are never compared; dead entries are skipped on pop
+        self._heaps: Dict[str, list] = {lane: [] for lane in LANES}
+        self._seq = 0
+        self._counts = {lane: {"admitted": 0, "forwarded": 0,
+                               "shed": 0, "shed_quota": 0,
+                               "expired": 0} for lane in LANES}
+        self._stopping = False
+        self._drain = True
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_env(cls, service) -> Optional["AdmissionController"]:
+        """COS_LANES=1 builds the controller; default off keeps the
+        pre-admission submit path byte-identical."""
+        if _env_int("COS_LANES", 0) != 1:
+            return None
+        return cls(service)
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> "AdmissionController":
+        assert self._thread is None, "admission already started"
+        self._stopping = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="cos-serve-admission",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, join_timeout: float = 60.0):
+        """With drain, everything admitted is still forwarded before
+        the dispatcher exits; else queued entries fail with
+        ServingStopped.  New admits are rejected either way."""
+        with self._cond:
+            self._drain = drain
+            self._stopping = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout)
+            self._thread = None
+        failed: List[_Entry] = []
+        with self._lock:
+            for lane in LANES:
+                for _, _, e in self._heaps[lane]:
+                    if not e.dead:
+                        e.dead = True
+                        failed.append(e)
+                self._heaps[lane].clear()
+        for e in failed:
+            e.fail(ServingStopped("serving stopped"))
+
+    # -- admit --------------------------------------------------------
+    def submit(self, record, *, lane: str = DEFAULT_LANE,
+               tenant: Optional[str] = None,
+               timeout_ms: Optional[float] = None,
+               model: Optional[str] = None,
+               trace=None) -> AdmittedResult:
+        return self.submit_many([record], lane=lane, tenant=tenant,
+                                timeout_ms=timeout_ms, model=model,
+                                trace=trace)[0]
+
+    def submit_many(self, records: Sequence[Any], *,
+                    lane: str = DEFAULT_LANE,
+                    tenant: Optional[str] = None,
+                    timeout_ms: Optional[float] = None,
+                    model: Optional[str] = None,
+                    trace=None) -> List[AdmittedResult]:
+        """Admit one request's records as a unit into `lane`, shedding
+        by deadline when the class is over its cap.  Raises
+        QueueFullError (with `retry_after_s`) when the NEWCOMER is the
+        right thing to shed, ValueError on an unknown lane or a
+        malformed record, KeyError on an unknown model."""
+        if lane not in LANES:
+            raise ValueError(f"unknown lane {lane!r} (classes: "
+                             f"{', '.join(LANES)})")
+        svc = self._service
+        if svc.draining:
+            raise ServingStopped("replica is draining")
+        sm = svc._served(model)
+        from .service import coerce_record
+        coerced = [r if isinstance(r, tuple)
+                   else coerce_record(r, sm.record_dims())
+                   for r in records]
+        if not coerced:
+            raise ValueError("empty record list")
+        tmo = timeout_ms if timeout_ms is not None \
+            else svc._lane_kw.get("default_timeout_ms")
+        now = time.monotonic()
+        deadline = now + tmo / 1e3 if tmo is not None else None
+        victim: Optional[_Entry] = None
+        shed_reason: Optional[str] = None
+        with self._lock:
+            if self._stopping:
+                raise ServingStopped("serving is stopping")
+            expired = self._prune_locked(lane, now)
+            heap = self._heaps[lane]
+            live_rows = sum(len(e.records) for _, _, e in heap
+                            if not e.dead)
+            if (self.tenant_quota and tenant
+                    and self._tenant_rows_locked(lane, tenant)
+                    + len(coerced) > self.tenant_quota):
+                self._counts[lane]["shed_quota"] += 1
+                self._counts[lane]["shed"] += 1
+                shed_reason = "tenant_quota"
+            elif live_rows + len(coerced) > self._caps[lane]:
+                # EDF shed: drop the latest-deadline work — the entry
+                # with the most slack to come back later
+                latest = max((e for _, _, e in heap if not e.dead),
+                             key=lambda e: e.key(), default=None)
+                new_key = deadline if deadline is not None \
+                    else float("inf")
+                if latest is not None and new_key < latest.key():
+                    latest.dead = True
+                    victim = latest
+                    self._counts[lane]["shed"] += 1
+                    self._seq += 1
+                    entry = _Entry(coerced, tmo, deadline, model,
+                                   trace, lane, tenant, self._seq)
+                    heapq.heappush(heap, (entry.key(), entry.seq,
+                                          entry))
+                    self._counts[lane]["admitted"] += 1
+                    self._cond.notify()
+                else:
+                    self._counts[lane]["shed"] += 1
+                    shed_reason = "class_full"
+            else:
+                self._seq += 1
+                entry = _Entry(coerced, tmo, deadline, model, trace,
+                               lane, tenant, self._seq)
+                heapq.heappush(heap, (entry.key(), entry.seq, entry))
+                self._counts[lane]["admitted"] += 1
+                self._cond.notify()
+        self._fail_expired(expired)
+        if victim is not None:
+            self._shed_entry(victim, "edf_preempted")
+        if shed_reason is not None:
+            est = self.drain_estimate_s(lane, model=model)
+            self._note_shed(lane, tenant, shed_reason, trace, est)
+            raise queue_full(
+                f"{lane} class at capacity "
+                f"({self._caps[lane]} rows) — load shed "
+                f"({shed_reason})", retry_after_s=est)
+        svc.metrics.incr(f"lane_admitted_{lane}", len(coerced))
+        return [AdmittedResult(entry, i)
+                for i in range(len(coerced))]
+
+    # -- shed/expire plumbing -----------------------------------------
+    def _tenant_rows_locked(self, lane: str, tenant: str) -> int:
+        return sum(len(e.records) for _, _, e in self._heaps[lane]
+                   if not e.dead and e.tenant == tenant)
+
+    def _prune_locked(self, lane: str, now: float) -> List[_Entry]:
+        """Pop dead and expired entries off the heap head (EDF keys
+        mean expired work is always a prefix); expired entries are
+        returned for failing OUTSIDE the lock."""
+        heap = self._heaps[lane]
+        expired: List[_Entry] = []
+        while heap:
+            key, _, e = heap[0]
+            if e.dead:
+                heapq.heappop(heap)
+            elif e.deadline is not None and now > e.deadline:
+                heapq.heappop(heap)
+                e.dead = True
+                self._counts[lane]["expired"] += 1
+                expired.append(e)
+            else:
+                break
+        return expired
+
+    def _fail_expired(self, expired: List[_Entry]) -> None:
+        for e in expired:
+            self._service.metrics.incr(f"lane_expired_{e.lane}")
+            e.fail(DeadlineExceeded(
+                "deadline passed while queued for admission "
+                f"(lane {e.lane})"))
+
+    def _shed_entry(self, e: _Entry, reason: str) -> None:
+        est = self.drain_estimate_s(e.lane, model=e.model)
+        self._note_shed(e.lane, e.tenant, reason, e.trace, est)
+        e.fail(queue_full(
+            f"{e.lane} class at capacity — shed for "
+            f"earlier-deadline work ({reason})", retry_after_s=est))
+
+    def _note_shed(self, lane: str, tenant: Optional[str],
+                   reason: str, trace, est: float) -> None:
+        self._service.metrics.incr(f"lane_shed_{lane}")
+        record_event("fleet", "shed", lane=lane, tenant=tenant,
+                     reason=reason,
+                     retry_after_ms=round(est * 1e3, 1))
+        if trace is not None:
+            self._tracer.record_span("serve.shed", trace, 0.0,
+                                     lane=lane, reason=reason)
+
+    # -- drain estimate -----------------------------------------------
+    def queued_rows(self, lane: str) -> int:
+        with self._lock:
+            return sum(len(e.records) for _, _, e in self._heaps[lane]
+                       if not e.dead)
+
+    def drain_estimate_s(self, lane: str,
+                         model: Optional[str] = None) -> float:
+        """Seconds until work admitted NOW would forward: rows queued
+        at-or-above this class's priority plus the underlying lane
+        depth, over the lane's measured service rate.  Capped — a
+        Retry-After hint must bound the client's patience, not model
+        a whole outage."""
+        rows = self.queued_rows("interactive")
+        if lane == "batch":
+            rows += self.queued_rows("batch")
+        return self._service.drain_estimate_s(model=model,
+                                              extra_rows=rows)
+
+    # -- dispatcher ---------------------------------------------------
+    def _underlying_depth(self, model: Optional[str]) -> int:
+        from .registry import DEFAULT_MODEL
+        lane = self._service.lanes.get(model or DEFAULT_MODEL)
+        return lane.depth() if lane is not None else 0
+
+    def _batch_watermark_for(self, model: Optional[str]) -> int:
+        if self.batch_watermark:
+            return self.batch_watermark
+        from .registry import DEFAULT_MODEL
+        lane = self._service.lanes.get(model or DEFAULT_MODEL)
+        return lane.max_batch if lane is not None \
+            else self._service.batcher.max_batch
+
+    def _pop_locked(self, now: float
+                    ) -> (Optional[_Entry]):
+        """Next entry in strict priority order: interactive first;
+        batch only when no interactive work waits and the target lane
+        sits below the watermark (so a batch backlog never fills the
+        queue interactive arrivals need).  Expired entries are pruned
+        (and failed by the caller via _prune side lists)."""
+        heap = self._heaps["interactive"]
+        if heap:
+            _, _, e = heap[0]
+            heapq.heappop(heap)
+            return e
+        heap = self._heaps["batch"]
+        if heap:
+            _, _, e = heap[0]
+            if self._underlying_depth(e.model) \
+                    <= self._batch_watermark_for(e.model):
+                heapq.heappop(heap)
+                return e
+        return None
+
+    def _loop(self) -> None:
+        while True:
+            expired: List[_Entry] = []
+            entry: Optional[_Entry] = None
+            exiting = stop_no_drain = False
+            with self._cond:
+                now = time.monotonic()
+                for lane in LANES:
+                    expired += self._prune_locked(lane, now)
+                entry = self._pop_locked(now)
+                if entry is None and self._stopping:
+                    # drain mode exits only once the heaps are truly
+                    # empty (a watermark-gated batch head is still
+                    # owed its forward); no-drain exits immediately
+                    live = any(not e.dead
+                               for lane in LANES
+                               for _, _, e in self._heaps[lane])
+                    exiting = not live or not self._drain
+                if entry is not None and self._stopping \
+                        and not self._drain:
+                    entry.dead = True
+                    stop_no_drain = True
+                if entry is None and not exiting and not expired:
+                    # bounded wait: batch may be watermark-gated with
+                    # no admit ever arriving to notify us
+                    self._cond.wait(0.02)
+            self._fail_expired(expired)
+            if entry is None:
+                if exiting:
+                    break
+                continue
+            if stop_no_drain:
+                entry.fail(ServingStopped("serving stopped"))
+                continue
+            self._forward(entry)
+
+    def _forward(self, entry: _Entry) -> None:
+        svc = self._service
+        now = time.monotonic()
+        if entry.deadline is not None and now > entry.deadline:
+            self._service.metrics.incr(f"lane_expired_{entry.lane}")
+            with self._lock:
+                self._counts[entry.lane]["expired"] += 1
+            entry.fail(DeadlineExceeded(
+                "deadline passed while queued for admission "
+                f"(lane {entry.lane})"))
+            return
+        rem_ms = None
+        if entry.deadline is not None:
+            rem_ms = max(1.0, (entry.deadline - now) * 1e3)
+        try:
+            pendings = svc.submit_many(entry.records,
+                                       timeout_ms=rem_ms,
+                                       model=entry.model,
+                                       trace=entry.trace)
+        except QueueFullError:
+            # the underlying lane is momentarily full: put the entry
+            # back (its deadline key re-sorts it) and yield briefly —
+            # admission backpressure, not a shed
+            with self._cond:
+                heapq.heappush(self._heaps[entry.lane],
+                               (entry.key(), entry.seq, entry))
+            time.sleep(0.002)
+            return
+        except BaseException as e:     # noqa: BLE001 — per-entry fault
+            entry.fail(e)
+            return
+        with self._lock:
+            self._counts[entry.lane]["forwarded"] += 1
+        svc.metrics.incr(f"lane_forwarded_{entry.lane}",
+                         len(entry.records))
+        entry.pendings = pendings
+        entry.event.set()
+
+    # -- reporting ----------------------------------------------------
+    def lanes_summary(self) -> Dict[str, dict]:
+        """The `lanes` metrics block: per-class live depth + lifetime
+        counters (prom renders `cos_lane_depth{lane=...}` and the shed
+        counters from exactly this)."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for lane in LANES:
+                live = [e for _, _, e in self._heaps[lane]
+                        if not e.dead]
+                out[lane] = dict(self._counts[lane],
+                                 depth=sum(len(e.records)
+                                           for e in live),
+                                 entries=len(live))
+        return out

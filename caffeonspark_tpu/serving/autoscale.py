@@ -1,0 +1,243 @@
+"""SLO-driven fleet autoscaler: the control loop over Fleet verbs.
+
+PR 15 gave the fleet a nervous system — the router measures every
+replica's success latency, polls every replica's queue depth, and
+aggregates both into its own scrape.  This module closes the loop:
+a controller thread watches the router's OWN view (no extra polling
+of replicas — the signals are already in the replica table) against
+a stated SLO and turns breaches into `Fleet.scale_up()` and sustained
+headroom into `Fleet.scale_down()`.  AOT warm start (PR 8) is what
+makes the loop reactive enough to matter: a scale-up warms on
+compilation-cache hits and serves in seconds, so capacity can follow
+a flash crowd instead of being provisioned for it.
+
+Signals (read each interval, all router-side):
+  * p99     — router-observed success latency over the aggregate
+              ring (`Router.latency_p99_ms`), vs COS_SLO_P99_MS
+  * qdepth  — fleet queue pressure: every routable replica's
+              last-polled batcher depth + router-side in-flight
+              (`Router.queue_pressure`), vs COS_SLO_QDEPTH
+
+Anti-flap discipline (all resolved ONCE at construction — COS003):
+  * hysteresis — scale up after COS_AS_UP_BREACHES consecutive
+    breached intervals; scale down only after COS_AS_DOWN_INTERVALS
+    consecutive intervals BELOW COS_AS_DOWN_MARGIN x the SLO (a gap
+    band between the up and down thresholds, so the controller never
+    oscillates around a single line);
+  * cooldowns — COS_AS_UP_COOLDOWN_S / COS_AS_DOWN_COOLDOWN_S between
+    actions, and a scale-up resets the down clock (capacity just
+    added must prove itself before being taken away);
+  * bounds — fleet size stays within [COS_AS_MIN, COS_AS_MAX].
+
+Scale-down is always the drain path (`Fleet.scale_down`: drain →
+wait-idle → terminate), so shrinking the fleet never fails a request.
+Every decision is observable: an `autoscale.decision` flight-recorder
+event with the signals that drove it (the Fleet verbs add their own
+`fleet.scale_up` / `fleet.scale_down` events), and the fleet-size
+gauge rides the router scrape as `cos_fleet_size`.
+
+Knobs:
+  COS_SLO_P99_MS         p99 target, ms (0 = p99 signal off)
+  COS_SLO_QDEPTH         queue-pressure target, rows (0 = off)
+  COS_AS_MIN             size floor (default 1)
+  COS_AS_MAX             size ceiling (default 8)
+  COS_AS_INTERVAL_S      control interval (default 1.0)
+  COS_AS_WINDOW_S        p99 observation window (default 30; only
+                         samples this young count, so the breach
+                         signal decays with the load that caused it)
+  COS_AS_UP_BREACHES     consecutive breaches before up (default 2)
+  COS_AS_UP_COOLDOWN_S   min gap between scale-ups (default 5)
+  COS_AS_DOWN_MARGIN     healthy = below margin x SLO (default 0.5)
+  COS_AS_DOWN_INTERVALS  consecutive healthy intervals (default 10)
+  COS_AS_DOWN_COOLDOWN_S min gap between scale-downs (default 20)
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from ..obs.recorder import record as record_event
+from .batcher import _env_int, _env_num
+
+_LOG = logging.getLogger(__name__)
+
+
+class AutoScaler:
+    """One controller thread over one Fleet.  `step()` is a single
+    control decision (exposed for deterministic tests); `start()`
+    runs it every COS_AS_INTERVAL_S."""
+
+    def __init__(self, fleet, *,
+                 slo_p99_ms: Optional[float] = None,
+                 slo_qdepth: Optional[int] = None,
+                 min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 interval_s: Optional[float] = None,
+                 window_s: Optional[float] = None,
+                 up_breaches: Optional[int] = None,
+                 up_cooldown_s: Optional[float] = None,
+                 down_margin: Optional[float] = None,
+                 down_intervals: Optional[int] = None,
+                 down_cooldown_s: Optional[float] = None,
+                 wait_idle_s: float = 60.0):
+        self.fleet = fleet
+        self.slo_p99_ms = max(0.0, float(
+            slo_p99_ms if slo_p99_ms is not None
+            else _env_num("COS_SLO_P99_MS", 0.0)))
+        self.slo_qdepth = max(0, int(
+            slo_qdepth if slo_qdepth is not None
+            else _env_int("COS_SLO_QDEPTH", 0)))
+        self.min_replicas = max(1, int(
+            min_replicas if min_replicas is not None
+            else _env_int("COS_AS_MIN", 1)))
+        self.max_replicas = max(self.min_replicas, int(
+            max_replicas if max_replicas is not None
+            else _env_int("COS_AS_MAX", 8)))
+        self.interval_s = max(0.05, float(
+            interval_s if interval_s is not None
+            else _env_num("COS_AS_INTERVAL_S", 1.0)))
+        self.window_s = max(self.interval_s, float(
+            window_s if window_s is not None
+            else _env_num("COS_AS_WINDOW_S", 30.0)))
+        self.up_breaches = max(1, int(
+            up_breaches if up_breaches is not None
+            else _env_int("COS_AS_UP_BREACHES", 2)))
+        self.up_cooldown_s = max(0.0, float(
+            up_cooldown_s if up_cooldown_s is not None
+            else _env_num("COS_AS_UP_COOLDOWN_S", 5.0)))
+        self.down_margin = min(0.95, max(0.05, float(
+            down_margin if down_margin is not None
+            else _env_num("COS_AS_DOWN_MARGIN", 0.5))))
+        self.down_intervals = max(1, int(
+            down_intervals if down_intervals is not None
+            else _env_int("COS_AS_DOWN_INTERVALS", 10)))
+        self.down_cooldown_s = max(0.0, float(
+            down_cooldown_s if down_cooldown_s is not None
+            else _env_num("COS_AS_DOWN_COOLDOWN_S", 20.0)))
+        self.wait_idle_s = wait_idle_s
+        self._breaches = 0
+        self._idles = 0
+        self._t_last_up = float("-inf")
+        self._t_last_down = float("-inf")
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_env(cls, fleet) -> Optional["AutoScaler"]:
+        """COS_AS_ENABLE=1 attaches the controller (stacks read this
+        once at fleet start).  Default off: a fleet without a stated
+        opt-in behaves exactly as before this module existed."""
+        if _env_int("COS_AS_ENABLE", 0) != 1:
+            return None
+        return cls(fleet)
+
+    def enabled(self) -> bool:
+        """A controller with no SLO stated has nothing to control."""
+        return self.slo_p99_ms > 0 or self.slo_qdepth > 0
+
+    # -- control loop -------------------------------------------------
+    def step(self, now: Optional[float] = None) -> Optional[str]:
+        """One control decision: observe the router's signals, update
+        the hysteresis counters, maybe act.  Returns "up" / "down" /
+        None — tests drive this directly for determinism."""
+        if not self.enabled():
+            return None
+        now = time.monotonic() if now is None else now
+        router = self.fleet.router
+        p99 = router.latency_p99_ms(window_s=self.window_s)
+        qdepth = router.queue_pressure()
+        size = len(self.fleet.replicas)
+        breach = ((self.slo_p99_ms > 0 and p99 > self.slo_p99_ms)
+                  or (self.slo_qdepth > 0
+                      and qdepth > self.slo_qdepth))
+        healthy = ((self.slo_p99_ms <= 0
+                    or p99 < self.down_margin * self.slo_p99_ms)
+                   and (self.slo_qdepth <= 0
+                        or qdepth < self.down_margin
+                        * self.slo_qdepth))
+        if breach:
+            self._breaches += 1
+            self._idles = 0
+        elif healthy:
+            self._idles += 1
+            self._breaches = 0
+        else:
+            # the hysteresis gap band: neither counter accumulates
+            self._breaches = 0
+            self._idles = 0
+        if (self._breaches >= self.up_breaches
+                and size < self.max_replicas
+                and now - self._t_last_up >= self.up_cooldown_s):
+            self._decide("scale_up", p99, qdepth, size)
+            try:
+                self.fleet.scale_up()
+            except Exception as e:    # noqa: BLE001 — keep controlling
+                _LOG.warning("autoscale: scale_up failed: %s", e)
+                record_event("autoscale", "scale_up_failed",
+                             error=f"{type(e).__name__}: {e}")
+                return None
+            # fresh capacity must prove itself before the next action
+            # in EITHER direction
+            self._t_last_up = now
+            self._t_last_down = now
+            self._breaches = 0
+            self._idles = 0
+            return "up"
+        if (self._idles >= self.down_intervals
+                and size > self.min_replicas
+                and now - self._t_last_down >= self.down_cooldown_s):
+            self._decide("scale_down", p99, qdepth, size)
+            try:
+                self.fleet.scale_down(wait_idle_s=self.wait_idle_s)
+            except Exception as e:    # noqa: BLE001 — keep controlling
+                _LOG.warning("autoscale: scale_down failed: %s", e)
+                record_event("autoscale", "scale_down_failed",
+                             error=f"{type(e).__name__}: {e}")
+                return None
+            self._t_last_down = now
+            self._idles = 0
+            return "down"
+        return None
+
+    def _decide(self, action: str, p99: float, qdepth: int,
+                size: int) -> None:
+        """The decision record a post-mortem replays: WHAT the
+        controller saw when it acted, not just that it acted."""
+        record_event("autoscale", "decision", action=action,
+                     p99_ms=round(p99, 3), qdepth=qdepth,
+                     replicas=size,
+                     slo_p99_ms=self.slo_p99_ms,
+                     slo_qdepth=self.slo_qdepth)
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> "AutoScaler":
+        assert self._thread is None, "autoscaler already started"
+        self._stop_evt.clear()
+
+        def loop():
+            while not self._stop_evt.wait(self.interval_s):
+                try:
+                    self.step()
+                except Exception as e:  # noqa: BLE001 — keep looping
+                    _LOG.warning("autoscale step failed: %s", e)
+
+        self._thread = threading.Thread(target=loop,
+                                        name="cos-autoscale",
+                                        daemon=True)
+        self._thread.start()
+        record_event("autoscale", "started",
+                     slo_p99_ms=self.slo_p99_ms,
+                     slo_qdepth=self.slo_qdepth,
+                     min=self.min_replicas, max=self.max_replicas)
+        return self
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            # a step may be mid-scale (blocking on warmup or a drain)
+            self._thread.join(timeout=max(60.0, self.wait_idle_s))
+            self._thread = None
+        record_event("autoscale", "stopped")

@@ -317,6 +317,11 @@ class MicroBatcher:
         self._submit_lock = threading.Lock()
         self._t_start: Optional[float] = None
         self._first_flush_seen = False
+        # measured service rate (rows/s, EWMA over flush completions):
+        # the admission layer's drain estimate and the 429 Retry-After
+        # hint are both derived from this
+        self._rate_ewma = 0.0
+        self._rate_t: Optional[float] = None
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> "MicroBatcher":
@@ -452,6 +457,26 @@ class MicroBatcher:
         except IndexError:
             pass
         return self._q.qsize() + staged
+
+    def rate_rows_s(self) -> float:
+        """Measured service rate (rows/s, EWMA over completed
+        flushes); 0.0 until the first two flushes land."""
+        return self._rate_ewma
+
+    def drain_estimate_s(self, extra_rows: int = 0) -> float:
+        """Seconds to serve everything queued (plus `extra_rows` ahead
+        of a prospective arrival) at the measured rate — the substance
+        of a 429's Retry-After.  With no rate measured yet, assume one
+        full flush per max_wait window (the slowest steady cadence the
+        batcher can settle into)."""
+        rows = self.depth() + max(0, int(extra_rows))
+        if rows <= 0:
+            return 0.0
+        rate = self._rate_ewma
+        if rate <= 0.0:
+            per_flush = max(self.max_wait_s, 1e-3)
+            return -(-rows // self.max_batch) * per_flush
+        return rows / rate
 
     # -- assembler ----------------------------------------------------
     def _loop(self):
@@ -632,6 +657,16 @@ class MicroBatcher:
         m.incr("flushes")
         m.incr(f"flush_bucket_{bucket}")
         m.incr("served_rows", len(live))
+        # service-rate EWMA over flush-completion gaps (only the
+        # executor thread writes these fields)
+        if self._rate_t is not None:
+            dt = done - self._rate_t
+            if dt > 0:
+                inst = len(live) / dt
+                self._rate_ewma = (inst if self._rate_ewma <= 0.0
+                                   else 0.2 * inst
+                                   + 0.8 * self._rate_ewma)
+        self._rate_t = done
         for r, row in zip(live, rows):
             r.complete(row, version)
             m.add("latency", done - r.t_submit)

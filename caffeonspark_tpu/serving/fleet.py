@@ -114,6 +114,10 @@ class ReplicaProcess:
         self.t_spawn: Optional[float] = None
         self.t_ready: Optional[float] = None
         self.restart_count = 0      # lifetime restarts of THIS replica
+        # scale-down marks the replica retired BEFORE draining it, so
+        # the death monitor never resurrects a replica the fleet is
+        # deliberately retiring (terminate looks exactly like a death)
+        self.retired = False
         # what the RUNNING process actually booted with (captured at
         # spawn — serve_args may be repointed after the fork, e.g. by
         # an abandoned roll's verdict repoint racing a respawn)
@@ -349,6 +353,10 @@ class Fleet:
             self.serve_args)
         self.pre_roll_model: Optional[str] = None
         self._roll_active = False
+        # monotonic replica-name/index counter: scale-up never reuses
+        # an index (COS_REPLICA_INDEX targets per-replica chaos, and a
+        # recycled name would alias recorder timelines)
+        self._next_index = self.n
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> "Fleet":
@@ -451,7 +459,7 @@ class Fleet:
 
     def _monitor_once(self):
         for name, rep in list(self.replicas.items()):
-            if rep.alive() or self._stop_evt.is_set():
+            if rep.retired or rep.alive() or self._stop_evt.is_set():
                 continue
             self.router.set_state(name, DOWN)
             # the budget is PER REPLICA: one crash-looping replica
@@ -701,6 +709,113 @@ class Fleet:
     def kill_replica(self, name: str) -> None:
         self.replicas[name].kill()
 
+    # -- elastic fleet size (the autoscaler's verbs) -------------------
+    @staticmethod
+    def _index_of(name: str) -> int:
+        try:
+            return int(name.replace("replica", "") or 0)
+        except ValueError:
+            return 0
+
+    def scale_up(self, count: int = 1) -> List[str]:
+        """Spawn `count` additional replicas and admit each once
+        healthy.  Indexes are monotonic (never recycled), host-aware
+        placement rides the agents round-robin exactly like start(),
+        and the spawn args follow the fleet's COMMITTED default model
+        — a scale-up mid-lineage must serve what the fleet serves,
+        not what the launch argv named.  With COS_AOT_CACHE_DIR the
+        new replica warms on cache hits and serves in seconds."""
+        added: List[str] = []
+        for _ in range(max(1, int(count))):
+            i = self._next_index
+            self._next_index += 1
+            name = f"replica{i}"
+            renv = dict(self.env, COS_REPLICA_INDEX=str(i))
+            args = self.serve_args
+            if self._default_model is not None:
+                args = _args_with_model(self.serve_args,
+                                        self._default_model)
+            if self.agents:
+                rep: ReplicaProcess = AgentReplicaProcess(
+                    name, args, env=renv, agents=self.agents,
+                    agent_index=i)
+            else:
+                rep = ReplicaProcess(name, args, env=renv)
+            t0 = time.monotonic()
+            rep.spawn()
+            self.router.add_replica(name, "http://unbound",
+                                    state=STARTING,
+                                    host=rep.host_name)
+            if not rep.wait_ready(self.startup_timeout_s,
+                                  stop_evt=self._stop_evt):
+                # never admit (or monitor) a replica that failed to
+                # boot: it was not yet in self.replicas, so cleanup
+                # is just the router entry and the process
+                self.router.remove_replica(name)
+                rep.terminate()
+                record_event("fleet", "scale_up_failed", replica=name)
+                raise RuntimeError(
+                    f"fleet: scale-up {name} failed to become "
+                    f"healthy within {self.startup_timeout_s}s")
+            self._republish_models(rep)
+            # registered only now: the monitor must never see a
+            # replica the scale-up might still abandon
+            self.replicas[name] = rep
+            self.router.update_url(name, rep.url,
+                                   host=rep.host_name or None)
+            self.router.set_state(name, OK)
+            self.n += 1
+            wall = time.monotonic() - t0
+            self.metrics.incr("scale_ups")
+            self.metrics.add("replica_startup", wall)
+            record_event("fleet", "scale_up", replica=name,
+                         url=rep.url, wall_s=round(wall, 3),
+                         replicas=self.n,
+                         **({"host": rep.host_name}
+                            if rep.host_name else {}))
+            added.append(name)
+        return added
+
+    def scale_down(self, name: Optional[str] = None,
+                   wait_idle_s: float = 60.0) -> str:
+        """Retire one replica WITHOUT losing a request:
+        drain → wait-idle → terminate (the rolling_reload drain path)
+        — never a SIGTERM with in-flight work.  `name` None retires
+        the highest-index routable replica (LIFO: the most recent
+        scale-up goes first).  The replica is flagged retired before
+        the drain so the death monitor cannot resurrect it, and
+        un-flagged if the drain fails — drain_replica has already put
+        it back in rotation (timeout) or marked it down
+        (unreachable), so the fleet keeps its capacity either way."""
+        if name is None:
+            states = self.router.states()
+            cands = [n for n, r in self.replicas.items()
+                     if not r.retired and states.get(n) == OK]
+            if len(cands) <= 1:
+                raise RuntimeError(
+                    "scale_down: need more than one routable replica "
+                    f"to retire one (routable: {sorted(cands)})")
+            name = max(cands, key=self._index_of)
+        rep = self.replicas.get(name)
+        if rep is None:
+            raise KeyError(f"scale_down: unknown replica {name!r}")
+        rep.retired = True
+        record_event("fleet", "scale_down_start", replica=name)
+        try:
+            self.router.drain_replica(name, wait_idle_s=wait_idle_s)
+        except BaseException:
+            rep.retired = False
+            record_event("fleet", "scale_down_aborted", replica=name)
+            raise
+        rep.terminate()
+        self.router.remove_replica(name)
+        self.replicas.pop(name, None)
+        self.n = max(0, self.n - 1)
+        self.metrics.incr("scale_downs")
+        record_event("fleet", "scale_down", replica=name,
+                     replicas=self.n)
+        return name
+
     def set_replica_fault(self, name: str, env: Dict[str, Optional[str]]
                           ) -> dict:
         """Scripted-chaos hook: flip COS_FAULT_* knobs inside ONE live
@@ -731,8 +846,13 @@ class Fleet:
 
     def metrics_summary(self) -> dict:
         out = self.router.metrics_summary()
-        out["fleet"] = {"replicas": self.n,
-                        "restarts": self._restarts}
+        out["fleet"] = dict(out.get("fleet") or {},
+                            replicas=self.n,
+                            restarts=self._restarts,
+                            scale_ups=self.metrics.get_counter(
+                                "scale_ups"),
+                            scale_downs=self.metrics.get_counter(
+                                "scale_downs"))
         if self.agents:
             # the agent-heartbeat view: host -> up?, what the prom
             # writer renders as cos_host_up{host=...}

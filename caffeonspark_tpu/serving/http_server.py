@@ -53,9 +53,17 @@ Distributed tracing: an inbound `X-COS-Trace: <trace>:<span>` header
 queue-wait / pack / forward / execution spans nest under it.  With
 no header and sampling off (the default) the whole path is inert.
 
-Status mapping: 429 queue-full fast-reject, 504 deadline exceeded,
-400 malformed request, 404 unknown model, 503 draining or model
-failure.
+Status mapping: 429 queue-full fast-reject or admission shed (with a
+`Retry-After` header and `retry_after_s` body field carrying the
+shedding lane's drain estimate), 504 deadline exceeded, 400 malformed
+request, 404 unknown model, 503 draining or model failure.
+
+Admission classes: when the replica runs with COS_LANES=1, a predict
+may name its priority class (`"lane": "interactive"|"batch"` in the
+body, or `?lane=`) and tenant (`"tenant"` / `?tenant=`); requests
+route through the EDF admission controller instead of straight into
+the model's flush lane.  Without the knob the fields are accepted and
+ignored — the wire stays compatible both ways.
 """
 
 from __future__ import annotations
@@ -63,6 +71,7 @@ from __future__ import annotations
 import base64
 import json
 import logging
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -86,11 +95,14 @@ class JsonHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     log_prefix = "http: "
 
-    def _send(self, code: int, payload: dict):
+    def _send(self, code: int, payload: dict,
+              headers: Optional[dict] = None):
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
@@ -385,7 +397,7 @@ class _Handler(JsonHandler):
                 self._send(400, {"error": str(e)})
                 return
         try:
-            out = self._predict_execute(svc, sp, req, model)
+            out = self._predict_execute(svc, sp, req, model, q)
         except BaseException:
             if flight is not None:
                 cache.complete(ckey, flight,
@@ -401,10 +413,40 @@ class _Handler(JsonHandler):
         if out is not None:
             self._finish_predict(svc, sp, out, t_req)
 
-    def _predict_execute(self, svc, sp, req, model):
+    def _send_429(self, svc, e, model):
+        """Shed/queue-full response.  The Retry-After header (and the
+        machine-readable `retry_after_s` body twin the router's
+        body-only transport reads) carries the shedding lane's current
+        drain estimate — a 429 that tells the client WHEN retrying
+        might work, instead of leaving it to blind backoff."""
+        ra = getattr(e, "retry_after_s", None)
+        if ra is None and hasattr(svc, "drain_estimate_s"):
+            try:
+                ra = svc.drain_estimate_s(model=model)
+            except KeyError:
+                ra = None
+        body = {"error": str(e)}
+        headers = None
+        if ra is not None and ra > 0:
+            body["retry_after_s"] = round(float(ra), 3)
+            headers = {"Retry-After": str(max(1, math.ceil(ra)))}
+        self._send(429, body, headers=headers)
+
+    def _predict_execute(self, svc, sp, req, model, q):
         """Parse records, submit, wait; returns the response dict, or
         None after having sent the mapped error response itself."""
         try:
+            # priority class + tenant (admission metadata): popped
+            # BEFORE the single-record fallback below so they never
+            # masquerade as record fields; accepted-and-ignored when
+            # the admission controller is off
+            lane = (req.pop("lane", None) or req.pop("priority", None)
+                    or q.get("lane") or q.get("priority"))
+            tenant = req.pop("tenant", None) or q.get("tenant")
+            if lane is not None and not isinstance(lane, str):
+                raise ValueError("'lane' must be a string")
+            if tenant is not None and not isinstance(tenant, str):
+                raise ValueError("'tenant' must be a string")
             records = req.get("records", [req] if ("data" in req
                                                   or "image_b64" in req)
                               else None)
@@ -420,13 +462,21 @@ class _Handler(JsonHandler):
             timeout_ms = req.get("timeout_ms")
             # all-or-nothing: queue-full must not strand an already-
             # submitted prefix that still executes after the 429
-            pending = svc.submit_many(records, timeout_ms=timeout_ms,
-                                      model=model, trace=sp.ctx)
+            admission = getattr(svc, "admission", None)
+            if admission is not None:
+                pending = admission.submit_many(
+                    records, lane=lane or "interactive",
+                    tenant=tenant, timeout_ms=timeout_ms,
+                    model=model, trace=sp.ctx)
+            else:
+                pending = svc.submit_many(records,
+                                          timeout_ms=timeout_ms,
+                                          model=model, trace=sp.ctx)
         except KeyError as e:
             self._send(404, {"error": str(e)})
             return None
         except QueueFullError as e:
-            self._send(429, {"error": str(e)})
+            self._send_429(svc, e, model)
             return None
         except ServingStopped as e:
             self._send(503, {"error": str(e)})
@@ -438,6 +488,11 @@ class _Handler(JsonHandler):
             rows = [p.wait(svc.http_wait_s) for p in pending]
         except DeadlineExceeded as e:
             self._send(504, {"error": str(e)})
+            return None
+        except QueueFullError as e:
+            # an ADMITTED entry can still be shed later, preempted by
+            # earlier-deadline work — same wire mapping as at admit
+            self._send_429(svc, e, model)
             return None
         except BaseException as e:        # noqa: BLE001 — model fault
             self._send(503, {"error": f"{type(e).__name__}: {e}"})

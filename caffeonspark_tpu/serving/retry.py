@@ -81,6 +81,12 @@ def retry_call(fn: Callable, *,
             last = e
             if on_retry is not None:
                 on_retry(e, attempt)
+            # a server-supplied Retry-After (the shedding lane's drain
+            # estimate, riding the exception as `retry_after_s`) beats
+            # blind jitter — but never sleeps past the backoff ceiling
+            hint = getattr(e, "retry_after_s", None)
+            if hint is not None and hint > 0:
+                delay = min(float(hint), policy.cap_ms / 1e3)
             if delay > 0:
                 sleep(delay)
     try:

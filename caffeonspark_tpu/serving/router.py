@@ -8,11 +8,14 @@ WHERE it queues — keep every replica's micro-batcher fed (more
 co-batching, deeper amortization) without letting any one replica
 build a backlog the others could have absorbed.
 
-  * **Balancing** — least-outstanding-requests: route to the healthy
-    replica with the fewest router-side in-flight requests.  Unlike
+  * **Balancing** — throughput-weighted least-outstanding: route to
+    the healthy replica with the lowest expected queueing cost,
+    (outstanding + 1) x measured per-replica latency EWMA.  Unlike
     round-robin this is self-correcting under heterogeneous replica
-    speed (a slow replica accumulates outstanding and stops being
-    picked until it drains).
+    speed twice over: a slow replica accumulates outstanding AND
+    carries a higher measured latency, so it receives proportionally
+    less traffic instead of merely equal-minus-backlog
+    (COS_ROUTER_WEIGHT=0 restores the unweighted pre-PR-20 pick).
   * **Health / draining** — per-replica state machine
     `starting → ok ⇄ draining → down`: a background poller reads each
     replica's `/healthz` (which reports `ok`/`draining`), and only
@@ -142,10 +145,11 @@ class _LatRing:
     (O(1) add); percentile reads sort a snapshot of <= `capacity`
     floats, cheap at operator/budget cadence."""
 
-    __slots__ = ("_ring", "_cap", "_i", "count", "ewma_ms")
+    __slots__ = ("_ring", "_times", "_cap", "_i", "count", "ewma_ms")
 
     def __init__(self, capacity: int = 512):
         self._ring: List[float] = []
+        self._times: List[float] = []
         self._cap = capacity
         self._i = 0
         self.count = 0
@@ -155,14 +159,28 @@ class _LatRing:
         self.count += 1
         self.ewma_ms = (ms if self.count == 1
                         else 0.2 * ms + 0.8 * self.ewma_ms)
+        now = time.monotonic()
         if len(self._ring) < self._cap:
             self._ring.append(ms)
+            self._times.append(now)
         else:
             self._ring[self._i] = ms
+            self._times[self._i] = now
             self._i = (self._i + 1) % self._cap
 
     def pct_ms(self, p: float) -> float:
         s = sorted(self._ring)
+        n = len(s)
+        return s[min(n - 1, int(p * n))] if n else 0.0
+
+    def pct_ms_window(self, p: float, window_s: float) -> float:
+        """Percentile over only the samples younger than `window_s` —
+        the autoscaler's view, so a quiet fleet's ring full of
+        flash-crowd latencies doesn't read as a still-burning SLO
+        breach long after the load has gone."""
+        cut = time.monotonic() - window_s
+        s = sorted(ms for ms, t in zip(self._ring, self._times)
+                   if t >= cut)
         n = len(s)
         return s[min(n - 1, int(p * n))] if n else 0.0
 
@@ -173,7 +191,8 @@ class _Replica:
     pick must read every replica's outstanding count atomically)."""
 
     __slots__ = ("name", "url", "state", "outstanding", "requests",
-                 "failures", "restarts", "drain_intent", "lat", "host")
+                 "failures", "restarts", "drain_intent", "lat", "host",
+                 "queue_depth")
 
     def __init__(self, name: str, url: str, state: str = STARTING,
                  host: str = ""):
@@ -187,6 +206,7 @@ class _Replica:
         self.restarts = 0
         self.drain_intent = False   # True only for ROUTER-issued drains
         self.lat = _LatRing()       # router-observed success latency
+        self.queue_depth = 0        # replica-side, from /healthz polls
 
 
 class Router:
@@ -211,7 +231,11 @@ class Router:
         # hedged-request knobs, resolved ONCE at construction (COS003).
         # hedge_pct 0 (the default) = hedging off: predict() stays the
         # exact single-leg inline path, no thread, no queue.
-        from .batcher import _env_num
+        from .batcher import _env_int, _env_num
+        # COS_ROUTER_WEIGHT=0 restores the unweighted least-outstanding
+        # pick; on (default), the pick weights by measured per-replica
+        # latency so heterogeneous replicas balance by throughput
+        self.weight_by_latency = _env_int("COS_ROUTER_WEIGHT", 1) != 0
         self.hedge_pct = (hedge_pct if hedge_pct is not None
                           else _env_num("COS_HEDGE_PCT", 0))
         self.hedge_min_ms = max(0.0, hedge_min_ms
@@ -296,15 +320,29 @@ class Router:
             return list(self._replicas)
 
     # -- balancing ----------------------------------------------------
+    def _cost_locked(self, rep: _Replica, fallback_ms: float) -> float:
+        """Expected queueing cost of routing the NEXT request to
+        `rep`: (outstanding + 1) work units x the replica's measured
+        per-request latency EWMA.  A replica with no samples yet
+        scores at the fleet-aggregate EWMA (or a 1 ms unit cost when
+        nothing is measured anywhere), so cold replicas compete on
+        outstanding alone — identical to the unweighted pick."""
+        ewma = rep.lat.ewma_ms
+        if ewma <= 0.0:
+            ewma = fallback_ms
+        return (rep.outstanding + 1) * ewma
+
     def _pick(self, avoid: Optional[str] = None) -> _Replica:
-        """Least-outstanding among `ok` replicas; the outstanding
-        increment happens under the same lock as the choice, so two
-        concurrent picks never both see the same idle replica as
-        free.  Ties rotate round-robin (a fixed tie-break would pin
-        idle traffic to one replica), and `avoid` steers a RETRY away
-        from the replica that just bounced it — a 429 means that
-        replica's queue is full NOW; re-picking it inside the backoff
-        window would mostly re-bounce."""
+        """Lowest-cost among `ok` replicas — throughput-weighted
+        least-outstanding (see _cost_locked; COS_ROUTER_WEIGHT=0
+        drops the weighting and compares outstanding alone).  The
+        outstanding increment happens under the same lock as the
+        choice, so two concurrent picks never both see the same idle
+        replica as free.  Ties rotate round-robin (a fixed tie-break
+        would pin idle traffic to one replica), and `avoid` steers a
+        RETRY away from the replica that just bounced it — a 429
+        means that replica's queue is full NOW; re-picking it inside
+        the backoff window would mostly re-bounce."""
         with self._lock:
             ok = [r for r in self._replicas.values() if r.state == OK]
             if not ok:
@@ -313,8 +351,15 @@ class Router:
                     + str({r.name: r.state
                            for r in self._replicas.values()}) + ")")
             pool = [r for r in ok if r.name != avoid] or ok
-            low = min(r.outstanding for r in pool)
-            ties = [r for r in pool if r.outstanding == low]
+            if self.weight_by_latency:
+                fallback = self._lat.ewma_ms or 1.0
+                low = min(self._cost_locked(r, fallback)
+                          for r in pool)
+                ties = [r for r in pool
+                        if self._cost_locked(r, fallback) <= low]
+            else:
+                low = min(r.outstanding for r in pool)
+                ties = [r for r in pool if r.outstanding == low]
             rep = ties[self._rr % len(ties)]
             self._rr += 1
             rep.outstanding += 1
@@ -350,6 +395,32 @@ class Router:
     def outstanding(self, name: str) -> int:
         with self._lock:
             return self._replicas[name].outstanding
+
+    # -- SLO observation (the autoscaler's inputs) ---------------------
+    def latency_p99_ms(self,
+                       window_s: Optional[float] = None) -> float:
+        """Router-observed success-latency p99 over the aggregate ring
+        — the autoscaler's SLO signal (0.0 until samples exist).  With
+        `window_s`, only samples younger than the window count, so the
+        signal decays once the load that produced it is gone."""
+        with self._lock:
+            if window_s is not None:
+                return self._lat.pct_ms_window(0.99, window_s)
+            return self._lat.pct_ms(0.99)
+
+    def queue_pressure(self) -> int:
+        """Fleet queue pressure as the router sees it: every routable
+        replica's last-polled batcher depth plus router-side in-flight
+        — rows that exist SOMEWHERE between a client and a device."""
+        with self._lock:
+            return sum(r.queue_depth + r.outstanding
+                       for r in self._replicas.values()
+                       if r.state == OK)
+
+    def n_routable(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values()
+                       if r.state == OK)
 
     # -- request path -------------------------------------------------
     def predict(self, payload,
@@ -416,8 +487,16 @@ class Router:
                     if code == 429:
                         self.metrics.incr("retry_429")
                         sp.set("outcome", "429")
-                        raise RouteRetryable(
+                        err = RouteRetryable(
                             f"{rep.name}: 429 queue full")
+                        # the shedding lane's drain estimate rides the
+                        # 429 body; attach it so retry_call sleeps the
+                        # server-suggested time instead of blind jitter
+                        ra = (body.get("retry_after_s")
+                              if isinstance(body, dict) else None)
+                        if isinstance(ra, (int, float)) and ra > 0:
+                            err.retry_after_s = float(ra)
+                        raise err
                     if code == 503:
                         # draining/stopping (or a model fault —
                         # bounded retries against a peer are the
@@ -556,6 +635,7 @@ class Router:
                         for r in self._replicas.values()]
         states = {}
         for name, url, prev, intent in snapshot:
+            qd = None
             try:
                 code, body = http_json(url + "/healthz",
                                         timeout=self.health_timeout_s)
@@ -563,11 +643,20 @@ class Router:
                                   OK if code == 200 else DOWN)
                 if code != 200 and status == OK:
                     status = DOWN
+                qd = body.get("queue_depth")
             except TRANSPORT_ERRORS + (ValueError,):
                 status = DOWN
             if prev == DRAINING and status == OK and intent:
                 status = DRAINING
             states[name] = status
+            # stash the replica-reported batcher depth: the autoscaler
+            # reads fleet queue pressure from the router's own view
+            # instead of re-polling N replicas itself
+            if isinstance(qd, int) and qd >= 0:
+                with self._lock:
+                    rep = self._replicas.get(name)
+                    if rep is not None and rep.url == url:
+                        rep.queue_depth = qd
             if status != prev:
                 self._apply_poll(name, url, prev, status)
         return states
@@ -870,6 +959,14 @@ class Router:
         # the net digest/mesh/dtype — serving/service.py)
         out["build_info"] = {"pid": str(os.getpid())}
         with self._lock:
+            # fleet size as the router sees it — the cos_fleet_size
+            # gauge every scrape-driven verdict (and the autoscaler
+            # bench) reads; Fleet.metrics_summary folds its own
+            # restart/scale counters into this block
+            out["fleet"] = {
+                "size": len(self._replicas),
+                "routable": sum(1 for r in self._replicas.values()
+                                if r.state == OK)}
             out["replicas"] = {
                 n: {"state": r.state, "url": r.url,
                     "outstanding": r.outstanding,
@@ -880,6 +977,10 @@ class Router:
                     # replica is the straggler) from /metrics alone
                     "lat_ewma_ms": round(r.lat.ewma_ms, 3),
                     "lat_p95_ms": round(r.lat.pct_ms(0.95), 3),
+                    # last-polled replica-side batcher depth — the
+                    # autoscaler's queue-pressure input, surfaced so
+                    # scale decisions are auditable from /metrics
+                    "queue_depth": r.queue_depth,
                     # which NodeAgent host carries it ("" = local
                     # subprocess) — the /metrics replica table's host
                     # column in multi-host fleets

@@ -174,6 +174,17 @@ class InferenceService:
         # each replica its index via COS_REPLICA_INDEX; a matching
         # index delays every predict response by (factor-1)× its own
         # service time (http_server applies it) — resolved ONCE here
+        # priority-class admission control (COS_LANES=1; None = off,
+        # submits go straight to the model lanes exactly as before).
+        # Constructed after lanes/batcher exist — the controller
+        # forwards into them
+        from .admission import AdmissionController
+        from .batcher import _env_num as _env_num_lenient
+        self.admission = AdmissionController.from_env(self)
+        # 429 Retry-After ceiling (shared by the admission shed path
+        # and the plain queue-full path) — resolved once, COS003
+        self._retry_after_cap_s = max(0.05, _env_num_lenient(
+            "COS_LANE_RETRY_AFTER_CAP_S", 5.0))
         from ..tools.chaos import resolve as _resolve_faults
         from ..utils.envutils import env_int as _env_int_strict
         ridx = _env_int_strict("COS_REPLICA_INDEX", -1, strict=False)
@@ -259,6 +270,8 @@ class InferenceService:
             if all_warmed:
                 self._recompile_guard.mark_steady()
         self.lanes.start()
+        if self.admission is not None:
+            self.admission.start()
         self._started = True
         return self
 
@@ -328,6 +341,10 @@ class InferenceService:
 
     def stop(self, drain: bool = True):
         if self._started:
+            # admission first: with drain, its queued entries forward
+            # into the lanes BEFORE the lanes themselves drain
+            if self.admission is not None:
+                self.admission.stop(drain=drain)
             self.lanes.stop(drain=drain)
             self._started = False
 
@@ -531,6 +548,18 @@ class InferenceService:
         return self.lanes.lane(sm.name).submit_many(
             coerced, timeout_ms=timeout_ms, trace=trace)
 
+    def drain_estimate_s(self, model: Optional[str] = None,
+                         extra_rows: int = 0) -> float:
+        """Seconds until a request arriving NOW for `model` would
+        flush: the model lane's measured-rate drain estimate plus
+        `extra_rows` queued ahead of it upstream (the admission
+        layer's backlog), capped at COS_LANE_RETRY_AFTER_CAP_S — the
+        substance of every 429's Retry-After."""
+        sm = self._served(model)
+        lane = self.lanes.get(sm.name) or self.batcher
+        return min(lane.drain_estimate_s(extra_rows=extra_rows),
+                   self._retry_after_cap_s)
+
     def reload(self, model_path: str,
                model: Optional[str] = None) -> int:
         """Hot-swap `model` (default when None) to a newer snapshot;
@@ -645,6 +674,10 @@ class InferenceService:
                 self.registry.hbm_budget_bytes / 2**20, 3)
         if self.respcache is not None:
             out["respcache"] = self.respcache.stats()
+        if self.admission is not None:
+            # per-class depth + shed/forward counters → prom renders
+            # cos_lane_depth / cos_lane_shed_total from this block
+            out["lanes"] = self.admission.lanes_summary()
         return out
 
 

@@ -1,0 +1,427 @@
+"""Fleet control-plane bench: the autoscale sweep and the lane drill.
+
+Two drills, one artifact (bench_evidence/bench_autoscale.json):
+
+  * autoscale sweep — a REAL subprocess Fleet deliberately started at
+    ONE replica whose predict path is slowed 40x (the
+    under-provisioned fleet every flash crowd finds), driven through
+    an offered-load staircase (light → heavy ramp → heavy steady →
+    settle).  Two cells over the same staircase: a static fleet
+    (control) and the same fleet with the SLO-driven AutoScaler
+    attached (fast hysteresis knobs, max 3 replicas, AOT warm start
+    from a shared compilation cache; scale-up replicas are NOT
+    slowed, so added capacity plus throughput-weighted routing is
+    what rescues the tail).  Gate `slo_held`: at the heavy-steady
+    level the static fleet's client-measured p99 blows the stated
+    SLO while the autoscaled fleet holds it; gate
+    `scaling_observed`: the autoscaled cell shows at least one
+    scale_up AND (after the load falls) one drain-path scale_down in
+    the flight recorder, with zero failed client requests across
+    both cells.
+
+  * lane drill — one in-process service behind the admission
+    controller, interactive probes measured alone (control) and then
+    against a saturating batch-lane flood over the SAME service.
+    Gate `no_starvation`: interactive p99 under flood stays within
+    tolerance (3x or +150 ms, whichever is larger) of the no-batch
+    control while batch throughput stays > 0 — strict priority plus
+    the batch watermark is what makes both true at once.
+
+Contract (PR 4): ALWAYS exits 0, ONE JSON document on stdout, --out
+writes the same document, progress goes to stderr, failures land in
+doc["error"].  Gates are recorded, not exit-coded.
+
+Usage:
+  python scripts/bench_autoscale.py [--quick] [--out PATH]
+"""
+
+import argparse
+import json
+import os
+import platform
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_FLAG = "--xla_cpu_multi_thread_eigen=false"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " " + _FLAG).strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+NET_TMPL = """
+name: "asnet"
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  source_class: "com.yahoo.ml.caffe.LMDB"
+  memory_data_param {{ source: "{root}/unused_lmdb" batch_size: 64
+    channels: 3 height: 24 width: 24 }}
+  transform_param {{ scale: 0.00390625 }} }}
+layer {{ name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param {{ num_output: 12 kernel_size: 5 stride: 2
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }}
+layer {{ name: "ip1" type: "InnerProduct" bottom: "conv1" top: "ip1"
+  inner_product_param {{ num_output: 48
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param {{ num_output: 10
+    weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
+  bottom: "label" top: "loss" }}
+"""
+
+SOLVER_TMPL = """
+net: "{net}"
+base_lr: 0.01
+lr_policy: "fixed"
+max_iter: 10
+random_seed: 7
+"""
+
+SLO_MS = 400.0
+
+
+def build_model(td: str):
+    from caffeonspark_tpu import checkpoint
+    from caffeonspark_tpu.proto import NetParameter, SolverParameter
+    from caffeonspark_tpu.solver import Solver
+    net_path = os.path.join(td, "net.prototxt")
+    net_txt = NET_TMPL.format(root=td)
+    with open(net_path, "w") as f:
+        f.write(net_txt)
+    solver_path = os.path.join(td, "solver.prototxt")
+    with open(solver_path, "w") as f:
+        f.write(SOLVER_TMPL.format(net=net_path))
+    s = Solver(
+        SolverParameter.from_text(SOLVER_TMPL.format(net=net_path)),
+        NetParameter.from_text(net_txt))
+    params, _ = s.init()
+    model = os.path.join(td, "serve.caffemodel")
+    checkpoint.save_caffemodel(model, s.train_net, params)
+    return solver_path, model
+
+
+def _record(seed=0):
+    return {"id": f"r{seed}", "label": 0.0,
+            "data": (np.random.RandomState(seed)
+                     .rand(3, 24, 24).astype(np.float32) * 255.0)
+            .round(4).tolist()}
+
+
+def _pcts(lats_s):
+    lats = sorted(lats_s)
+
+    def pct(p):
+        return round(1e3 * lats[min(len(lats) - 1,
+                                    int(p * len(lats)))], 3) \
+            if lats else None
+
+    return {"n": len(lats), "p50_ms": pct(0.50), "p95_ms": pct(0.95),
+            "p99_ms": pct(0.99)}
+
+
+# ------------------------------------------------------- autoscale sweep
+
+
+def load_level(router, clients: int, duration_s: float,
+               think_s: float) -> dict:
+    """One offered-load level, latency measured at the client —
+    router retries included, exactly the tail a caller sees."""
+    rec = _record(0)
+    stop = threading.Event()
+    lats = [[] for _ in range(clients)]
+    errors = [0] * clients
+
+    def client(i):
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                out = router.predict({"records": [rec]})
+                assert out["rows"], "empty response"
+                lats[i].append(time.monotonic() - t0)
+            except Exception:      # noqa: BLE001 — counted as failed
+                errors[i] += 1
+                time.sleep(0.001)
+            if think_s:
+                time.sleep(think_s)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=90)
+    elapsed = time.monotonic() - t0
+    all_lats = [x for ls in lats for x in ls]
+    cell = _pcts(all_lats)
+    cell.update({"clients": clients, "think_s": think_s,
+                 "duration_s": round(elapsed, 3),
+                 "rows_per_sec": round(len(all_lats) / elapsed, 2),
+                 "failed": sum(errors)})
+    return cell
+
+
+def sweep_cell(tag, serve_args, env, levels, autoscale: bool) -> dict:
+    """One pass of the offered-load staircase over a fresh 1-replica
+    fleet, optionally with the AutoScaler closed-loop attached."""
+    from caffeonspark_tpu.obs.recorder import get_recorder
+    from caffeonspark_tpu.serving import AutoScaler, Fleet
+
+    fleet = Fleet(serve_args, replicas=1, env=env)
+    scaler = None
+    cell = {"autoscale": autoscale, "levels": []}
+    try:
+        fleet.start()
+        if autoscale:
+            scaler = AutoScaler(
+                fleet, slo_p99_ms=SLO_MS, slo_qdepth=8,
+                min_replicas=1, max_replicas=3, interval_s=0.3,
+                window_s=6.0, up_breaches=2, up_cooldown_s=2.0,
+                down_margin=0.4, down_intervals=8,
+                down_cooldown_s=4.0, wait_idle_s=30.0).start()
+        for name, clients, think_s, duration_s in levels:
+            level = load_level(fleet.router, clients, duration_s,
+                               think_s)
+            level["level"] = name
+            level["replicas_after"] = len(fleet.replicas)
+            cell["levels"].append(level)
+            print(json.dumps({"cell": tag, **level}),
+                  file=sys.stderr, flush=True)
+        cell["scale_ups"] = fleet.metrics.get_counter("scale_ups")
+        cell["scale_downs"] = fleet.metrics.get_counter("scale_downs")
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        fleet.stop()
+    cell["failed"] = sum(lv["failed"] for lv in cell["levels"])
+    events = get_recorder().events()
+    cell["recorder"] = [
+        {k: v for k, v in ev.items() if k not in ("seq", "ts")}
+        for ev in events
+        if ev.get("source") in ("fleet", "autoscale")
+        and ev.get("event") in ("scale_up", "scale_down", "decision")]
+    return cell
+
+
+def run_sweep_drill(out: dict, quick: bool) -> None:
+    import tempfile
+    td = tempfile.mkdtemp(prefix="cos_as_bench_")
+    solver_path, model = build_model(td)
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": _FLAG,
+           "COS_AOT_CACHE_DIR": os.path.join(td, "aot"),
+           "COS_RECOMPILE_GUARD": "1",
+           "COS_SERVE_MAX_BATCH": "8",
+           "COS_SERVE_MAX_WAIT_MS": "2",
+           "COS_HEDGE_PCT": "0", "COS_CACHE_CAP": "0",
+           # replica0 (the only replica either cell starts with) is
+           # slowed; scale-ups spawn as replica1+ and run at speed
+           "COS_FAULT_REPLICA_SLOW": "0:40"}
+    serve_args = ["-conf", solver_path, "-model", model,
+                  "-features", "ip2"]
+    steady_s = 6.0 if quick else 10.0
+    settle_s = 12.0 if quick else 16.0
+    # the ramp + mid levels are deliberately long enough for the
+    # controller to finish reacting (2 breaches x 0.3s interval, 2s
+    # up-cooldown between the two scale-ups, spawn + AOT warm start —
+    # a spawn can take several wall seconds when 16 load clients
+    # contend for the same cores); the GATED level is heavy_steady —
+    # SLO verdicts compare steady states, the reaction window is the
+    # price of reactive capacity
+    levels = [("light", 1, 0.05, 3.0),
+              ("heavy_ramp", 16, 0.0, 8.0),
+              ("heavy_mid", 16, 0.0, 6.0),
+              ("heavy_steady", 16, 0.0, steady_s),
+              ("settle", 1, 0.05, settle_s)]
+    drill = {"slo_p99_ms": SLO_MS, "levels": levels,
+             "static": sweep_cell("static", serve_args, env, levels,
+                                  autoscale=False),
+             "autoscaled": sweep_cell("autoscaled", serve_args, env,
+                                      levels, autoscale=True)}
+    out["sweep"] = drill
+
+    def _heavy(cell):
+        for lv in cell["levels"]:
+            if lv["level"] == "heavy_steady":
+                return lv
+        return {}
+
+    sp99 = _heavy(drill["static"]).get("p99_ms")
+    ap99 = _heavy(drill["autoscaled"]).get("p99_ms")
+    auto = drill["autoscaled"]
+    out["gates"]["slo_held"] = bool(
+        sp99 is not None and ap99 is not None
+        and sp99 > SLO_MS >= ap99)
+    out["gates"]["scaling_observed"] = bool(
+        auto["scale_ups"] > 0 and auto["scale_downs"] > 0
+        and auto["failed"] == 0)
+
+
+# ------------------------------------------------------------ lane drill
+
+
+def run_lane_drill(out: dict, quick: bool) -> None:
+    import tempfile
+    from caffeonspark_tpu.config import Config
+    from caffeonspark_tpu.serving import InferenceService
+    from caffeonspark_tpu.serving.admission import AdmissionController
+    from caffeonspark_tpu.serving.batcher import QueueFullError
+
+    td = tempfile.mkdtemp(prefix="cos_lane_bench_")
+    solver_path, model = build_model(td)
+    conf = Config(["-conf", solver_path, "-model", model])
+    svc = InferenceService(conf, blob_names=("ip2",), max_batch=16,
+                           max_wait_ms=2, queue_depth=256)
+    svc.admission = AdmissionController(svc, interactive_depth=64,
+                                        batch_depth=96)
+    drill = {"interactive_depth": 64, "batch_depth": 96}
+    duration_s = 4.0 if quick else 8.0
+    try:
+        svc.start()              # starts the attached admission too
+
+        def probe_phase(flood: bool) -> dict:
+            stop = threading.Event()
+            lats, failed = [], [0]
+            batch_rows = [0]
+            batch_sheds = [0]
+
+            def interactive():
+                rec = ("probe", 0.0, 3, 24, 24, False,
+                       np.random.RandomState(0)
+                       .rand(3, 24, 24).astype(np.float32) * 255.0)
+                while not stop.is_set():
+                    t0 = time.monotonic()
+                    try:
+                        svc.admission.submit(
+                            rec, lane="interactive",
+                            timeout_ms=5000).wait(6.0)
+                        lats.append(time.monotonic() - t0)
+                    except Exception:  # noqa: BLE001 — counted
+                        failed[0] += 1
+                    time.sleep(0.01)
+
+            def flooder():
+                recs = [("b%d" % i, 0.0, 3, 24, 24, False,
+                         np.random.RandomState(i)
+                         .rand(3, 24, 24).astype(np.float32) * 255.0)
+                        for i in range(16)]
+                while not stop.is_set():
+                    try:
+                        rs = svc.admission.submit_many(
+                            recs, lane="batch", tenant="flood",
+                            timeout_ms=20000)
+                        rs[-1].wait(30.0)
+                        batch_rows[0] += len(rs)
+                    except QueueFullError:
+                        batch_sheds[0] += 1
+                        time.sleep(0.005)
+                    except Exception:  # noqa: BLE001 — best effort
+                        time.sleep(0.005)
+
+            n_probes = 2
+            threads = [threading.Thread(target=interactive,
+                                        daemon=True)
+                       for _ in range(n_probes)]
+            if flood:
+                threads += [threading.Thread(target=flooder,
+                                             daemon=True)
+                            for _ in range(3)]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            time.sleep(duration_s)
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+            elapsed = time.monotonic() - t0
+            phase = _pcts(lats)
+            phase.update({
+                "failed": failed[0],
+                "batch_rows_per_sec":
+                    round(batch_rows[0] / elapsed, 2),
+                "batch_sheds": batch_sheds[0]})
+            return phase
+
+        drill["alone"] = probe_phase(flood=False)
+        print(json.dumps({"cell": "lane_alone", **drill["alone"]}),
+              file=sys.stderr, flush=True)
+        drill["flood"] = probe_phase(flood=True)
+        print(json.dumps({"cell": "lane_flood", **drill["flood"]}),
+              file=sys.stderr, flush=True)
+        drill["lanes_summary"] = svc.admission.lanes_summary()
+    finally:
+        svc.stop()               # stops admission, then the lanes
+    out["lanes"] = drill
+    alone = drill["alone"]["p99_ms"]
+    flood = drill["flood"]["p99_ms"]
+    tol_ms = max(3.0 * alone, alone + 150.0) \
+        if alone is not None else None
+    drill["tolerance_ms"] = tol_ms
+    out["gates"]["no_starvation"] = bool(
+        alone is not None and flood is not None
+        and flood <= tol_ms
+        and drill["flood"]["batch_rows_per_sec"] > 0
+        and drill["flood"]["failed"] == 0)
+
+
+# ----------------------------------------------------------------- main
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    out_path = args.out or os.path.join(
+        REPO, "bench_evidence", "bench_autoscale.json")
+    doc = {
+        "bench": "autoscale",
+        "backend": "cpu",
+        "cpus": os.cpu_count(),
+        "host": platform.node(),
+        "slo_p99_ms": SLO_MS,
+        "config": {"quick": bool(args.quick)},
+        "gates": {},
+        "harness_semantics": (
+            "Sweep: real 1-replica subprocess fleet through a "
+            "light/heavy/light offered-load staircase, static vs "
+            "AutoScaler-attached (max 3 replicas, shared AOT cache); "
+            "client-measured p99 per level, scale decisions read "
+            "back from the flight recorder.  Lanes: one in-process "
+            "service, interactive probes alone vs against a "
+            "3-thread batch-lane flood through the admission "
+            "controller."),
+        "ts": time.time(),
+    }
+    try:
+        run_sweep_drill(doc, args.quick)
+        run_lane_drill(doc, args.quick)
+        doc["ok"] = all(doc["gates"].values()) \
+            if doc["gates"] else False
+    except Exception as e:   # noqa: BLE001 — always-exit-0 contract
+        import traceback
+        doc["error"] = f"{type(e).__name__}: {e}"
+        doc["traceback"] = traceback.format_exc(limit=12)
+        doc["ok"] = False
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"bench": "autoscale", "gates": doc["gates"],
+                      "ok": doc["ok"],
+                      "error": doc.get("error"),
+                      "artifact": out_path}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

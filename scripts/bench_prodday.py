@@ -18,9 +18,15 @@ Two legs:
         red (p99 SLO blown), hedging/cache ENABLED must go green —
         the harness distinguishes system versions, which is the whole
         point of a replay harness.
+  a/b2  scenarios/autoscale_day.json (flash crowd + batch-lane
+        backlog + one slowed replica) run twice from a ONE-replica
+        fleet with hedging/cache off in both cells: the static fleet
+        must blow the p99 budget (red), the SLO-driven control plane
+        (COS_AS_ENABLE + COS_LANES) must hold it (green) with its
+        scale-up decisions visible in the flight recorder.
 
 `--quick` runs scenarios/prodday_smoke.json only (no deploy faults,
-no a/b cell) and stays tier-1-safe (<60s).
+no a/b cells) and stays tier-1-safe (<60s).
 
 ALWAYS exits 0 with ONE JSON document on stdout (bench.py contract);
 the full artifact lands in bench_evidence/bench_prodday.json.
@@ -89,6 +95,19 @@ GREEN = {"COS_HEDGE_PCT": "95", "COS_HEDGE_MIN_MS": "25",
 # the red system version: same code, hedging + cache disabled
 RED = {"COS_HEDGE_PCT": "0", "COS_CACHE_CAP": "0"}
 
+# autoscale a/b: hedging/cache off in BOTH cells so the only
+# difference is the control plane — static one-replica fleet (red)
+# vs autoscaler + admission lanes over the same fleet (green)
+AS_RED = {"COS_HEDGE_PCT": "0", "COS_CACHE_CAP": "0"}
+AS_GREEN = dict(AS_RED,
+                COS_AS_ENABLE="1", COS_SLO_P99_MS="600",
+                COS_SLO_QDEPTH="24", COS_AS_MIN="1", COS_AS_MAX="4",
+                COS_AS_INTERVAL_S="0.5", COS_AS_WINDOW_S="8",
+                COS_AS_UP_BREACHES="2",
+                COS_AS_UP_COOLDOWN_S="3", COS_AS_DOWN_MARGIN="0.4",
+                COS_AS_DOWN_INTERVALS="8", COS_AS_DOWN_COOLDOWN_S="8",
+                COS_LANES="1", COS_LANE_BATCH_DEPTH="64")
+
 
 class IngestThread:
     """The streaming-ingest leg of the PR 13 loop: keeps the training
@@ -155,6 +174,40 @@ def _restore_env(old):
     for k in list(os.environ):
         if k.startswith("COS_FAULT_"):
             del os.environ[k]
+
+
+def _recorder_events(dump_dir, source, event):
+    """Count `source.event` occurrences across a leg's recorder dump
+    files — how the bench proves a control-plane decision actually
+    fired (vs the verdict merely coming out green)."""
+    n = 0
+    needle_src = f'"{source}"'
+    needle_evt = f'"{event}"'
+    for root, _dirs, files in os.walk(dump_dir):
+        for fname in files:
+            if not fname.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(root, fname)) as f:
+                    doc = json.load(f)
+                evs = doc.get("events") if isinstance(doc, dict) \
+                    else doc
+                for ev in evs or []:
+                    if (isinstance(ev, dict)
+                            and ev.get("source") == source
+                            and ev.get("event") == event):
+                        n += 1
+            except (OSError, ValueError):
+                # a half-written dump shouldn't kill the bench; the
+                # raw-string fallback still counts the event
+                try:
+                    text = open(os.path.join(root, fname),
+                                errors="replace").read()
+                    if needle_src in text and needle_evt in text:
+                        n += 1
+                except OSError:
+                    pass
+    return n
 
 
 def run_day(tag, scenario_path, knobs, conf, pools, dump_root,
@@ -264,9 +317,35 @@ def run(args, record):
                 and red["gates"]["incidents_explained"]
                 and red["gates"]["leaks"])
             record["ab_green_passes"] = bool(green["ok"])
+
+            # a/b2: SLO-driven control plane vs static fleet, from a
+            # deliberately under-provisioned single replica
+            as_path = os.path.join(REPO, "scenarios",
+                                   "autoscale_day.json")
+            as_red = run_day("as_red", as_path, AS_RED, conf, pools,
+                             dump_root, steps, replicas=1)
+            as_green = run_day("as_green", as_path, AS_GREEN, conf,
+                               pools, dump_root, steps, replicas=1)
+            scale_ups = _recorder_events(
+                os.path.join(dump_root, "as_green"),
+                "fleet", "scale_up")
+            decisions = _recorder_events(
+                os.path.join(dump_root, "as_green"),
+                "autoscale", "decision")
+            record["autoscale_ab"] = {
+                "red": as_red, "green": as_green,
+                "green_scale_ups": scale_ups,
+                "green_decisions": decisions}
+            record["as_red_detects"] = bool(
+                not as_red["gates"]["slo"]
+                and as_red["gates"]["leaks"])
+            record["as_green_passes"] = bool(as_green["ok"]
+                                             and scale_ups > 0)
             record["ok"] = bool(record["day_survived"]
                                 and record["ab_red_detects"]
-                                and record["ab_green_passes"])
+                                and record["ab_green_passes"]
+                                and record["as_red_detects"]
+                                and record["as_green_passes"])
         else:
             record["ab"] = "skipped (--quick)"
             record["ok"] = record["day_survived"]
@@ -286,7 +365,9 @@ def main(argv=None) -> int:
         "backend": "cpu",
         "cpus": os.cpu_count(),
         "config": {"quick": bool(args.quick), "replicas": 2,
-                   "green_knobs": GREEN, "red_knobs": RED},
+                   "green_knobs": GREEN, "red_knobs": RED,
+                   "autoscale_green_knobs": AS_GREEN,
+                   "autoscale_red_knobs": AS_RED},
         "harness_semantics": (
             "Scenario data files replayed by caffeonspark_tpu.prodday "
             "against a real DeployController process tree (2 fleet "
@@ -316,6 +397,9 @@ def main(argv=None) -> int:
                       "ab_red_detects": record.get("ab_red_detects"),
                       "ab_green_passes":
                           record.get("ab_green_passes"),
+                      "as_red_detects": record.get("as_red_detects"),
+                      "as_green_passes":
+                          record.get("as_green_passes"),
                       "ok": record.get("ok"),
                       "error": record.get("error"),
                       "artifact": out_path}))

@@ -1,0 +1,834 @@
+"""Fleet control plane: the SLO autoscaler's hysteresis state machine
+(fake fleet, deterministic clock), lane-based admission control (EDF
+shedding, tenant quotas, batch-never-starves-interactive), the
+Retry-After wire mapping (429 header + body, retry hint honored under
+the backoff ceiling), throughput-weighted routing, drained scale-down
+under load with zero client-visible failures, and the prom rendering
+of the fleet/lane families."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from caffeonspark_tpu.serving import (AdmissionController, AutoScaler,
+                                      Fleet, QueueFullError,
+                                      RetryPolicy, Router,
+                                      ServingHTTPServer, retry_call)
+from caffeonspark_tpu.serving.admission import queue_full
+from caffeonspark_tpu.serving.batcher import (DeadlineExceeded,
+                                              ServingStopped)
+from caffeonspark_tpu.serving.router import OK, RouteRetryable, _LatRing
+from caffeonspark_tpu.metrics import PipelineMetrics
+
+
+# ----------------------------------------------- fake fleet / router
+
+class _FakeRouter:
+    """Just the two signals the autoscaler reads."""
+
+    def __init__(self):
+        self.p99 = 0.0
+        self.qdepth = 0
+        self.windows = []           # window_s values the scaler passed
+
+    def latency_p99_ms(self, window_s=None):
+        self.windows.append(window_s)
+        return self.p99
+
+    def queue_pressure(self):
+        return self.qdepth
+
+
+class _FakeFleet:
+    def __init__(self, n=1):
+        self.router = _FakeRouter()
+        self.replicas = {f"replica{i}": object() for i in range(n)}
+        self.ups = 0
+        self.downs = 0
+        self.fail_up = False
+        self.wait_idle_seen = None
+
+    def scale_up(self, count=1):
+        if self.fail_up:
+            raise RuntimeError("spawn failed")
+        self.ups += 1
+        self.replicas[f"replica{len(self.replicas)}"] = object()
+
+    def scale_down(self, name=None, wait_idle_s=60.0):
+        self.downs += 1
+        self.wait_idle_seen = wait_idle_s
+        self.replicas.popitem()
+
+
+def _scaler(fleet, **kw):
+    kw.setdefault("slo_p99_ms", 100.0)
+    kw.setdefault("slo_qdepth", 0)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("up_breaches", 2)
+    kw.setdefault("up_cooldown_s", 5.0)
+    kw.setdefault("down_margin", 0.5)
+    kw.setdefault("down_intervals", 3)
+    kw.setdefault("down_cooldown_s", 10.0)
+    return AutoScaler(fleet, **kw)
+
+
+# ------------------------------------------------------- autoscaler
+
+def test_autoscaler_disabled_without_slo():
+    """Both SLO targets at 0 = nothing to control: step() is inert."""
+    fleet = _FakeFleet()
+    sc = _scaler(fleet, slo_p99_ms=0, slo_qdepth=0)
+    assert not sc.enabled()
+    fleet.router.p99 = 10_000.0
+    assert sc.step(now=0.0) is None
+    assert fleet.ups == 0
+
+
+def test_autoscaler_up_hysteresis():
+    """One breached interval is noise; COS_AS_UP_BREACHES consecutive
+    breaches scale up, and the action resets the counter."""
+    fleet = _FakeFleet(1)
+    sc = _scaler(fleet)
+    fleet.router.p99 = 250.0
+    assert sc.step(now=0.0) is None          # breach 1: not yet
+    assert sc.step(now=1.0) == "up"          # breach 2: act
+    assert fleet.ups == 1
+    # counters reset: the next breach starts a fresh streak (and the
+    # up-cooldown gates the next action regardless)
+    assert sc.step(now=6.0) is None
+    assert sc.step(now=7.0) == "up"
+    assert fleet.ups == 2
+
+
+def test_autoscaler_up_cooldown_and_max_bound():
+    fleet = _FakeFleet(1)
+    sc = _scaler(fleet, max_replicas=2, up_cooldown_s=5.0)
+    fleet.router.p99 = 500.0
+    assert sc.step(now=0.0) is None
+    assert sc.step(now=1.0) == "up"
+    # still breaching, but inside the cooldown window
+    assert sc.step(now=2.0) is None
+    assert sc.step(now=3.0) is None
+    # cooldown passed — but the fleet is at COS_AS_MAX
+    assert sc.step(now=7.0) is None
+    assert sc.step(now=8.0) is None
+    assert fleet.ups == 1 and len(fleet.replicas) == 2
+
+
+def test_autoscaler_gap_band_resets_both_streaks():
+    """Between margin*SLO and the SLO neither counter accumulates —
+    the controller cannot oscillate around a single threshold."""
+    fleet = _FakeFleet(2)
+    sc = _scaler(fleet, down_intervals=2)
+    fleet.router.p99 = 150.0
+    sc.step(now=0.0)                          # breach 1
+    fleet.router.p99 = 80.0                   # gap band (50..100)
+    sc.step(now=1.0)
+    fleet.router.p99 = 150.0
+    assert sc.step(now=2.0) is None           # streak restarted
+    fleet.router.p99 = 20.0                   # healthy
+    sc.step(now=3.0)
+    fleet.router.p99 = 80.0                   # gap band again
+    sc.step(now=4.0)
+    fleet.router.p99 = 20.0
+    assert sc.step(now=5.0) is None           # idle streak restarted
+    assert fleet.ups == 0 and fleet.downs == 0
+
+
+def test_autoscaler_down_after_sustained_headroom():
+    fleet = _FakeFleet(3)
+    sc = _scaler(fleet, down_intervals=3, down_cooldown_s=0.0)
+    fleet.router.p99 = 10.0                   # well under 0.5 * 100
+    assert sc.step(now=0.0) is None
+    assert sc.step(now=1.0) is None
+    assert sc.step(now=2.0) == "down"
+    assert fleet.downs == 1
+    assert fleet.wait_idle_seen == sc.wait_idle_s
+
+
+def test_autoscaler_down_respects_min_and_cooldown():
+    fleet = _FakeFleet(2)
+    sc = _scaler(fleet, down_intervals=1, down_cooldown_s=10.0)
+    fleet.router.p99 = 1.0
+    assert sc.step(now=0.0) == "down"
+    # healthy again immediately, but inside the down-cooldown
+    assert sc.step(now=1.0) is None
+    # cooldown passed, but the fleet sits at COS_AS_MIN
+    assert sc.step(now=20.0) is None
+    assert len(fleet.replicas) == 1
+
+
+def test_autoscaler_scale_up_resets_down_clock():
+    """Capacity just added must prove itself: a scale-up pushes the
+    down-cooldown forward even if the load vanishes instantly."""
+    fleet = _FakeFleet(1)
+    sc = _scaler(fleet, down_intervals=1, down_cooldown_s=8.0,
+                 up_cooldown_s=0.0)
+    fleet.router.p99 = 500.0
+    sc.step(now=0.0)
+    assert sc.step(now=1.0) == "up"
+    fleet.router.p99 = 1.0
+    assert sc.step(now=2.0) is None           # idle, but clock reset at 1
+    assert sc.step(now=8.0) is None
+    assert sc.step(now=9.5) == "down"         # 8s after the up
+
+
+def test_autoscaler_qdepth_signal_alone():
+    fleet = _FakeFleet(1)
+    sc = _scaler(fleet, slo_p99_ms=0, slo_qdepth=10)
+    fleet.router.qdepth = 50
+    sc.step(now=0.0)
+    assert sc.step(now=1.0) == "up"
+    # p99 plays no role with its target off
+    assert sc.enabled()
+
+
+def test_autoscaler_scale_up_failure_keeps_controlling():
+    """A failed spawn is logged and recorded, not fatal — and the
+    breach streak survives, so the controller retries next interval
+    (once the cooldown allows)."""
+    fleet = _FakeFleet(1)
+    sc = _scaler(fleet, up_cooldown_s=0.0)
+    fleet.fail_up = True
+    fleet.router.p99 = 500.0
+    sc.step(now=0.0)
+    assert sc.step(now=1.0) is None           # acted, spawn blew up
+    fleet.fail_up = False
+    assert sc.step(now=2.0) == "up"           # streak carried over
+    assert fleet.ups == 1
+
+
+def test_autoscaler_passes_window_to_router():
+    fleet = _FakeFleet(1)
+    sc = _scaler(fleet, window_s=7.5)
+    sc.step(now=0.0)
+    assert fleet.router.windows == [7.5]
+
+
+def test_autoscaler_from_env_gated(monkeypatch):
+    monkeypatch.delenv("COS_AS_ENABLE", raising=False)
+    assert AutoScaler.from_env(_FakeFleet()) is None
+    monkeypatch.setenv("COS_AS_ENABLE", "1")
+    monkeypatch.setenv("COS_SLO_P99_MS", "250")
+    sc = AutoScaler.from_env(_FakeFleet())
+    assert sc is not None and sc.slo_p99_ms == 250.0
+
+
+def test_latring_windowed_percentile():
+    """Only samples younger than the window count — the breach signal
+    must decay with the load that caused it, not linger in a full
+    ring until slow light traffic rolls it out."""
+    ring = _LatRing(capacity=16)
+    for _ in range(8):
+        ring.add_ms(900.0)
+    time.sleep(0.06)
+    for _ in range(4):
+        ring.add_ms(5.0)
+    assert ring.pct_ms(0.99) == 900.0            # unwindowed view
+    assert ring.pct_ms_window(0.99, 1000.0) == 900.0
+    assert ring.pct_ms_window(0.99, 0.05) == 5.0  # old samples aged out
+    assert ring.pct_ms_window(0.99, 0.0) == 0.0   # empty window
+
+
+# --------------------------------------------- weighted routing pick
+
+def _bare_router(n, **kw):
+    r = Router({f"r{i}": f"http://127.0.0.1:{9000 + i}"
+                for i in range(n)}, **kw)
+    for name in r.names():
+        r.set_state(name, OK)
+    return r
+
+
+def test_weighted_pick_prefers_fast_replica():
+    """With COS_ROUTER_WEIGHT on (default), a replica measured slow
+    gets picked only once its fast peer's queue justifies the cost."""
+    r = _bare_router(2)
+    assert r.weight_by_latency
+    for _ in range(20):
+        r._replicas["r0"].lat.add_ms(400.0)      # the straggler
+        r._replicas["r1"].lat.add_ms(10.0)
+    picks = {"r0": 0, "r1": 0}
+    for _ in range(40):
+        rep = r._pick()
+        picks[rep.name] += 1
+        r._unpick(rep)
+    assert picks["r1"] == 40 and picks["r0"] == 0
+    # with the fast replica loaded, cost crosses over: (outstanding+1)
+    # * 10ms > 1 * 400ms at 40 outstanding
+    with r._lock:
+        r._replicas["r1"].outstanding = 50
+    rep = r._pick()
+    assert rep.name == "r0"
+
+
+def test_unweighted_pick_ignores_latency(monkeypatch):
+    monkeypatch.setenv("COS_ROUTER_WEIGHT", "0")
+    r = _bare_router(2)
+    assert not r.weight_by_latency
+    for _ in range(20):
+        r._replicas["r0"].lat.add_ms(400.0)
+    picks = {"r0": 0, "r1": 0}
+    for _ in range(40):
+        rep = r._pick()
+        picks[rep.name] += 1
+        r._unpick(rep)
+    # pure least-outstanding: ties rotate round-robin
+    assert picks["r0"] == 20 and picks["r1"] == 20
+
+
+def test_queue_pressure_sums_routable_replicas():
+    r = _bare_router(3)
+    with r._lock:
+        r._replicas["r0"].queue_depth = 5
+        r._replicas["r0"].outstanding = 2
+        r._replicas["r1"].queue_depth = 3
+    r.set_state("r2", "down")
+    with r._lock:
+        r._replicas["r2"].queue_depth = 99    # not routable: excluded
+    assert r.queue_pressure() == 10
+    assert r.n_routable() == 2
+
+
+# ------------------------------------------------- admission control
+
+class _FakePending:
+    def __init__(self, val):
+        self._val = val
+        self.model_version = 7
+
+    def wait(self, timeout=None):
+        return self._val
+
+    def done(self):
+        return True
+
+
+class _FakeLane:
+    def __init__(self, max_batch=8):
+        self.max_batch = max_batch
+        self._depth = 0
+
+    def depth(self):
+        return self._depth
+
+
+class _FakeLanes(dict):
+    pass
+
+
+class _FakeServedModel:
+    @staticmethod
+    def record_dims():
+        return (1, 4, 4)
+
+
+class _FakeService:
+    """The exact surface AdmissionController touches, nothing else."""
+
+    def __init__(self, max_batch=8):
+        from caffeonspark_tpu.serving.registry import DEFAULT_MODEL
+        self.draining = False
+        self.metrics = PipelineMetrics()
+        self.batcher = _FakeLane(max_batch)
+        self.lanes = _FakeLanes({DEFAULT_MODEL: self.batcher})
+        self._lane_kw = {"default_timeout_ms": None}
+        self.forwarded = []
+        self.submit_fail = None       # exception to raise on submit
+
+    def _served(self, model):
+        return _FakeServedModel()
+
+    def submit_many(self, records, timeout_ms=None, model=None,
+                    trace=None):
+        if self.submit_fail is not None:
+            raise self.submit_fail
+        self.forwarded.append(list(records))
+        return [_FakePending({"SampleID": i})
+                for i in range(len(records))]
+
+    def drain_estimate_s(self, model=None, extra_rows=0):
+        return min(0.1 * extra_rows + 0.2, 5.0)
+
+
+REC = ("id", "", 1, 4, 4, False, None)
+
+
+def _ctrl(svc=None, **kw):
+    svc = svc or _FakeService()
+    kw.setdefault("interactive_depth", 4)
+    kw.setdefault("batch_depth", 4)
+    return AdmissionController(svc, **kw), svc
+
+
+def test_admission_forward_roundtrip():
+    ctrl, svc = _ctrl()
+    ctrl.start()
+    try:
+        out = ctrl.submit(REC, lane="interactive")
+        assert out.wait(5.0) == {"SampleID": 0}
+        assert svc.forwarded == [[REC]]
+        s = ctrl.lanes_summary()
+        assert s["interactive"]["admitted"] == 1
+        assert s["interactive"]["forwarded"] == 1
+        assert s["interactive"]["depth"] == 0
+    finally:
+        ctrl.stop()
+
+
+def test_admission_unknown_lane_rejected():
+    ctrl, _ = _ctrl()
+    with pytest.raises(ValueError, match="unknown lane"):
+        ctrl.submit(REC, lane="bulk")
+
+
+def test_admission_sheds_newcomer_with_most_slack():
+    """Over the cap, the LATEST-deadline work goes: a newcomer with
+    more slack than everything queued is the one refused, and the 429
+    carries the drain estimate."""
+    ctrl, _ = _ctrl()          # dispatcher NOT started: entries queue
+    for i in range(4):
+        ctrl.submit(REC, lane="interactive", timeout_ms=1_000)
+    with pytest.raises(QueueFullError) as ei:
+        ctrl.submit(REC, lane="interactive", timeout_ms=60_000)
+    assert ei.value.retry_after_s > 0
+    s = ctrl.lanes_summary()
+    assert s["interactive"]["shed"] == 1
+    assert s["interactive"]["depth"] == 4
+    ctrl.stop(drain=False)
+
+
+def test_admission_edf_preempts_latest_deadline():
+    """A newcomer with an EARLIER deadline than the queued tail evicts
+    that tail instead of being refused — under overload, WHAT you
+    refuse matters more than that you refuse."""
+    ctrl, _ = _ctrl()
+    victims = [ctrl.submit(REC, lane="interactive",
+                           timeout_ms=60_000) for _ in range(4)]
+    admitted = ctrl.submit(REC, lane="interactive", timeout_ms=500)
+    shed = [v for v in victims if v.done()]
+    assert len(shed) == 1
+    with pytest.raises(QueueFullError) as ei:
+        shed[0].wait(0.0)
+    assert ei.value.retry_after_s > 0
+    assert not admitted.done()
+    assert ctrl.queued_rows("interactive") == 4
+    ctrl.stop(drain=False)
+
+
+def test_admission_no_deadline_is_latest():
+    """No timeout = infinite slack: an undeadlined entry is always the
+    EDF victim over any deadlined newcomer."""
+    ctrl, _ = _ctrl()
+    forever = ctrl.submit(REC, lane="batch")
+    for _ in range(3):
+        ctrl.submit(REC, lane="batch", timeout_ms=60_000)
+    ctrl.submit(REC, lane="batch", timeout_ms=1_000)
+    assert forever.done()
+    with pytest.raises(QueueFullError):
+        forever.wait(0.0)
+    ctrl.stop(drain=False)
+
+
+def test_admission_tenant_quota():
+    """One runaway tenant cannot convert the whole class into its own
+    backlog; other tenants keep admitting."""
+    ctrl, _ = _ctrl(interactive_depth=16, tenant_quota=2)
+    ctrl.submit(REC, lane="interactive", tenant="hog")
+    ctrl.submit(REC, lane="interactive", tenant="hog")
+    with pytest.raises(QueueFullError):
+        ctrl.submit(REC, lane="interactive", tenant="hog")
+    ctrl.submit(REC, lane="interactive", tenant="polite")
+    s = ctrl.lanes_summary()
+    assert s["interactive"]["shed_quota"] == 1
+    assert s["interactive"]["depth"] == 3
+    ctrl.stop(drain=False)
+
+
+def test_admission_expires_queued_entries():
+    ctrl, _ = _ctrl()
+    doomed = ctrl.submit(REC, lane="interactive", timeout_ms=10)
+    time.sleep(0.05)
+    # any admit prunes the expired heap head
+    ctrl.submit(REC, lane="interactive", timeout_ms=60_000)
+    assert doomed.done()
+    with pytest.raises(DeadlineExceeded):
+        doomed.wait(0.0)
+    assert ctrl.lanes_summary()["interactive"]["expired"] == 1
+    ctrl.stop(drain=False)
+
+
+def test_admission_batch_never_starves_interactive():
+    """Strict priority + watermark: batch forwards only while no
+    interactive work waits AND the underlying lane sits at-or-below
+    the watermark; lifting the backlog releases batch."""
+    svc = _FakeService()
+    ctrl, _ = _ctrl(svc, interactive_depth=64, batch_depth=64,
+                    batch_watermark=2)
+    svc.batcher._depth = 10             # deep underlying backlog
+    ctrl.start()
+    try:
+        b = ctrl.submit(REC, lane="batch")
+        i = ctrl.submit(REC, lane="interactive")
+        deadline = time.monotonic() + 5.0
+        while not i.done() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert i.done()                 # interactive went through...
+        assert not b.done()             # ...batch is watermark-held
+        assert ctrl.queued_rows("batch") == 1
+        svc.batcher._depth = 0          # backlog drained
+        deadline = time.monotonic() + 5.0
+        while not b.done() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert b.done() and b.wait(0.0) == {"SampleID": 0}
+    finally:
+        ctrl.stop()
+
+
+def test_admission_stop_no_drain_fails_queued():
+    ctrl, _ = _ctrl()
+    held = ctrl.submit(REC, lane="batch")
+    ctrl.stop(drain=False)
+    with pytest.raises(ServingStopped):
+        held.wait(1.0)
+    with pytest.raises(ServingStopped):
+        ctrl.submit(REC, lane="interactive")
+
+
+def test_admission_drain_estimate_stacks_classes():
+    """Batch work queues behind BOTH classes; interactive only behind
+    its own."""
+    ctrl, _ = _ctrl(interactive_depth=64, batch_depth=64)
+    for _ in range(3):
+        ctrl.submit(REC, lane="interactive")
+        ctrl.submit(REC, lane="batch")
+    assert ctrl.drain_estimate_s("batch") \
+        > ctrl.drain_estimate_s("interactive")
+    ctrl.stop(drain=False)
+
+
+# ------------------------------------------ Retry-After wire mapping
+
+class _ShedService:
+    """Fake service whose submit path always sheds with a hint."""
+
+    draining = False
+    admission = None
+    respcache = None
+
+    def submit_many(self, records, timeout_ms=None, model=None,
+                    trace=None):
+        raise queue_full("interactive class at capacity — load shed",
+                         retry_after_s=2.4)
+
+
+def test_http_429_carries_retry_after():
+    srv = ServingHTTPServer(_ShedService()).start_background()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/predict",
+            data=json.dumps({"records": [{"data": [1.0]}]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        e = ei.value
+        assert e.code == 429
+        assert e.headers["Retry-After"] == "3"       # ceil(2.4)
+        body = json.loads(e.read().decode())
+        assert body["retry_after_s"] == 2.4
+    finally:
+        srv.stop()
+
+
+def test_retry_call_honors_hint_under_ceiling():
+    """A server-supplied Retry-After beats blind jitter but never
+    sleeps past the policy's backoff ceiling."""
+    sleeps = []
+
+    def fail_twice(state=[0]):
+        state[0] += 1
+        if state[0] <= 2:
+            raise queue_full("shed", retry_after_s=0.05)
+        return "ok"
+
+    policy = RetryPolicy(attempts=4, base_ms=10, cap_ms=500, seed=1)
+    out = retry_call(fail_twice, retry_on=(QueueFullError,),
+                     policy=policy, sleep=sleeps.append)
+    assert out == "ok"
+    assert sleeps == [0.05, 0.05]
+
+    sleeps.clear()
+
+    def fail_once(state=[0]):
+        state[0] += 1
+        if state[0] == 1:
+            raise queue_full("shed", retry_after_s=30.0)
+        return "ok"
+
+    policy = RetryPolicy(attempts=3, base_ms=10, cap_ms=80, seed=1)
+    assert retry_call(fail_once, retry_on=(QueueFullError,),
+                      policy=policy, sleep=sleeps.append) == "ok"
+    assert sleeps == [0.08]                     # capped at cap_ms
+
+
+class _Shedding429Replica:
+    """Replica that always 429s with a machine-readable hint — checks
+    the router's body-transport of Retry-After onto RouteRetryable."""
+
+    def __init__(self):
+        outer = self
+        outer.hits = 0
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                outer.hits += 1
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                body = json.dumps({"error": "queue full",
+                                   "retry_after_s": 1.7}).encode()
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self._thread.join(timeout=10)
+        self.httpd.server_close()
+
+
+def test_router_parses_retry_after_from_429_body():
+    fake = _Shedding429Replica()
+    r = Router({"r0": fake.url},
+               policy=RetryPolicy(attempts=2, base_ms=0.1,
+                                  cap_ms=100, seed=3))
+    r.set_state("r0", OK)
+    try:
+        # the hint must ride the classified exception so retry_call
+        # (and any outer retrier) can honor it — pin the attribute on
+        # the error that surfaces once attempts run out
+        with pytest.raises(RouteRetryable) as ei:
+            r.predict({"records": [{"id": "x"}]}, timeout_s=5.0)
+        assert getattr(ei.value, "retry_after_s", None) == 1.7
+        assert fake.hits == 2                   # both attempts bounced
+    finally:
+        r.stop()
+        fake.stop()
+
+
+# --------------------------- drained scale-down under load (fakes)
+
+class _EchoReplica:
+    """Minimal live replica surface: healthz / drain / predict."""
+
+    def __init__(self):
+        outer = self
+        self.draining = False
+        self.served = 0
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                st = "draining" if outer.draining else "ok"
+                self._send(200, {"ok": st == "ok", "status": st,
+                                 "model_version": 1,
+                                 "queue_depth": 0})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/v1/drain":
+                    outer.draining = bool(req.get("drain", True))
+                    self._send(200, {"ok": True})
+                elif outer.draining:
+                    self._send(503, {"error": "draining"})
+                else:
+                    outer.served += 1
+                    self._send(200, {"rows": [
+                        {"SampleID": r.get("id", "")}
+                        for r in req.get("records", [])],
+                        "model_version": 1})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self._thread.join(timeout=10)
+        self.httpd.server_close()
+
+
+class _FakeProc:
+    """ReplicaProcess stand-in for Fleet's bookkeeping."""
+
+    def __init__(self):
+        self.retired = False
+        self.terminated = False
+
+    def terminate(self, grace=10.0):
+        self.terminated = True
+
+    def alive(self):
+        return not self.terminated
+
+
+def test_twenty_scale_downs_zero_failed_requests():
+    """The scale-down contract under continuous load: drain →
+    wait-idle → terminate, 20 times in a row, with zero client-visible
+    failures — retiring capacity must never cost a request."""
+    n = 21
+    fakes = [_EchoReplica() for _ in range(n)]
+    fleet = Fleet(["-serve"], replicas=0,
+                  policy=RetryPolicy(attempts=6, base_ms=0.1,
+                                     cap_ms=2.0, seed=7))
+    fleet.n = n
+    for i, f in enumerate(fakes):
+        name = f"replica{i}"
+        fleet.replicas[name] = _FakeProc()
+        fleet.router.add_replica(name, f.url)
+        fleet.router.set_state(name, OK)
+    stop = threading.Event()
+    failures = []
+    successes = [0]
+
+    def client(k):
+        j = 0
+        while not stop.is_set():
+            try:
+                out = fleet.router.predict(
+                    {"records": [{"id": f"c{k}.{j}"}]}, timeout_s=10)
+                assert out["rows"][0]["SampleID"] == f"c{k}.{j}"
+                successes[0] += 1
+            except BaseException as e:  # noqa: BLE001 — the assertion
+                failures.append(repr(e))
+                return
+            j += 1
+
+    threads = [threading.Thread(target=client, args=(k,), daemon=True)
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        retired = [fleet.scale_down(wait_idle_s=10.0)
+                   for _ in range(20)]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        for f in fakes:
+            f.stop()
+    assert failures == []
+    assert successes[0] > 0
+    assert len(set(retired)) == 20
+    assert fleet.n == 1 and len(fleet.replicas) == 1
+    assert fleet.metrics.get_counter("scale_downs") == 20
+    # every retired process was terminated, the survivor was not
+    assert all(p.terminated or name in fleet.replicas
+               for name, p in list(fleet.replicas.items()))
+    # LIFO order: the highest index goes first
+    assert retired[0] == "replica20"
+    assert "replica0" in fleet.replicas
+
+
+# ----------------------------------------------------- prom families
+
+def test_prom_renders_fleet_and_lane_families():
+    from caffeonspark_tpu.obs.prom import PromWriter, parse_exposition
+    w = PromWriter()
+    w.add_summary({
+        "fleet": {"size": 3, "routable": 2, "scale_ups": 4,
+                  "scale_downs": 2, "restarts": 1},
+        "lanes": {"interactive": {"depth": 5, "admitted": 10,
+                                  "forwarded": 8, "shed": 2,
+                                  "expired": 0},
+                  "batch": {"depth": 40, "admitted": 50,
+                            "forwarded": 9, "shed": 1,
+                            "expired": 0}},
+    }, {"role": "router"})
+    fams = parse_exposition(w.render())
+    assert fams["cos_fleet_size"]["type"] == "gauge"
+    flat = {(name, tuple(sorted(lbl.items()))): v
+            for name, fam in fams.items()
+            for lbl, v in fam["samples"]}
+    assert flat[("cos_fleet_size",
+                 (("role", "router"),))] == 3.0
+    assert flat[("cos_fleet_routable",
+                 (("role", "router"),))] == 2.0
+    assert flat[("cos_fleet_scale_ups_total",
+                 (("role", "router"),))] == 4.0
+    assert flat[("cos_lane_depth",
+                 (("lane", "interactive"), ("role", "router")))] == 5.0
+    assert flat[("cos_lane_depth",
+                 (("lane", "batch"), ("role", "router")))] == 40.0
+    assert flat[("cos_lane_shed_total",
+                 (("lane", "interactive"),
+                  ("role", "router")))] == 2.0
+
+
+# ------------------------------------------------- scenario tenants
+
+def test_scenario_tenant_lane_roundtrip(tmp_path):
+    from caffeonspark_tpu.prodday.scenario import (ScenarioError,
+                                                   load_scenario)
+    doc = {
+        "name": "lanes", "seed": 1,
+        "slo": {"p99_ms": 500, "availability": 0.9},
+        "phases": [{
+            "name": "p0", "duration_s": 1,
+            "load": {"shape": "flat", "rps": 1, "tenants": [
+                {"name": "web", "weight": 3, "lane": "interactive"},
+                {"name": "scorer", "weight": 1, "lane": "batch"}]}}],
+    }
+    p = tmp_path / "s.json"
+    p.write_text(json.dumps(doc, indent=1))
+    sc = load_scenario(str(p))
+    tenants = sc.phases[0].load.tenants
+    lanes = {t.name: t.lane for t in tenants}
+    assert lanes == {"web": "interactive", "scorer": "batch"}
+    assert tenants[0].to_dict()["lane"] == "interactive"
+
+    doc["phases"][0]["load"]["tenants"][0]["lane"] = "express"
+    p.write_text(json.dumps(doc, indent=1))
+    with pytest.raises(ScenarioError, match="lane"):
+        load_scenario(str(p))
